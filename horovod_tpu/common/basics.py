@@ -1,0 +1,176 @@
+"""Process-global framework state and lifecycle (init/shutdown).
+
+The reference keeps a singleton ``HorovodGlobalState`` owning the background
+coordinator thread (``horovod/common/operations.cc:90``, ``global_state.h:44``)
+and exposes a C ABI ``horovod_init/rank/size/...`` consumed through ctypes
+(``horovod/common/basics.py``). The TPU-native rebuild keeps the same lifecycle
+surface, but the heavy machinery differs by tier:
+
+* **SPMD tier** (single controller process per host, jit over the device
+  mesh): no negotiation is needed — XLA's SPMD model already guarantees every
+  device executes the same collectives in the same order, which is exactly the
+  invariant the reference's negotiation protocol establishes dynamically
+  (SURVEY.md §5 "Distributed communication backend"). Collectives lower
+  straight to XLA ops over ICI.
+* **Eager multi-process tier** (Horovod parity for host tensors / torch): a
+  background controller with tensor fusion, response cache, timeline and stall
+  detection, speaking a TCP control plane instead of MPI.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Optional, Sequence
+
+from . import hvd_logging as logging
+from .config import Config
+from .topology import Topology, detect
+
+
+class HorovodTpuState:
+    """Python analogue of the reference ``HorovodGlobalState``
+    (``horovod/common/global_state.h:44-154``): one per process, created by
+    ``init()``, torn down by ``shutdown()``/interpreter exit."""
+
+    def __init__(self, config: Config, topology: Topology):
+        self.config = config
+        self.topology = topology
+        self.initialized = True
+        self.shut_down = False
+        self.mutex = threading.RLock()
+        # Lazily-created subsystems (eager tier only).
+        self.controller = None  # control plane + eager collectives
+        self.timeline = None
+        self.parameter_manager = None
+
+    def close(self) -> None:
+        with self.mutex:
+            if self.shut_down:
+                return
+            self.shut_down = True
+            self.initialized = False
+            if self.controller is not None:
+                self.controller.shutdown()
+                self.controller = None
+            if self.timeline is not None:
+                self.timeline.close()
+                self.timeline = None
+
+
+_state: Optional[HorovodTpuState] = None
+_state_lock = threading.Lock()
+
+
+def init(ranks: Optional[Sequence[int]] = None) -> None:
+    """Initialize horovod_tpu. Idempotent, like the reference's
+    ``InitializeHorovodOnce`` (``horovod/common/operations.cc:1566-1583``).
+
+    ``ranks`` restricts the job to a subset of processes, mirroring
+    ``hvd.init(ranks)`` (``horovod/common/basics.py:29-55``). mpi4py
+    communicators are not supported — there is no MPI on TPU; pass ``ranks``
+    or use the launcher's env instead.
+    """
+    global _state
+    with _state_lock:
+        if _state is not None and _state.initialized:
+            return
+        config = Config.from_env()
+        logging.configure(config.log_level, config.log_hide_timestamp)
+        topology = detect(ranks)
+        logging.set_rank(topology.rank)
+        _state = HorovodTpuState(config, topology)
+        if topology.size > 1 and os.environ.get("HOROVOD_CONTROLLER_ADDR"):
+            # Multi-process eager tier: bring up the TCP control plane.
+            try:
+                from ..controller.controller import Controller
+            except ImportError as exc:
+                raise RuntimeError(
+                    "HOROVOD_CONTROLLER_ADDR is set but the controller tier "
+                    "is unavailable in this build") from exc
+            _state.controller = Controller(config, topology)
+        if config.timeline_filename and topology.rank == 0:
+            from .timeline import Timeline
+
+            _state.timeline = Timeline(config.timeline_filename,
+                                       mark_cycles=config.timeline_mark_cycles)
+        logging.debug(
+            "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
+            "local_size=%d devices=%d/%d",
+            topology.rank, topology.size, topology.local_rank,
+            topology.local_size, topology.local_num_devices,
+            topology.num_devices,
+        )
+
+
+def shutdown() -> None:
+    """Tear down background services (reference ``horovod_shutdown``,
+    ``operations.cc:1605-1614``)."""
+    global _state
+    with _state_lock:
+        if _state is not None:
+            _state.close()
+            _state = None
+
+
+atexit.register(shutdown)
+
+
+def _ensure_initialized() -> HorovodTpuState:
+    # The reference raises "Horovod has not been initialized; use hvd.init()"
+    # from every API entry point (horovod/common/operations.cc:1587-1593).
+    if _state is None or not _state.initialized:
+        raise ValueError(
+            "Horovod has not been initialized; use hvd.init().")
+    return _state
+
+
+def state() -> HorovodTpuState:
+    return _ensure_initialized()
+
+
+def is_initialized() -> bool:
+    return _state is not None and _state.initialized
+
+
+def rank() -> int:
+    return _ensure_initialized().topology.rank
+
+
+def size() -> int:
+    return _ensure_initialized().topology.size
+
+
+def local_rank() -> int:
+    return _ensure_initialized().topology.local_rank
+
+
+def local_size() -> int:
+    return _ensure_initialized().topology.local_size
+
+
+def cross_rank() -> int:
+    return _ensure_initialized().topology.cross_rank
+
+
+def cross_size() -> int:
+    return _ensure_initialized().topology.cross_size
+
+
+def num_devices() -> int:
+    """Total accelerator chips in the job (TPU extension; the reference has no
+    equivalent because rank==GPU there)."""
+    return _ensure_initialized().topology.num_devices
+
+
+def local_num_devices() -> int:
+    return _ensure_initialized().topology.local_num_devices
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim for ``hvd.mpi_threads_supported()``
+    (``horovod/common/basics.py:96-104``). There is no MPI in the TPU runtime;
+    the controller's TCP plane is always thread-safe, so report True."""
+    _ensure_initialized()
+    return True

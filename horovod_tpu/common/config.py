@@ -1,0 +1,119 @@
+"""Runtime configuration for horovod_tpu.
+
+The reference configures everything through environment variables read once at
+startup inside ``BackgroundThreadLoop`` (reference ``horovod/common/operations.cc:987-1080``).
+We keep the exact same variable names so operator muscle memory (and existing
+launch scripts) carry over, and add ``HOROVOD_TPU_*`` variables for knobs that
+only exist on TPU.
+
+Unlike the reference, configuration is an explicit dataclass snapshot rather
+than globals scattered through a god object: JAX programs are functional, and a
+frozen config travels well through jit boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Defaults mirror reference horovod/common/operations.cc:1005 (64 MiB fusion
+# threshold), :1013 (5 ms cycle time) and horovod/common/global_state.h:135
+# (1024-entry response cache).
+DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
+DEFAULT_CYCLE_TIME_MS = 5.0
+DEFAULT_CACHE_CAPACITY = 1024
+DEFAULT_STALL_CHECK_SECONDS = 60.0
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in ("", "0", "false", "no", "off")
+
+
+def _env_float(name: str, default: float) -> float:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    if val is None or not val.strip():
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Snapshot of all runtime knobs, read from the environment at init().
+
+    Env-variable names intentionally match the reference (SURVEY.md §5
+    "Config / flag system") so scripts written for the reference keep working.
+    """
+
+    # Tensor Fusion (reference operations.cc:1005): fused buffers up to this
+    # many bytes are reduced in one collective.
+    fusion_threshold_bytes: int = DEFAULT_FUSION_THRESHOLD_BYTES
+    # Background controller tick, ms (reference operations.cc:1013).
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    # Response-cache entries (reference global_state.h:135); 0 disables.
+    cache_capacity: int = DEFAULT_CACHE_CAPACITY
+    # Two-level (ICI-within-slice / DCN-across-slices) collectives, the TPU
+    # analogue of reference NCCLHierarchicalAllreduce (nccl_operations.cc:167).
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    # Chrome-trace timeline output path (reference operations.cc:986-996).
+    timeline_filename: Optional[str] = None
+    timeline_mark_cycles: bool = False
+    # Stall detection (reference operations.cc:688-769).
+    stall_check_disable: bool = False
+    stall_check_seconds: float = DEFAULT_STALL_CHECK_SECONDS
+    stall_shutdown_seconds: float = 0.0  # 0 = never force shutdown
+    # Autotuner (reference parameter_manager.cc).
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    # Logging level name: trace/debug/info/warning/error/fatal.
+    log_level: str = "warning"
+    log_hide_timestamp: bool = False
+    # TPU-only: dtype used on the wire for fused allreduce ("float32",
+    # "bfloat16"). bfloat16 halves ICI bytes; reference's closest analogue is
+    # fp16 Compression (torch/compression.py:45-74).
+    tpu_reduction_dtype: Optional[str] = None
+
+    @staticmethod
+    def from_env() -> "Config":
+        timeline = os.environ.get("HOROVOD_TIMELINE") or None
+        autotune_log = os.environ.get("HOROVOD_AUTOTUNE_LOG") or None
+        return Config(
+            fusion_threshold_bytes=_env_int(
+                "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD_BYTES
+            ),
+            cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS),
+            cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", DEFAULT_CACHE_CAPACITY),
+            hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE"),
+            hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
+            timeline_filename=timeline,
+            timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
+            stall_check_seconds=_env_float(
+                "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_CHECK_SECONDS
+            ),
+            stall_shutdown_seconds=_env_float(
+                "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0
+            ),
+            autotune=_env_bool("HOROVOD_AUTOTUNE"),
+            autotune_log=autotune_log,
+            log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),
+            log_hide_timestamp=_env_bool("HOROVOD_LOG_HIDE_TIME"),
+            tpu_reduction_dtype=os.environ.get("HOROVOD_TPU_REDUCTION_DTYPE") or None,
+        )

@@ -1,0 +1,79 @@
+"""Async-operation handles.
+
+Reference: ``horovod/torch/handle_manager.{h,cc}`` — an int-keyed map from
+handle to completion Status, filled in by the background thread's callback and
+joined by ``synchronize()``. Here a Handle owns a ``threading.Event`` plus the
+result; the manager keeps results alive until waited (the reference pins
+tensors in ``_handle_map`` for the same reason, ``torch/mpi_ops.py:54``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+
+class Handle:
+    __slots__ = ("_id", "_event", "_result", "_error", "_manager")
+
+    def __init__(self, handle_id: int, manager: "HandleManager"):
+        self._id = handle_id
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._manager = manager
+
+    @property
+    def id(self) -> int:
+        return self._id
+
+    def set_result(self, value: Any) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_error(self, exc: BaseException) -> None:
+        self._error = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"handle {self._id} not complete after {timeout}s")
+        self._manager.clear(self._id)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class HandleManager:
+    """Allocates handles and retains them until cleared
+    (reference ``torch/handle_manager.h:31-42``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._next = 0
+        self._live: Dict[int, Handle] = {}
+
+    def allocate(self) -> Handle:
+        with self._lock:
+            hid = self._next
+            self._next += 1
+            h = Handle(hid, self)
+            self._live[hid] = h
+            return h
+
+    def completed(self, value: Any) -> Handle:
+        """A handle that is already resolved (size-1 fast path)."""
+        h = self.allocate()
+        h.set_result(value)
+        return h
+
+    def clear(self, handle_id: int) -> None:
+        with self._lock:
+            self._live.pop(handle_id, None)
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return sum(1 for h in self._live.values() if not h.done())

@@ -1,0 +1,278 @@
+"""Framework-level collective operations: allreduce / allgather / broadcast
+(+ reducescatter / alltoall TPU extensions).
+
+Reference surface: ``horovod/tensorflow/__init__.py:36-87`` (allreduce),
+``horovod/torch/mpi_ops.py:124-438`` (sync + async + in-place variants,
+poll/synchronize). Semantics preserved:
+
+* ``allreduce(t, average=True)`` returns the elementwise mean (sum when
+  ``average=False``) of ``t`` across all ranks.
+* ``allgather(t)`` concatenates along dim 0 in rank order.
+* ``broadcast(t, root_rank)`` returns root's value everywhere.
+
+Two execution tiers (see ``horovod_tpu.common.basics``):
+
+* **Traced/SPMD** — the argument is a JAX tracer inside ``jit``/``shard_map``:
+  the op lowers directly to an XLA collective (``lax.psum`` etc.) over the
+  mesh axis. This is the TPU hot path: no negotiation, no fusion engine —
+  XLA fuses and schedules on ICI. The reference's dynamic negotiation exists
+  to establish exactly the every-rank-runs-the-same-op invariant that SPMD
+  already guarantees statically.
+* **Eager** — host-driven, per-tensor, across *processes*: routed through the
+  background controller (tensor fusion + response cache + timeline + stall
+  detection), the parity path for the reference's
+  ``EnqueueTensorAllreduce`` machinery (``horovod/common/operations.cc:1654``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import basics
+from ..common.handles import Handle, HandleManager
+
+# Reduction op constants. The reference expresses Average as a client-side
+# divide after Sum (torch/mpi_ops_v2.cc:66-72); we expose both spellings.
+Sum = "Sum"
+Average = "Average"
+
+_DEFAULT_AXIS = "data"
+_axis_lock = threading.Lock()
+
+handle_manager = HandleManager()
+
+
+def set_default_spmd_axis(name: str) -> None:
+    """Mesh axis used when a collective is called on a traced value without an
+    explicit ``axis_name``. Default ``"data"`` to match
+    ``horovod_tpu.parallel.mesh``."""
+    global _DEFAULT_AXIS
+    with _axis_lock:
+        _DEFAULT_AXIS = name
+
+
+def _resolve_axis(axis_name: Optional[str]) -> str:
+    return axis_name if axis_name is not None else _DEFAULT_AXIS
+
+
+def _is_traced(tensor) -> bool:
+    return isinstance(tensor, jax.core.Tracer)
+
+
+def _traced_collective(tensor, axis_name, fn):
+    """Run a lax collective on a traced value.
+
+    If the axis name is not bound (plain ``jit``/pjit tracing rather than
+    ``shard_map``), fall back to identity: under pjit-style automatic
+    parallelism the collective is implicit — XLA derives reductions from the
+    sharding annotations — and under single-process tracing (e.g. inside
+    ``optax.MultiSteps``' ``lax.cond``) identity is the size-1 semantics."""
+    ax = _resolve_axis(axis_name)
+    try:
+        return fn(tensor, ax)
+    except NameError:
+        from ..common import hvd_logging as logging
+
+        logging.trace(
+            "collective on traced value with unbound axis %r: identity "
+            "(pjit-style implicit collectives)", ax)
+        return tensor
+
+
+def _resolve_average(average: Optional[bool], op: Optional[str]) -> bool:
+    if op is not None:
+        if average is not None:
+            raise ValueError("specify either average= or op=, not both")
+        return op == Average
+    return True if average is None else bool(average)
+
+
+def _controller():
+    st = basics.state()
+    if st.controller is None:
+        raise RuntimeError(
+            "eager collectives at size > 1 require the background controller; "
+            "launch through horovodrun (which exports HOROVOD_CONTROLLER_ADDR) "
+            "or use the SPMD tier (collectives inside jit/shard_map over a "
+            "multi-host mesh)")
+    return st.controller
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+
+
+def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None,
+              compression=None, op: Optional[str] = None,
+              axis_name: Optional[str] = None):
+    """Mean (or sum) of ``tensor`` over all ranks.
+
+    Reference: ``horovod/tensorflow/__init__.py:36-87`` /
+    ``horovod/torch/mpi_ops.py:124-154``. ``compression`` applies only on the
+    eager tier's wire format (in SPMD, cast before calling — XLA will fuse it).
+    """
+    avg = _resolve_average(average, op)
+    if _is_traced(tensor):
+        return _traced_collective(
+            tensor, axis_name,
+            lambda t, ax: lax.pmean(t, ax) if avg else lax.psum(t, ax))
+    st = basics.state()
+    if st.topology.size == 1:
+        return jnp.asarray(tensor)
+    return _controller().allreduce(tensor, average=avg, name=name,
+                                   compression=compression)
+
+
+def allreduce_async(tensor, average: Optional[bool] = None,
+                    name: Optional[str] = None, op: Optional[str] = None,
+                    compression=None) -> Handle:
+    """Asynchronous allreduce; join with ``synchronize(handle)``.
+
+    Reference: ``horovod/torch/mpi_ops.py:156-198`` — returns an integer
+    handle resolved by the background thread's completion callback."""
+    avg = _resolve_average(average, op)
+    if _is_traced(tensor):
+        raise ValueError(
+            "allreduce_async is an eager-tier API; inside jit use allreduce() "
+            "(XLA already overlaps collectives with compute)")
+    st = basics.state()
+    if st.topology.size == 1:
+        return handle_manager.completed(jnp.asarray(tensor))
+    return _controller().allreduce_async(tensor, average=avg, name=name,
+                                         compression=compression)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+
+
+def allgather(tensor, name: Optional[str] = None,
+              axis_name: Optional[str] = None):
+    """Concatenation of ``tensor`` from all ranks along dim 0, rank order.
+
+    Reference: ``horovod/tensorflow/mpi_ops.py`` HorovodAllgather /
+    ``horovod/torch/mpi_ops.py:200-254``. Eager tier supports differing
+    first-dim sizes across ranks (the reference's allgather response carries
+    per-rank first dims, ``common/message.h:170-180``); the traced tier
+    requires equal shard shapes, as XLA demands static shapes."""
+    if _is_traced(tensor):
+        return _traced_collective(
+            tensor, axis_name, lambda t, ax: lax.all_gather(t, ax, tiled=True))
+    st = basics.state()
+    if st.topology.size == 1:
+        return jnp.asarray(tensor)
+    return _controller().allgather(tensor, name=name)
+
+
+def allgather_async(tensor, name: Optional[str] = None) -> Handle:
+    if _is_traced(tensor):
+        raise ValueError("allgather_async is an eager-tier API")
+    st = basics.state()
+    if st.topology.size == 1:
+        return handle_manager.completed(jnp.asarray(tensor))
+    return _controller().allgather_async(tensor, name=name)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              axis_name: Optional[str] = None):
+    """Root's ``tensor``, delivered to every rank.
+
+    Reference: ``horovod/torch/mpi_ops.py:256-332``. Traced tier: selects the
+    root shard with a masked psum — on TPU this lowers to one all-reduce over
+    ICI, the standard XLA broadcast idiom."""
+    if _is_traced(tensor):
+        def _bcast(t, ax):
+            idx = lax.axis_index(ax)
+            masked = jnp.where(idx == root_rank, t, jnp.zeros_like(t))
+            return lax.psum(masked, ax)
+
+        return _traced_collective(tensor, axis_name, _bcast)
+    st = basics.state()
+    if st.topology.size == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return jnp.asarray(tensor)
+    return _controller().broadcast(tensor, root_rank=root_rank, name=name)
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handle:
+    if _is_traced(tensor):
+        raise ValueError("broadcast_async is an eager-tier API")
+    st = basics.state()
+    if st.topology.size == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return handle_manager.completed(jnp.asarray(tensor))
+    return _controller().broadcast_async(tensor, root_rank=root_rank, name=name)
+
+
+# ---------------------------------------------------------------------------
+# TPU extensions (no reference equivalent; documented as such).
+
+
+def reducescatter(tensor, average: Optional[bool] = None, op: Optional[str] = None,
+                  axis_name: Optional[str] = None):
+    """Reduce + scatter along dim 0. TPU extension: the reference has no
+    user-facing reducescatter (it appears only inside
+    ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:230-247``). On ICI this
+    is the bandwidth-optimal half of an allreduce."""
+    avg = _resolve_average(average, op)
+    if _is_traced(tensor):
+        def _rs(t, ax):
+            out = lax.psum_scatter(t, ax, tiled=True)
+            if avg:
+                out = out / lax.psum(1, ax)
+            return out
+
+        return _traced_collective(tensor, axis_name, _rs)
+    st = basics.state()
+    if st.topology.size == 1:
+        return jnp.asarray(tensor)
+    return _controller().reducescatter(tensor, average=avg)
+
+
+def alltoall(tensor, axis_name: Optional[str] = None):
+    """Exchange dim-0 splits between ranks. TPU extension (reference lacks
+    alltoall; it arrived upstream in Horovod 0.20). Building block for
+    Ulysses-style sequence parallelism (``horovod_tpu.parallel.sequence``)."""
+    if _is_traced(tensor):
+        def _a2a(t, ax):
+            n = lax.psum(1, ax)
+            x = t.reshape((n, t.shape[0] // n) + tuple(t.shape[1:]))
+            out = lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                                 tiled=False)
+            return out.reshape((-1,) + tuple(t.shape[1:]))
+
+        return _traced_collective(tensor, axis_name, _a2a)
+    st = basics.state()
+    if st.topology.size == 1:
+        return jnp.asarray(tensor)
+    return _controller().alltoall(tensor)
+
+
+# ---------------------------------------------------------------------------
+# handle resolution (reference torch/mpi_ops.py:422-438)
+
+
+def synchronize(handle: Handle):
+    """Block until an async op completes and return its result."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    """True if the async op has completed (reference ``horovod_torch_poll``,
+    ``torch/mpi_ops_v2.cc:226-229``)."""
+    return handle.done()
+
+
+def wait(handle: Handle):
+    return handle.wait()

@@ -1,0 +1,17 @@
+"""Parallelism substrate: device meshes, shardings, and (TPU extensions)
+sequence/context parallelism.
+
+The reference implements data parallelism only (SURVEY.md §2.3); the mesh
+utilities here are its substrate plus the axes future strategies hang off."""
+
+from .mesh import (  # noqa: F401
+    DATA_AXIS,
+    make_mesh,
+    mesh,
+    set_mesh,
+    reset_mesh,
+    data_sharding,
+    replicated_sharding,
+    shard_batch,
+    replicate,
+)

@@ -1,0 +1,112 @@
+"""Device-mesh utilities — the TPU-native substrate for data parallelism.
+
+The reference's unit of parallelism is the *process* (one per GPU), with NCCL
+rings built at runtime (``horovod/common/ops/nccl_operations.cc:111-153``). On
+TPU the unit is the *chip* on a ``jax.sharding.Mesh``: XLA lowers collectives
+onto ICI rings/tori automatically from sharding annotations, so "building the
+ring" is replaced by "choosing the mesh".
+
+The reference only implements data parallelism (SURVEY.md §2.3), so the default
+mesh is 1-D over every chip with axis name ``"data"``. The helpers accept
+arbitrary extra axes (``model``, ``seq``, ...) because the same substrate
+carries TP/SP — see ``horovod_tpu.parallel`` extensions.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+_lock = threading.Lock()
+_global_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    axes: Optional[Mapping[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh. Default: 1-D ``("data",)`` over all visible devices.
+
+    ``axes`` maps axis name -> size; one axis may be -1 (inferred). Axis order
+    matters on hardware: earlier axes change slowest, and XLA maps the
+    trailing axes onto the densest ICI dimension, so put the
+    highest-bandwidth-demand axis (e.g. ``model``) last.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    names = tuple(axes.keys())
+    sizes = [int(s) for s in axes.values()]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1])) or 1
+        if n % known:
+            raise ValueError(f"cannot infer axis: {n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} != {n} devices")
+    arr = np.array(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def mesh() -> Mesh:
+    """The process-global mesh, lazily a 1-D data mesh over all devices."""
+    global _global_mesh
+    with _lock:
+        if _global_mesh is None:
+            _global_mesh = make_mesh()
+        return _global_mesh
+
+
+def set_mesh(m: Mesh) -> None:
+    global _global_mesh
+    with _lock:
+        _global_mesh = m
+
+
+def reset_mesh() -> None:
+    global _global_mesh
+    with _lock:
+        _global_mesh = None
+
+
+def data_sharding(m: Optional[Mesh] = None, *dims_after_batch: Optional[str]) -> NamedSharding:
+    """Sharding for a batch: leading dim split over every mesh axis named
+    ``data``-like; remaining dims follow ``dims_after_batch`` (default
+    replicated)."""
+    m = m or mesh()
+    return NamedSharding(m, PartitionSpec(DATA_AXIS, *dims_after_batch))
+
+
+def replicated_sharding(m: Optional[Mesh] = None) -> NamedSharding:
+    m = m or mesh()
+    return NamedSharding(m, PartitionSpec())
+
+
+def shard_batch(tree, m: Optional[Mesh] = None):
+    """Place a host pytree on the mesh, batch dim split along ``data``.
+
+    TPU-native replacement for the reference pattern of each process loading
+    its own shard (``examples/tensorflow_mnist.py`` dataset sharding by rank):
+    one controller process places the global batch; XLA scatters it.
+    """
+    m = m or mesh()
+    sh = NamedSharding(m, PartitionSpec(DATA_AXIS))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+
+def replicate(tree, m: Optional[Mesh] = None):
+    """Replicate a pytree (params/optimizer state) across the mesh."""
+    m = m or mesh()
+    sh = NamedSharding(m, PartitionSpec())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)

@@ -1,0 +1,36 @@
+"""Test harness: hermetic 8-virtual-device CPU mesh.
+
+The reference tests "distributed" behavior with 2 MPI ranks on one container
+(SURVEY.md §4). Our equivalent: a single process with 8 XLA host devices
+(``--xla_force_host_platform_device_count=8``) exercising the SPMD tier, plus
+subprocess-spawned multi-rank tests for the eager controller tier.
+
+Must run before ``import jax``: the axon sitecustomize exports
+``JAX_PLATFORMS=axon``, so we override in-process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Each test gets a fresh hvd lifecycle and mesh registry."""
+    yield
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel import reset_mesh
+
+    hvd.shutdown()
+    reset_mesh()

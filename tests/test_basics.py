@@ -1,0 +1,77 @@
+"""Lifecycle + topology tests.
+
+Reference analogue: rank/size assertions at the top of every test module
+(``test/test_torch.py`` TorchTests.test_horovod_rank etc., via
+``test/common.py:25-58`` env conventions)."""
+
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_not_initialized_raises():
+    with pytest.raises(ValueError, match="not been initialized"):
+        hvd.rank()
+
+
+def test_init_single_process():
+    hvd.init()
+    assert hvd.is_initialized()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.num_devices() == 8  # virtual CPU devices from conftest
+    assert hvd.local_num_devices() == 8
+    assert hvd.mpi_threads_supported() is True
+
+
+def test_init_idempotent():
+    hvd.init()
+    hvd.init()
+    assert hvd.size() == 1
+
+
+def test_env_topology(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_LOCAL_RANK", "1")
+    monkeypatch.setenv("HOROVOD_LOCAL_SIZE", "2")
+    from horovod_tpu.common.topology import detect
+
+    topo = detect()
+    assert topo.rank == 3 and topo.size == 8
+    assert topo.local_rank == 1 and topo.local_size == 2
+    assert topo.cross_rank == 1 and topo.cross_size == 4
+
+
+def test_ompi_env_compat(monkeypatch):
+    # Reference reads OMPI_COMM_WORLD_* (test/common.py:25-58).
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "1")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "2")
+    from horovod_tpu.common.topology import detect
+
+    topo = detect()
+    assert topo.rank == 1 and topo.size == 2
+
+
+def test_init_ranks_subset(monkeypatch):
+    # hvd.init(ranks) narrows the job (horovod/common/basics.py:29-55).
+    monkeypatch.setenv("HOROVOD_RANK", "2")
+    monkeypatch.setenv("HOROVOD_SIZE", "4")
+    from horovod_tpu.common.topology import detect
+
+    topo = detect(ranks=[2, 3])
+    assert topo.rank == 0 and topo.size == 2
+
+    with pytest.raises(RuntimeError):
+        detect(ranks=[0, 1])
+
+
+def test_shutdown_then_raise():
+    hvd.init()
+    hvd.shutdown()
+    with pytest.raises(ValueError, match="not been initialized"):
+        hvd.size()

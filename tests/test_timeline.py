@@ -1,0 +1,50 @@
+"""Timeline subsystem test.
+
+Reference: ``test/test_timeline.py:41-58`` — runs a named allreduce with
+HOROVOD_TIMELINE set and asserts the JSON contains NEGOTIATE_ALLREDUCE,
+ALLREDUCE and CYCLE_START markers."""
+
+import json
+
+from horovod_tpu.common import timeline as tl
+
+
+def test_timeline_events(tmp_path):
+    path = tmp_path / "timeline.json"
+    t = tl.Timeline(str(path), mark_cycles=True)
+    t.negotiate_start("grad.0", "allreduce")
+    t.negotiate_rank_ready("grad.0", 0)
+    t.negotiate_end("grad.0", "allreduce")
+    t.start("grad.0", tl.ALLREDUCE)
+    t.activity_start("grad.0", tl.MEMCPY_IN_FUSION_BUFFER)
+    t.activity_end("grad.0")
+    t.activity_start("grad.0", tl.XLA_COLLECTIVE)
+    t.activity_end("grad.0")
+    t.end("grad.0")
+    t.mark_cycle_start()
+    t.close()
+
+    content = path.read_text()
+    # Same markers the reference test asserts on (test/test_timeline.py:41-58).
+    assert "NEGOTIATE_ALLREDUCE" in content
+    assert "ALLREDUCE" in content
+    assert "CYCLE_START" in content
+    assert "grad.0" in content
+    events = json.loads(content)
+    assert any(e.get("ph") == "B" for e in events)
+    assert any(e.get("ph") == "E" for e in events)
+
+
+def test_timeline_via_init(tmp_path, monkeypatch):
+    path = tmp_path / "tl.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    hvd.init()
+    st = basics.state()
+    assert st.timeline is not None
+    st.timeline.start("x", tl.BROADCAST)
+    st.timeline.end("x")
+    hvd.shutdown()
+    assert "BROADCAST" in path.read_text()
