@@ -81,20 +81,17 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         topology = detect(ranks)
         logging.set_rank(topology.rank)
         _state = HorovodTpuState(config, topology)
-        if topology.size > 1 and os.environ.get("HOROVOD_CONTROLLER_ADDR"):
-            # Multi-process eager tier: bring up the TCP control plane.
-            try:
-                from ..controller.controller import Controller
-            except ImportError as exc:
-                raise RuntimeError(
-                    "HOROVOD_CONTROLLER_ADDR is set but the controller tier "
-                    "is unavailable in this build") from exc
-            _state.controller = Controller(config, topology)
         if config.timeline_filename and topology.rank == 0:
             from .timeline import Timeline
 
             _state.timeline = Timeline(config.timeline_filename,
                                        mark_cycles=config.timeline_mark_cycles)
+        if topology.size > 1 and os.environ.get("HOROVOD_CONTROLLER_ADDR"):
+            # Multi-process eager tier: bring up the TCP control plane.
+            from ..controller.controller import Controller
+
+            _state.controller = Controller(config, topology,
+                                           timeline=_state.timeline)
         logging.debug(
             "horovod_tpu initialized: rank=%d size=%d local_rank=%d "
             "local_size=%d devices=%d/%d",

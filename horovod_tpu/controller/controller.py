@@ -1,0 +1,572 @@
+"""Background controller: the eager tier's negotiation + execution engine.
+
+Reference: ``horovod/common/operations.cc`` — a background thread per process
+ticks every ``cycle_time_ms`` (``RunLoopOnce``, operations.cc:1246), drains
+the request queue, negotiates globally-ready tensors (coordinator
+gathers RequestLists / broadcasts the fused ResponseList,
+operations.cc:1388-1518), packs Tensor Fusion groups (``FuseResponses``,
+operations.cc:450-573), executes, and fires completion callbacks. A
+bit-indexed response cache short-circuits negotiation for repeat tensors
+(``CoordinateCacheAndState`` + ``RunBypass``, operations.cc:1166-1381), and
+the coordinator warns/aborts on stalled ranks (operations.cc:688-769).
+
+This is the same machine with MPI swapped for the TCP star
+(``horovod_tpu.controller.service``) and the data plane on host numpy buffers
+(the reference's MPI CPU ops). TPU device tensors take the SPMD tier instead —
+on XLA the negotiation's purpose (every rank executes the same collective in
+the same order) is a static property of the compiled program.
+
+Protocol per cycle (lockstep):
+  worker → coordinator   {"rank", "cache_mask", "invalid_mask",
+                          "requests": RequestList}
+  coordinator → workers  {"bypass_bits", "invalid_mask",
+                          "responses": ResponseList}
+  then, for each bypass bit and each response, in identical order on every
+  rank: one raw-buffer data exchange (send shard / recv result).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..common import hvd_logging as logging
+from ..common import timeline as tl
+from ..common.config import Config
+from ..common.handles import Handle, HandleManager
+from ..common.message import (
+    Request,
+    RequestList,
+    RequestType,
+    Response,
+    ResponseList,
+    ResponseType,
+    construct_response,
+)
+from ..common.response_cache import ResponseCache
+from ..common.topology import Topology
+from .service import CoordinatorService, WorkerClient
+
+_OP_NAMES = {
+    RequestType.ALLREDUCE: "ALLREDUCE",
+    RequestType.ALLGATHER: "ALLGATHER",
+    RequestType.BROADCAST: "BROADCAST",
+}
+
+
+class _Pending:
+    """Tensor-table entry (reference ``TensorTableEntry``,
+    ``common/common.h:167-184``)."""
+
+    __slots__ = ("name", "array", "request", "handle", "average",
+                 "postprocess", "enqueued_at")
+
+    def __init__(self, name: str, array: np.ndarray, request: Request,
+                 handle: Handle, average: bool,
+                 postprocess: Optional[Callable[[np.ndarray], Any]]):
+        self.name = name
+        self.array = array
+        self.request = request
+        self.handle = handle
+        self.average = average
+        self.postprocess = postprocess
+        self.enqueued_at = time.monotonic()
+
+
+class ShutdownError(RuntimeError):
+    """Delivered to pending callbacks at teardown (reference
+    ``operations.cc:1107-1122`` "Horovod has been shut down")."""
+
+
+class Controller:
+    def __init__(self, config: Config, topology: Topology,
+                 timeline: Optional[tl.Timeline] = None):
+        self.cfg = config
+        self.topo = topology
+        self.timeline = timeline
+        self.handles = HandleManager()
+        self._lock = threading.Lock()
+        self._queue: List[str] = []           # names awaiting negotiation
+        self._table: Dict[str, _Pending] = {}  # name -> entry
+        self._bit_pending: Dict[int, str] = {}  # cache bit -> name (hits)
+        self._cache = ResponseCache(config.cache_capacity)
+        self._autoname_counter: Dict[str, int] = {}
+        self._shutdown_requested = False
+        self._closed = threading.Event()
+        self._stall_warned: Dict[str, float] = {}
+
+        addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
+        if topology.rank == 0:
+            self._service = CoordinatorService(addr, topology.size)
+            self._client = None
+            # Coordinator's MessageTable (reference global_state.h:34):
+            # name -> {rank: Request}; plus first-seen stamps for stall check.
+            self._message_table: Dict[str, Dict[int, Request]] = {}
+            self._first_seen: Dict[str, float] = {}
+        else:
+            self._service = None
+            self._client = WorkerClient(addr, topology.rank)
+
+        self._thread = threading.Thread(
+            target=self._run_loop, name="hvd-controller", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------ API
+
+    def _autoname(self, kind: str, name: Optional[str]) -> str:
+        if name is not None:
+            return name
+        # Deterministic per-type counters: identical call order across ranks
+        # yields identical names, like the reference's handle-derived names
+        # for unnamed torch tensors (torch/mpi_ops.py:49-56).
+        with self._lock:
+            n = self._autoname_counter.get(kind, 0)
+            self._autoname_counter[kind] = n + 1
+        return f"{kind}.noname.{n}"
+
+    def _enqueue(self, kind: str, name: Optional[str], array: np.ndarray,
+                 request_type: RequestType, average: bool = False,
+                 root_rank: int = -1,
+                 postprocess: Optional[Callable] = None) -> Handle:
+        name = self._autoname(kind, name)
+        array = np.ascontiguousarray(array)
+        req = Request(
+            request_rank=self.topo.rank, request_type=request_type,
+            tensor_name=name, tensor_dtype=str(array.dtype),
+            tensor_shape=tuple(array.shape), root_rank=root_rank)
+        handle = self.handles.allocate()
+        entry = _Pending(name, array, req, handle, average, postprocess)
+        with self._lock:
+            if self._closed.is_set() or self._shutdown_requested:
+                handle.set_error(ShutdownError("Horovod has been shut down"))
+                return handle
+            if name in self._table:
+                # Reference IncrementTensorCount duplicate-name error
+                # (operations.cc:164-175): same name enqueued again before
+                # the previous operation finished.
+                handle.set_error(RuntimeError(
+                    f"Duplicate tensor name {name!r}: a collective with this "
+                    "name is already pending; names must be unique until the "
+                    "operation completes."))
+                return handle
+            self._table[name] = entry
+            self._queue.append(name)
+        return handle
+
+    def allreduce_async(self, tensor, average: bool = True,
+                        name: Optional[str] = None, compression=None,
+                        wrap: Optional[Callable] = None) -> Handle:
+        array = np.asarray(tensor)
+        ctx = None
+        if compression is not None:
+            compressed, ctx = compression.compress(array)
+            array = np.asarray(compressed)
+
+        size = self.topo.size
+
+        def post(out: np.ndarray, _ctx=ctx, _compression=compression):
+            if _compression is not None:
+                out = np.asarray(_compression.decompress(out, _ctx))
+            if average:
+                out = out / size
+            return wrap(out) if wrap is not None else out
+
+        return self._enqueue("allreduce", name, array, RequestType.ALLREDUCE,
+                             average=average, postprocess=post)
+
+    def allgather_async(self, tensor, name: Optional[str] = None,
+                        wrap: Optional[Callable] = None) -> Handle:
+        return self._enqueue("allgather", name, np.asarray(tensor),
+                             RequestType.ALLGATHER, postprocess=wrap)
+
+    def broadcast_async(self, tensor, root_rank: int,
+                        name: Optional[str] = None,
+                        wrap: Optional[Callable] = None) -> Handle:
+        return self._enqueue("broadcast", name, np.asarray(tensor),
+                             RequestType.BROADCAST, root_rank=root_rank,
+                             postprocess=wrap)
+
+    def allreduce(self, tensor, average: bool = True,
+                  name: Optional[str] = None, compression=None,
+                  wrap: Optional[Callable] = None):
+        return self.allreduce_async(tensor, average, name, compression,
+                                    wrap=wrap).wait()
+
+    def allgather(self, tensor, name: Optional[str] = None,
+                  wrap: Optional[Callable] = None):
+        return self.allgather_async(tensor, name, wrap=wrap).wait()
+
+    def broadcast(self, tensor, root_rank: int, name: Optional[str] = None,
+                  wrap: Optional[Callable] = None):
+        return self.broadcast_async(tensor, root_rank, name, wrap=wrap).wait()
+
+    def reducescatter(self, tensor, average: bool = True):
+        raise NotImplementedError(
+            "reducescatter is an SPMD-tier extension; use it inside "
+            "jit/shard_map (the reference has no eager reducescatter either)")
+
+    def alltoall(self, tensor):
+        raise NotImplementedError(
+            "alltoall is an SPMD-tier extension; use it inside jit/shard_map")
+
+    def shutdown(self) -> None:
+        """Cooperative teardown: flag travels with the next tick, coordinator
+        echoes it to everyone (reference RequestList.shutdown,
+        operations.cc:1442-1445,1499)."""
+        with self._lock:
+            self._shutdown_requested = True
+        self._thread.join(timeout=30.0)
+        if self._thread.is_alive():
+            logging.warning("controller thread did not exit within 30s")
+
+    # ------------------------------------------------------------ cycle loop
+
+    def _run_loop(self) -> None:
+        try:
+            while not self._closed.is_set():
+                started = time.monotonic()
+                if self.timeline:
+                    self.timeline.mark_cycle_start()
+                self._cycle()
+                if self.topo.rank != 0:
+                    # Workers pace the lockstep; the coordinator is paced by
+                    # their arrivals (reference sleeps cycle_time in every
+                    # rank's loop, operations.cc:1250-1255).
+                    elapsed = time.monotonic() - started
+                    delay = self.cfg.cycle_time_ms / 1e3 - elapsed
+                    if delay > 0 and not self._shutdown_requested:
+                        time.sleep(delay)
+        except Exception as exc:  # transport failure: fail all pending work
+            logging.error("controller loop failed: %s", exc)
+            self._fail_all(exc)
+        finally:
+            self._closed.set()
+            if self._service:
+                self._service.close()
+            if self._client:
+                self._client.close()
+
+    def _build_tick(self) -> dict:
+        with self._lock:
+            names = self._queue
+            self._queue = []
+            cache_mask = 0
+            invalid_mask = 0
+            uncached: List[Request] = []
+            for name in names:
+                entry = self._table[name]
+                bit = self._cache.lookup(entry.request)
+                if bit is not None:
+                    self._bit_pending[bit] = name
+                    continue
+                stale = self._cache.stale_bit(entry.request)
+                if stale is not None:
+                    invalid_mask |= 1 << stale
+                uncached.append(entry.request)
+            for bit in self._bit_pending:
+                cache_mask |= 1 << bit
+            shutdown = self._shutdown_requested
+        return {
+            "rank": self.topo.rank,
+            "cache_mask": cache_mask,
+            "invalid_mask": invalid_mask,
+            "requests": RequestList(requests=uncached, shutdown=shutdown),
+        }
+
+    def _cycle(self) -> None:
+        tick = self._build_tick()
+        if self.topo.rank == 0:
+            reply = self._coordinate(tick)
+        else:
+            self._client.send(tick)
+            reply = self._client.recv()
+        self._process_reply(reply)
+
+    # ------------------------------------------------------- coordinator side
+
+    def _coordinate(self, my_tick: dict) -> dict:
+        size = self.topo.size
+        ticks = {0: my_tick}
+        for rank in range(1, size):
+            ticks[rank] = self._service.recv_from(rank)
+
+        shutdown = any(t["requests"].shutdown for t in ticks.values())
+        invalid_mask = 0
+        for t in ticks.values():
+            invalid_mask |= t["invalid_mask"]
+        and_mask = ticks[0]["cache_mask"]
+        for t in ticks.values():
+            and_mask &= t["cache_mask"]
+        and_mask &= ~invalid_mask
+        bypass_bits = ResponseCache.mask_to_bits(and_mask)
+
+        # Negotiation (reference operations.cc:1388-1475): accumulate
+        # per-tensor requests; a tensor is ready when every rank reported it.
+        now = time.monotonic()
+        ready: List[Response] = []
+        for rank in sorted(ticks):
+            for req in ticks[rank]["requests"].requests:
+                entry = self._message_table.setdefault(req.tensor_name, {})
+                if not entry:
+                    self._first_seen[req.tensor_name] = now
+                    if self.timeline:
+                        self.timeline.negotiate_start(
+                            req.tensor_name, _OP_NAMES[req.request_type])
+                if self.timeline:
+                    self.timeline.negotiate_rank_ready(req.tensor_name, rank)
+                entry[rank] = req
+        for name in list(self._message_table):
+            entry = self._message_table[name]
+            if len(entry) == size:
+                requests = [entry[r] for r in range(size)]
+                response = construct_response(requests, size)
+                ready.append(response)
+                del self._message_table[name]
+                self._first_seen.pop(name, None)
+                self._stall_warned.pop(name, None)
+                if self.timeline:
+                    self.timeline.negotiate_end(
+                        name, _OP_NAMES[requests[0].request_type])
+
+        self._check_stalls(now)
+        responses = self._fuse_responses(ready)
+        reply = {
+            "bypass_bits": bypass_bits,
+            "invalid_mask": invalid_mask,
+            "responses": ResponseList(responses=responses, shutdown=shutdown),
+        }
+        self._service.send_all(reply)
+        return reply
+
+    def _fuse_responses(self, responses: List[Response]) -> List[Response]:
+        """Tensor Fusion packing (reference ``FuseResponses``,
+        ``operations.cc:450-573``): join ALLREDUCE responses of equal dtype
+        while the fused byte count stays under the threshold, with look-ahead
+        past mismatched dtypes. Only allreduce fuses (as in the reference);
+        byte sizes come from the negotiated shapes, identical on all ranks."""
+        out: List[Response] = []
+        pending = list(responses)
+        while pending:
+            first = pending.pop(0)
+            if first.response_type != ResponseType.ALLREDUCE:
+                out.append(first)
+                continue
+            fused = first
+            dtype = self._response_dtype(first)
+            total = self._response_bytes(first)
+            i = 0
+            while i < len(pending):
+                cand = pending[i]
+                if (cand.response_type == ResponseType.ALLREDUCE
+                        and self._response_dtype(cand) == dtype):
+                    nbytes = self._response_bytes(cand)
+                    if total + nbytes <= self.cfg.fusion_threshold_bytes:
+                        fused.tensor_names.extend(cand.tensor_names)
+                        total += nbytes
+                        pending.pop(i)
+                        continue
+                i += 1  # look-ahead (reference operations.cc:483-499)
+            out.append(fused)
+        return out
+
+    def _response_dtype(self, response: Response) -> str:
+        return self._table[response.tensor_names[0]].request.tensor_dtype
+
+    def _response_bytes(self, response: Response) -> int:
+        return sum(self._table[n].array.nbytes for n in response.tensor_names)
+
+    def _check_stalls(self, now: float) -> None:
+        """Reference ``CheckForStalledTensors`` (operations.cc:688-769)."""
+        if self.cfg.stall_check_disable:
+            return
+        for name, first in list(self._first_seen.items()):
+            age = now - first
+            if age > self.cfg.stall_check_seconds:
+                last = self._stall_warned.get(name, 0.0)
+                if now - last > self.cfg.stall_check_seconds:
+                    seen = sorted(self._message_table.get(name, {}))
+                    missing = [r for r in range(self.topo.size)
+                               if r not in seen]
+                    logging.warning(
+                        "One or more tensors were submitted to be reduced, "
+                        "gathered or broadcasted by subset of ranks and are "
+                        "waiting for remainder of ranks for more than %ds. "
+                        "Stalled op: %s [missing ranks: %s]",
+                        int(self.cfg.stall_check_seconds), name,
+                        ", ".join(map(str, missing)))
+                    self._stall_warned[name] = now
+                if (self.cfg.stall_shutdown_seconds > 0
+                        and age > self.cfg.stall_shutdown_seconds):
+                    logging.error(
+                        "Stall duration exceeded "
+                        "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS: aborting job "
+                        "(stalled op: %s)", name)
+                    with self._lock:
+                        self._shutdown_requested = True
+
+    # ----------------------------------------------------------- both sides
+
+    def _process_reply(self, reply: dict) -> None:
+        for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
+            name = None
+            with self._lock:
+                self._cache.evict_bit(bit)
+                name = self._bit_pending.pop(bit, None)
+                if name is not None:
+                    # Cache entry died under a pending hit: renegotiate.
+                    self._queue.append(name)
+
+        for bit in reply["bypass_bits"]:
+            # Cached fast path (reference RunBypass, operations.cc:1166-1215).
+            _, response = self._cache.get(bit)
+            with self._lock:
+                self._cache.touch(bit)
+                name = self._bit_pending.pop(bit)
+            self._execute(Response(
+                response_type=response.response_type,
+                tensor_names=[name],
+                tensor_sizes=list(response.tensor_sizes)), cache_put=False)
+
+        rlist: ResponseList = reply["responses"]
+        for response in rlist.responses:
+            self._execute(response, cache_put=True)
+
+        if rlist.shutdown or self._shutdown_requested:
+            self._fail_all(ShutdownError("Horovod has been shut down"))
+            self._closed.set()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            entries = list(self._table.values())
+            self._table.clear()
+            self._queue.clear()
+            self._bit_pending.clear()
+        for entry in entries:
+            if not entry.handle.done():
+                entry.handle.set_error(exc)
+
+    # ------------------------------------------------------------ data plane
+
+    def _execute(self, response: Response, cache_put: bool) -> None:
+        names = response.tensor_names
+        if response.response_type == ResponseType.ERROR:
+            with self._lock:
+                entries = [self._table.pop(n) for n in names]
+            for entry in entries:
+                entry.handle.set_error(RuntimeError(response.error_message))
+            return
+
+        with self._lock:
+            entries = [self._table[n] for n in names]
+        tname = names[0] if len(names) == 1 else f"fused[{len(names)}]"
+        if self.timeline:
+            self.timeline.start(tname, response.response_type.name)
+
+        if response.response_type == ResponseType.ALLREDUCE:
+            self._execute_allreduce(entries, tname)
+        elif response.response_type == ResponseType.ALLGATHER:
+            self._execute_allgather(entries[0], response)
+        else:
+            self._execute_broadcast(entries[0])
+
+        with self._lock:
+            for entry in entries:
+                self._table.pop(entry.name, None)
+                if cache_put:
+                    self._cache.put(
+                        entry.request,
+                        Response(response_type=response.response_type,
+                                 tensor_names=[entry.name],
+                                 tensor_sizes=list(response.tensor_sizes)))
+        if self.timeline:
+            self.timeline.end(tname)
+
+    def _finish(self, entry: _Pending, out: np.ndarray) -> None:
+        if entry.postprocess is not None:
+            out = entry.postprocess(out)
+        entry.handle.set_result(out)
+
+    def _execute_allreduce(self, entries: List[_Pending], tname: str) -> None:
+        # Pack the fusion buffer (reference MemcpyInFusionBuffer,
+        # collective_operations.cc:35-50).
+        if self.timeline:
+            self.timeline.activity_start(tname, tl.MEMCPY_IN_FUSION_BUFFER)
+        dtype = entries[0].array.dtype
+        buf = (entries[0].array.ravel() if len(entries) == 1 else
+               np.concatenate([e.array.ravel() for e in entries]))
+        # Integer sums are exact; float sums happen in the wire dtype, as in
+        # the reference's MPI_SUM on the raw buffer.
+        if self.timeline:
+            self.timeline.activity_end(tname)
+            self.timeline.activity_start(tname, tl.TCP_COLLECTIVE)
+        if self.topo.rank == 0:
+            acc = buf.astype(buf.dtype, copy=True)
+            for rank in range(1, self.topo.size):
+                peer = np.frombuffer(
+                    self._service.recv_bytes_from(rank), dtype=dtype)
+                acc = acc + peer
+            payload = acc.tobytes()
+            for rank in range(1, self.topo.size):
+                self._service.send_bytes_to(rank, payload)
+            result = acc
+        else:
+            self._client.send_bytes(buf.tobytes())
+            result = np.frombuffer(self._client.recv_bytes(), dtype=dtype)
+        if self.timeline:
+            self.timeline.activity_end(tname)
+            self.timeline.activity_start(tname, tl.MEMCPY_OUT_FUSION_BUFFER)
+        offset = 0
+        for entry in entries:
+            n = entry.array.size
+            out = result[offset:offset + n].reshape(entry.array.shape)
+            offset += n
+            self._finish(entry, np.array(out, copy=True))
+        if self.timeline:
+            self.timeline.activity_end(tname)
+
+    def _execute_allgather(self, entry: _Pending, response: Response) -> None:
+        dtype = entry.array.dtype
+        rest = entry.array.shape[1:]
+        if self.topo.rank == 0:
+            parts = {0: entry.array}
+            for rank in range(1, self.topo.size):
+                raw = np.frombuffer(
+                    self._service.recv_bytes_from(rank), dtype=dtype)
+                parts[rank] = raw.reshape((response.tensor_sizes[rank],) + rest)
+            full = np.concatenate([parts[r] for r in range(self.topo.size)])
+            payload = full.tobytes()
+            for rank in range(1, self.topo.size):
+                self._service.send_bytes_to(rank, payload)
+        else:
+            self._client.send_bytes(entry.array.tobytes())
+            raw = np.frombuffer(self._client.recv_bytes(), dtype=dtype)
+            full = raw.reshape((sum(response.tensor_sizes),) + rest)
+        self._finish(entry, np.array(full, copy=True))
+
+    def _execute_broadcast(self, entry: _Pending) -> None:
+        root = entry.request.root_rank
+        if self.topo.rank == 0:
+            if root == 0:
+                data = entry.array
+            else:
+                raw = self._service.recv_bytes_from(root)
+                data = np.frombuffer(raw, dtype=entry.array.dtype).reshape(
+                    entry.array.shape)
+            payload = data.tobytes()
+            for rank in range(1, self.topo.size):
+                if rank != root:
+                    self._service.send_bytes_to(rank, payload)
+            result = data
+        else:
+            if self.topo.rank == root:
+                self._client.send_bytes(entry.array.tobytes())
+                result = entry.array
+            else:
+                raw = self._client.recv_bytes()
+                result = np.frombuffer(raw, dtype=entry.array.dtype).reshape(
+                    entry.array.shape)
+        self._finish(entry, np.array(result, copy=True))

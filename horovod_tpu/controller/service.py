@@ -1,0 +1,117 @@
+"""Star-topology control/data transport: coordinator (rank 0) + workers.
+
+The reference's control plane is MPI collectives among ranks —
+``MPI_Gather``/``MPI_Gatherv`` of RequestLists into rank 0 and ``MPI_Bcast``
+of the fused ResponseList back (``horovod/common/operations.cc:1388-1518``).
+On TPU there is no MPI; the equivalent is a TCP star: every worker keeps one
+persistent authenticated connection to the coordinator, sends its tick
+(gather), and receives the reply (bcast). The rendezvous/bootstrap pattern
+follows the reference's driver/task services (``run/common/service/*``).
+
+The same connections carry the host-tensor data phases (the reference's MPI
+CPU ops, ``common/ops/mpi_operations.cc``): the protocol is strict lockstep —
+every rank walks the identical response list in the identical order — so
+control and data frames never interleave ambiguously.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..common import hvd_logging as logging
+from ..common.wire import Wire
+
+
+def parse_addr(addr: str) -> Tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class CoordinatorService:
+    """Rank 0's side: accept one connection per worker rank."""
+
+    def __init__(self, bind_addr: str, size: int, accept_timeout: float = 120.0):
+        host, port = parse_addr(bind_addr)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(size)
+        self.wires: Dict[int, Wire] = {}
+        deadline = time.monotonic() + accept_timeout
+        while len(self.wires) < size - 1:
+            self._listener.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                raise TimeoutError(
+                    f"coordinator: only {len(self.wires)}/{size - 1} workers "
+                    f"connected within {accept_timeout}s")
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            wire = Wire(conn)
+            hello = wire.recv_obj()
+            rank = int(hello["rank"])
+            self.wires[rank] = wire
+            logging.debug("coordinator: rank %d connected", rank)
+
+    def recv_from(self, rank: int) -> Any:
+        return self.wires[rank].recv_obj()
+
+    def recv_bytes_from(self, rank: int) -> bytes:
+        return self.wires[rank].recv_bytes()
+
+    def send_to(self, rank: int, obj: Any) -> None:
+        self.wires[rank].send_obj(obj)
+
+    def send_bytes_to(self, rank: int, payload: bytes) -> None:
+        self.wires[rank].send_bytes(payload)
+
+    def send_all(self, obj: Any) -> None:
+        for rank in sorted(self.wires):
+            self.wires[rank].send_obj(obj)
+
+    def close(self) -> None:
+        for wire in self.wires.values():
+            wire.close()
+        self._listener.close()
+
+
+class WorkerClient:
+    """A non-zero rank's side: one persistent connection, with connect
+    retries while the coordinator comes up (the reference's task services
+    retry registration the same way, ``run/common/service/driver_service.py``)."""
+
+    def __init__(self, addr: str, rank: int, connect_timeout: float = 120.0):
+        host, port = parse_addr(addr)
+        deadline = time.monotonic() + connect_timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                break
+            except OSError as exc:
+                last_err = exc
+                time.sleep(0.05)
+        else:
+            raise ConnectionError(
+                f"rank {rank}: cannot reach coordinator at {addr}: {last_err}")
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.wire = Wire(sock)
+        self.wire.send_obj({"rank": rank})
+
+    def send(self, obj: Any) -> None:
+        self.wire.send_obj(obj)
+
+    def recv(self) -> Any:
+        return self.wire.recv_obj()
+
+    def send_bytes(self, payload: bytes) -> None:
+        self.wire.send_bytes(payload)
+
+    def recv_bytes(self) -> bytes:
+        return self.wire.recv_bytes()
+
+    def close(self) -> None:
+        self.wire.close()

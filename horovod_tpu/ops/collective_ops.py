@@ -125,7 +125,7 @@ def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None
     if st.topology.size == 1:
         return jnp.asarray(tensor)
     return _controller().allreduce(tensor, average=avg, name=name,
-                                   compression=compression)
+                                   compression=compression, wrap=jnp.asarray)
 
 
 def allreduce_async(tensor, average: Optional[bool] = None,
@@ -144,7 +144,8 @@ def allreduce_async(tensor, average: Optional[bool] = None,
     if st.topology.size == 1:
         return handle_manager.completed(jnp.asarray(tensor))
     return _controller().allreduce_async(tensor, average=avg, name=name,
-                                         compression=compression)
+                                         compression=compression,
+                                         wrap=jnp.asarray)
 
 
 # ---------------------------------------------------------------------------
@@ -166,7 +167,7 @@ def allgather(tensor, name: Optional[str] = None,
     st = basics.state()
     if st.topology.size == 1:
         return jnp.asarray(tensor)
-    return _controller().allgather(tensor, name=name)
+    return _controller().allgather(tensor, name=name, wrap=jnp.asarray)
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> Handle:
@@ -175,7 +176,7 @@ def allgather_async(tensor, name: Optional[str] = None) -> Handle:
     st = basics.state()
     if st.topology.size == 1:
         return handle_manager.completed(jnp.asarray(tensor))
-    return _controller().allgather_async(tensor, name=name)
+    return _controller().allgather_async(tensor, name=name, wrap=jnp.asarray)
 
 
 # ---------------------------------------------------------------------------
@@ -201,7 +202,8 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
         return jnp.asarray(tensor)
-    return _controller().broadcast(tensor, root_rank=root_rank, name=name)
+    return _controller().broadcast(tensor, root_rank=root_rank, name=name,
+                                   wrap=jnp.asarray)
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handle:
@@ -212,7 +214,8 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handl
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
         return handle_manager.completed(jnp.asarray(tensor))
-    return _controller().broadcast_async(tensor, root_rank=root_rank, name=name)
+    return _controller().broadcast_async(tensor, root_rank=root_rank,
+                                         name=name, wrap=jnp.asarray)
 
 
 # ---------------------------------------------------------------------------

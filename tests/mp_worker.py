@@ -1,0 +1,211 @@
+"""Subprocess worker for multi-process eager-tier tests.
+
+Run as: python mp_worker.py <scenario>, with HOROVOD_RANK/SIZE/CONTROLLER_ADDR
+set by the parent (tests/test_multiprocess.py). Equivalent of the reference's
+mpirun-launched test bodies (SURVEY.md §4: "2 MPI ranks on one container").
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.compression import Compression  # noqa: E402
+
+
+def expect(cond, msg):
+    if not cond:
+        raise AssertionError(msg)
+
+
+def scenario_allreduce(rank, size):
+    x = np.arange(8, dtype=np.float32) + rank
+    avg = np.asarray(hvd.allreduce(x, average=True, name="t.avg"))
+    want = np.arange(8, dtype=np.float32) + (size - 1) / 2.0
+    np.testing.assert_allclose(avg, want, rtol=1e-6)
+
+    tot = np.asarray(hvd.allreduce(x, average=False, name="t.sum"))
+    want_sum = size * np.arange(8, dtype=np.float32) + sum(range(size))
+    np.testing.assert_allclose(tot, want_sum, rtol=1e-6)
+
+    xi = (np.arange(6) + rank).astype(np.int32)
+    ti = np.asarray(hvd.allreduce(xi, average=False, name="t.int"))
+    np.testing.assert_array_equal(
+        ti, size * np.arange(6) + sum(range(size)))
+
+    # fp16 wire compression round trip (reference Compression.fp16).
+    xc = np.linspace(-2, 2, 16, dtype=np.float32) * (rank + 1)
+    tc = np.asarray(hvd.allreduce(xc, average=True, name="t.fp16",
+                                  compression=Compression.fp16))
+    scale = sum(r + 1 for r in range(size)) / size
+    np.testing.assert_allclose(tc, np.linspace(-2, 2, 16) * scale, atol=1e-2)
+
+
+def scenario_fusion(rank, size):
+    # Many small tensors in flight at once: the controller packs them into
+    # one fused buffer per dtype (reference "multiple" tests stress fusion).
+    handles = [
+        hvd.allreduce_async((np.ones(32, np.float32) * (i + rank)),
+                            average=False, name=f"fuse.{i}")
+        for i in range(12)
+    ]
+    for i, h in enumerate(handles):
+        out = np.asarray(hvd.synchronize(h))
+        want = np.ones(32) * (size * i + sum(range(size)))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def scenario_allgather(rank, size):
+    # Rank-dependent first dims (reference allgather variable-dim tests).
+    x = np.full((rank + 1, 3), rank, dtype=np.float32)
+    out = np.asarray(hvd.allgather(x, name="gather.var"))
+    want = np.concatenate(
+        [np.full((r + 1, 3), r, dtype=np.float32) for r in range(size)])
+    np.testing.assert_array_equal(out, want)
+
+
+def scenario_broadcast(rank, size):
+    x = np.full(5, rank, dtype=np.float32)
+    out0 = np.asarray(hvd.broadcast(x, root_rank=0, name="bc.0"))
+    np.testing.assert_array_equal(out0, np.zeros(5))
+    out1 = np.asarray(hvd.broadcast(x, root_rank=size - 1, name="bc.last"))
+    np.testing.assert_array_equal(out1, np.full(5, size - 1))
+
+
+def scenario_cache(rank, size):
+    # Same named op repeatedly: after the first negotiation the response
+    # cache's bypass path executes it (reference RunBypass).
+    for it in range(6):
+        x = np.arange(4, dtype=np.float32) * (it + 1) + rank
+        out = np.asarray(hvd.allreduce(x, average=False, name="cached.t"))
+        want = size * np.arange(4, dtype=np.float32) * (it + 1) + sum(range(size))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+    # Shape change for the same name: invalidation + renegotiation.
+    y = np.ones((2, 2), np.float32) * rank
+    out = np.asarray(hvd.allreduce(y, average=False, name="cached.t"))
+    np.testing.assert_allclose(out, np.ones((2, 2)) * sum(range(size)))
+
+
+def scenario_error_mismatch(rank, size):
+    # Reference error-path test: mismatched shapes across ranks must raise
+    # on every rank (test/test_torch.py test_horovod_allreduce_error).
+    x = np.ones(2 + rank, dtype=np.float32)
+    try:
+        hvd.allreduce(x, name="bad.shape")
+    except RuntimeError as exc:
+        expect("Mismatched allreduce tensor shapes" in str(exc),
+               f"wrong error: {exc}")
+    else:
+        raise AssertionError("mismatched shapes did not raise")
+
+    # dtype mismatch
+    x2 = np.ones(4, dtype=np.float32 if rank == 0 else np.float64)
+    try:
+        hvd.allreduce(x2, name="bad.dtype")
+    except RuntimeError as exc:
+        expect("Mismatched data types" in str(exc), f"wrong error: {exc}")
+    else:
+        raise AssertionError("mismatched dtypes did not raise")
+
+    # After errors, the controller must still work.
+    ok = np.asarray(hvd.allreduce(np.ones(3, np.float32), average=False,
+                                  name="good.after"))
+    np.testing.assert_allclose(ok, np.full(3, size))
+
+
+def scenario_duplicate_name(rank, size):
+    h1 = hvd.allreduce_async(np.ones(4, np.float32), name="dup", average=False)
+    h2 = hvd.allreduce_async(np.ones(4, np.float32), name="dup", average=False)
+    # Exactly one of them must fail with the duplicate-name error; the
+    # first completes normally.
+    np.testing.assert_allclose(np.asarray(hvd.synchronize(h1)), 1.0 * size)
+    try:
+        hvd.synchronize(h2)
+    except RuntimeError as exc:
+        expect("Duplicate tensor name" in str(exc), f"wrong error: {exc}")
+    else:
+        raise AssertionError("duplicate name did not raise")
+
+
+def scenario_stall(rank, size):
+    # Reference test/test_stall.py: one rank joins late; the coordinator must
+    # warn (HOROVOD_STALL_CHECK_TIME_SECONDS=1 set by the parent) and the op
+    # must still complete once the straggler arrives.
+    import time as _time
+
+    if rank != 0:
+        _time.sleep(2.5)
+    out = np.asarray(hvd.allreduce(np.ones(2, np.float32), average=False,
+                                   name="stall.t"))
+    np.testing.assert_allclose(out, float(size))
+
+
+def scenario_stall_shutdown(rank, size):
+    # With HOROVOD_STALL_SHUTDOWN_TIME_SECONDS set, a permanent straggler
+    # aborts the job cooperatively (reference operations.cc:757-769).
+    import time as _time
+
+    if rank == 0:
+        h = hvd.allreduce_async(np.ones(2, np.float32), name="never.t")
+        try:
+            hvd.synchronize(h)
+        except RuntimeError as exc:
+            expect("shut down" in str(exc), f"wrong error: {exc}")
+        else:
+            raise AssertionError("expected shutdown error on stalled op")
+    else:
+        _time.sleep(8)  # never participate
+
+
+def scenario_optimizer(rank, size):
+    # End-to-end eager-tier DistributedOptimizer + broadcast_parameters
+    # (reference examples/pytorch_mnist.py pattern).
+    import jax.numpy as jnp
+    import optax
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones(3) * (rank + 1)}  # deliberately inconsistent
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(params["w"]), 1.0)
+
+    state = tx.init(params)
+    grads = {"w": jnp.ones(3) * (rank + 1)}
+    updates, state = tx.update(grads, state, params)
+    want = -0.1 * np.mean([r + 1 for r in range(size)])
+    np.testing.assert_allclose(np.asarray(updates["w"]), want, rtol=1e-6)
+
+
+SCENARIOS = {
+    "optimizer": scenario_optimizer,
+    "stall": scenario_stall,
+    "stall_shutdown": scenario_stall_shutdown,
+    "allreduce": scenario_allreduce,
+    "fusion": scenario_fusion,
+    "allgather": scenario_allgather,
+    "broadcast": scenario_broadcast,
+    "cache": scenario_cache,
+    "error_mismatch": scenario_error_mismatch,
+    "duplicate_name": scenario_duplicate_name,
+}
+
+
+def main():
+    scenario = sys.argv[1]
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    try:
+        SCENARIOS[scenario](rank, size)
+    finally:
+        hvd.shutdown()
+    print(f"worker rank={rank} scenario={scenario}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
