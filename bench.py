@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Headline benchmark: synthetic ResNet-50 data-parallel training throughput.
+
+Mirrors the reference's ``examples/tensorflow_synthetic_benchmark.py`` /
+``examples/pytorch_synthetic_benchmark.py`` (ResNet-50, synthetic ImageNet
+batches, img/sec) running through the framework's hot path:
+``hvd.DistributedOptimizer`` inside a jitted ``shard_map`` over the device
+mesh, bf16 activations.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+vs_baseline anchor: the only absolute throughput figure in the reference repo
+is tf_cnn_benchmarks ResNet-101 at 1656.82 total img/sec on 16 P100s
+(docs/benchmarks.md:28-34) = 103.55 img/sec/GPU. BASELINE.md's rebuild target
+metric is ResNet-50 img/sec/chip, so vs_baseline compares our per-chip
+ResNet-50 throughput against that per-GPU figure (the closest in-repo
+number; ResNet-101 is ~1.7x the FLOPs of ResNet-50 — noted, not hidden).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.md:28-34
+
+BATCH_PER_CHIP = 128
+IMAGE_SIZE = 224
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    hvd.init()
+    n = hvd.local_num_devices()
+    mesh = hvd.parallel.mesh()
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    batch = BATCH_PER_CHIP * n
+    images_host = np.random.RandomState(0).rand(
+        batch, IMAGE_SIZE, IMAGE_SIZE, 3).astype(np.float32)
+    labels_host = np.random.RandomState(1).randint(0, 1000, size=(batch,))
+
+    variables = model.init(rng, jnp.ones((1, IMAGE_SIZE, IMAGE_SIZE, 3)),
+                           train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.1, momentum=0.9), axis_name="data")
+    opt_state = tx.init(params)
+
+    def loss_fn(p, stats, x, y):
+        logits, new_model_state = model.apply(
+            {"params": p, "batch_stats": stats}, x, train=True,
+            mutable=["batch_stats"])
+        one_hot = jax.nn.one_hot(y, 1000)
+        loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        return loss, new_model_state["batch_stats"]
+
+    def train_step(p, stats, opt_state, x, y):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(p, stats, x, y)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), new_stats, opt_state, loss
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    ), donate_argnums=(0, 1, 2))
+
+    x = hvd.parallel.shard_batch(jnp.asarray(images_host), mesh)
+    y = hvd.parallel.shard_batch(jnp.asarray(labels_host), mesh)
+    params = hvd.parallel.replicate(params, mesh)
+    batch_stats = hvd.parallel.replicate(batch_stats, mesh)
+    opt_state = hvd.parallel.replicate(opt_state, mesh)
+
+    for _ in range(WARMUP):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+    # Host fetch as the sync barrier: on the axon-tunneled platform,
+    # block_until_ready can return before execution completes; a device→host
+    # transfer cannot.
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, x, y)
+    float(loss)
+    elapsed = time.perf_counter() - t0
+
+    total_img_sec = batch * ITERS / elapsed
+    per_chip = total_img_sec / n
+    print(json.dumps({
+        "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""Model zoo: the architectures the reference benchmarks with (ResNet family,
+MNIST MLP) plus the rebuild's BERT target (BASELINE.md)."""
+
+from .bert import (  # noqa: F401
+    BERT_BASE,
+    BERT_LARGE,
+    BERT_TINY,
+    BertConfig,
+    BertEncoder,
+    mlm_loss,
+)
+from .mlp import MnistMLP  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+    ResNetTiny,
+)

@@ -1,0 +1,93 @@
+"""ResNet v1.5 family in flax — the framework's flagship vision benchmark
+models.
+
+The reference benchmarks ResNet-50/101 through tf_cnn_benchmarks and ships
+``examples/keras_imagenet_resnet50.py`` / ``examples/pytorch_imagenet_resnet50.py``
+(SURVEY.md §6, ``docs/benchmarks.md:10-34``). This is a from-scratch
+TPU-first implementation, not a port: NHWC layout (XLA's native conv layout
+on TPU), bfloat16 activations with float32 parameters/batch-stats, and large
+fused convolutions that tile cleanly onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut (v1.5: stride
+    on the 3x3)."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5. ``dtype`` is the activation/compute dtype; parameters and
+    batch statistics stay float32 (bf16 activations keep the MXU fed at
+    double rate while fp32 master weights preserve convergence)."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        act = nn.relu
+
+        x = jnp.asarray(x, self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2 ** i,
+                    strides=strides, conv=conv, norm=norm, act=act)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18Sizes = [2, 2, 2, 2]  # (uses bottleneck here; kept for tiny tests)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
+# Tiny variant for hermetic CPU tests / multichip dry runs.
+ResNetTiny = partial(ResNet, stage_sizes=[1, 1], num_filters=8, num_classes=10)
