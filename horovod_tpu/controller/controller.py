@@ -133,7 +133,10 @@ class Controller:
                  root_rank: int = -1,
                  postprocess: Optional[Callable] = None) -> Handle:
         name = self._autoname(kind, name)
-        array = np.ascontiguousarray(array)
+        array = np.asarray(array)
+        if not array.flags.c_contiguous:
+            # ascontiguousarray promotes 0-d to 1-d; preserve the shape.
+            array = np.ascontiguousarray(array).reshape(array.shape)
         req = Request(
             request_rank=self.topo.rank, request_type=request_type,
             tensor_name=name, tensor_dtype=str(array.dtype),

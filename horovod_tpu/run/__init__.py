@@ -1,0 +1,3 @@
+"""Launcher package (``python -m horovod_tpu.run`` / ``bin/horovodrun``)."""
+
+from .launch import main, parse_hosts, run  # noqa: F401

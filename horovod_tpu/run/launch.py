@@ -1,0 +1,209 @@
+"""``horovodrun`` for TPU clusters.
+
+Reference: ``horovod/run/run.py`` (489 lines) — parses ``-np``/``-H``, does an
+ssh preflight, discovers routable NICs via driver/task TCP services, then
+execs ``mpirun`` which fans out ranks via orted. On TPU none of the MPI
+machinery exists; the launcher's jobs reduce to:
+
+  1. mint a per-job HMAC secret and pick the coordinator address,
+  2. start one process per rank with the topology exported in env
+     (``HOROVOD_RANK/SIZE/LOCAL_RANK/LOCAL_SIZE/CONTROLLER_ADDR/SECRET_KEY``),
+  3. stream rank-prefixed output, propagate failures, kill stragglers.
+
+Local ranks are direct children; remote hosts (``-H host:slots``) fan out
+over ssh with the env inlined (the reference's ``-x VAR`` passthrough,
+``run/run.py:462-480``). On a TPU pod slice you typically run one process
+per host and let the SPMD tier drive all local chips; ``--bind-chips``
+instead partitions the host's chips among local ranks via
+``TPU_VISIBLE_DEVICES`` (one-chip-per-process, the reference's
+one-GPU-per-rank model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common.wire import make_secret
+
+
+def parse_hosts(hosts: Optional[str], np_: int) -> List[Tuple[str, int]]:
+    """Parse ``-H host1:2,host2:2`` (reference ``run/run.py:285-342``)."""
+    if not hosts:
+        return [("localhost", np_)]
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, slots = part.partition(":")
+        out.append((host, int(slots) if slots else 1))
+    total = sum(s for _, s in out)
+    if total < np_:
+        raise ValueError(
+            f"-np {np_} exceeds total slots {total} in -H {hosts!r}")
+    return out
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _is_local(host: str) -> bool:
+    return host in ("localhost", "127.0.0.1", socket.gethostname())
+
+
+def build_rank_env(base: Dict[str, str], rank: int, size: int,
+                   local_rank: int, local_size: int, cross_rank: int,
+                   cross_size: int, controller_addr: str, secret: str,
+                   bind_chips: bool) -> Dict[str, str]:
+    env = dict(base)
+    env.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_CROSS_RANK": str(cross_rank),
+        "HOROVOD_CROSS_SIZE": str(cross_size),
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_SECRET_KEY": secret,
+    })
+    if bind_chips:
+        env["TPU_VISIBLE_DEVICES"] = str(local_rank)
+        env["TPU_PROCESS_BOUNDS"] = f"1,1,1"
+    return env
+
+
+def _stream(prefix: str, pipe, out) -> None:
+    for line in iter(pipe.readline, ""):
+        out.write(f"{prefix}{line}")
+        out.flush()
+    pipe.close()
+
+
+def run(args: argparse.Namespace) -> int:
+    hosts = parse_hosts(args.hosts, args.np)
+    size = args.np
+    secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
+    coord_host = hosts[0][0]
+    coord_addr = (args.controller_addr
+                  or f"{'127.0.0.1' if _is_local(coord_host) else coord_host}"
+                     f":{_free_port()}")
+
+    assignments = []  # (rank, host, local_rank, local_size, cross_rank)
+    rank = 0
+    for cross_rank, (host, slots) in enumerate(hosts):
+        local = min(slots, size - rank)
+        for lr in range(local):
+            assignments.append((rank, host, lr, local, cross_rank))
+            rank += 1
+        if rank >= size:
+            break
+
+    procs: List[subprocess.Popen] = []
+    threads = []
+    failed = threading.Event()
+
+    def spawn(rank, host, local_rank, local_size, cross_rank):
+        env = build_rank_env(
+            dict(os.environ), rank, size, local_rank, local_size,
+            cross_rank, len(hosts), coord_addr, secret, args.bind_chips)
+        if _is_local(host):
+            cmd = args.command
+        else:
+            # ssh fan-out with env inlined (reference run/run.py:462-485 via
+            # mpirun -x; no orted here — ranks connect straight back to the
+            # coordinator's TCP service).
+            exports = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in env.items()
+                if k.startswith(("HOROVOD_", "TPU_", "JAX_", "PYTHONPATH")))
+            remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+                " ".join(shlex.quote(c) for c in args.command)
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+            env = dict(os.environ)
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, bufsize=1)
+        procs.append(proc)
+        t = threading.Thread(
+            target=_stream, args=(f"[{rank}]: " if size > 1 else "",
+                                  proc.stdout, sys.stdout), daemon=True)
+        t.start()
+        threads.append(t)
+
+    for a in assignments:
+        spawn(*a)
+
+    def _terminate_all(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _terminate_all)
+    signal.signal(signal.SIGTERM, _terminate_all)
+
+    exit_code = 0
+    try:
+        pending = list(enumerate(procs))
+        while pending:
+            for i, p in list(pending):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                pending.remove((i, p))
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    sys.stderr.write(
+                        f"horovodrun: rank {i} exited with code {rc}; "
+                        "terminating remaining ranks\n")
+                    failed.set()
+                    _terminate_all()
+            if pending:
+                time.sleep(0.05)
+    finally:
+        _terminate_all()
+        for t in threads:
+            t.join(timeout=2.0)
+    return exit_code
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="horovodrun",
+        description="Launch a horovod_tpu job (TPU-native horovodrun: no "
+                    "mpirun, no ssh preflight for local jobs).")
+    parser.add_argument("-np", "--num-proc", dest="np", type=int, required=True,
+                        help="total number of processes (ranks)")
+    parser.add_argument("-H", "--hosts", default=None,
+                        help="host1:slots,host2:slots (default: all local)")
+    parser.add_argument("--controller-addr", default=None,
+                        help="coordinator bind address host:port "
+                             "(default: auto on rank-0 host)")
+    parser.add_argument("--bind-chips", action="store_true",
+                        help="partition local TPU chips among local ranks via "
+                             "TPU_VISIBLE_DEVICES (one-chip-per-rank model)")
+    parser.add_argument("--verbose", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="training command")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

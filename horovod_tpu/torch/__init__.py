@@ -1,0 +1,202 @@
+"""PyTorch user API: ``import horovod_tpu.torch as hvd``.
+
+Reference: ``horovod/torch/__init__.py`` (348 lines). Full surface parity —
+``DistributedOptimizer`` with per-parameter gradient hooks,
+``broadcast_parameters``, ``broadcast_optimizer_state``, the op set from
+``.mpi_ops`` — with the data plane on the TCP controller (torch tensors are
+host tensors on a TPU system; device-side training belongs to the JAX tier).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Optional, Tuple, Union
+
+import torch
+
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from .compression import Compression  # noqa: F401
+from .mpi_ops import (  # noqa: F401
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    poll,
+    synchronize,
+)
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Fires ``allreduce_async_`` per parameter as soon as its gradient is
+    accumulated, then joins the handles in ``step()`` — the reference's hook
+    architecture (``torch/__init__.py:95-151``) on
+    ``register_post_accumulate_grad_hook`` instead of the AccumulateGrad
+    indirection (``p.expand_as(p).grad_fn.next_functions``) that predates it.
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.param_group_{gi}.param_{pi}", p)
+                for gi, group in enumerate(self.param_groups)
+                for pi, p in enumerate(group["params"])
+            ]
+        all_params = {
+            id(p) for group in self.param_groups for p in group["params"]}
+        dups = _find_duplicates([name for name, _ in named_parameters])
+        if dups:
+            raise ValueError(
+                f"named_parameters contains duplicate names: {sorted(dups)}")
+        named_ids = {id(p) for _, p in named_parameters}
+        if len(named_parameters) != len(all_params & named_ids):
+            raise ValueError(
+                "named_parameters must cover exactly the parameters passed "
+                "to the optimizer (reference torch/__init__.py:58-68)")
+
+        self._parameter_names = {id(p): name for name, p in named_parameters}
+        self._handles = {}
+        self._grad_accs = []
+        self._backward_count = collections.defaultdict(int)
+        if size() > 1:
+            self._register_hooks()
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    self._grad_accs.append(
+                        p.register_post_accumulate_grad_hook(self._make_hook()))
+
+    def _make_hook(self):
+        def hook(p):
+            self._backward_count[id(p)] += 1
+            if self._backward_count[id(p)] % self.backward_passes_per_step == 0:
+                name = self._parameter_names.get(id(p))
+                tensor = p.grad
+                tensor_compressed, ctx = self._compression.compress(tensor)
+                handle = allreduce_async_(tensor_compressed, average=True,
+                                          name=name)
+                self._handles[p] = (handle, ctx, tensor_compressed)
+        return hook
+
+    def synchronize(self):
+        """Join all in-flight gradient reductions
+        (reference ``torch/__init__.py:132-151``)."""
+        for p, (handle, ctx, compressed) in list(self._handles.items()):
+            synchronize(handle)
+            if ctx is not None or compressed is not p.grad:
+                with torch.no_grad():
+                    p.grad.copy_(self._compression.decompress(compressed, ctx))
+        self._handles.clear()
+
+    def step(self, closure=None):
+        if size() > 1:
+            self.synchronize()
+        return super(self.__class__, self).step(closure)
+
+
+def _find_duplicates(names):
+    seen, dups = set(), set()
+    for n in names:
+        if n in seen:
+            dups.add(n)
+        seen.add(n)
+    return dups
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1):
+    """Wrap a torch optimizer with cross-rank gradient averaging (reference
+    ``hvd.DistributedOptimizer``, ``torch/__init__.py:154-175``): dynamically
+    subclasses the optimizer's own class so user code keeps its API."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a ``state_dict`` or iterable of
+    ``(name, tensor)`` (reference ``torch/__init__.py:178-230``)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    handles = []
+    for name, p in items:
+        if p is None:
+            continue
+        handles.append(broadcast_async_(p, root_rank, name=f"broadcast.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state from root so every rank resumes identically
+    (reference ``torch/__init__.py:232-348``, including the
+    materialize-state-by-zero-grad-step trick and scalar wrapping)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+
+    state_dict = optimizer.state_dict()
+    if not state_dict["state"]:
+        # Uninitialized state on non-root ranks: materialize it with a
+        # zero-gradient step (reference torch/__init__.py:246-258).
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = p.data.new_zeros(p.size())
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    tensors = {}
+    scalars = {}
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            name = f"optimizer.{pid}.{key}"
+            if torch.is_tensor(value):
+                tensors[name] = (pstate, key, value)
+            else:
+                scalars[name] = (pstate, key, value)
+
+    handles = [broadcast_async_(t, root_rank, name=name)
+               for name, (_, _, t) in sorted(tensors.items())]
+    for h in handles:
+        synchronize(h)
+
+    # Scalars (e.g. `step` counts) travel as tensors and are written back in
+    # their original Python type (reference's callback dance,
+    # torch/__init__.py:294-343).
+    for name, (pstate, key, value) in sorted(scalars.items()):
+        t = torch.tensor(float(value), dtype=torch.float64)
+        t = broadcast(t, root_rank, name=name)
+        pstate[key] = type(value)(t.item())
+
+    optimizer.load_state_dict(state_dict)

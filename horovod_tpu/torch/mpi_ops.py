@@ -1,0 +1,206 @@
+"""PyTorch collective ops with autograd support.
+
+Reference: ``horovod/torch/mpi_ops.py`` (438 lines) + the pybind layer
+``torch/mpi_ops_v2.cc`` it wraps. Same public surface — sync, async and
+in-place variants, ``synchronize``/``poll`` handle resolution, autograd
+``Function``s with the correct backward — but the enqueue lands on the TCP
+controller (host data plane) instead of ``EnqueueTensorAllreduce``; on TPU,
+torch tensors are host-side objects, so this *is* their native path (device
+math belongs to the JAX tier).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import torch
+
+from ..common import basics
+from ..common.handles import Handle, HandleManager
+
+handle_manager = HandleManager()
+
+
+def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy()
+
+
+def _controller():
+    st = basics.state()
+    if st.controller is None:
+        raise RuntimeError(
+            "eager collectives at size > 1 require the background controller; "
+            "launch through horovodrun")
+    return st.controller
+
+
+def _size() -> int:
+    return basics.state().topology.size
+
+
+# ---------------------------------------------------------------------------
+# raw async ops (no autograd), reference torch/mpi_ops.py:124-332
+
+
+def allreduce_async(tensor: torch.Tensor, average: bool = True,
+                    name: Optional[str] = None) -> Handle:
+    if _size() == 1:
+        return handle_manager.completed(tensor.clone())
+    return _controller().allreduce_async(
+        _to_numpy(tensor), average=average, name=name,
+        wrap=lambda a: torch.from_numpy(np.ascontiguousarray(a)).to(
+            tensor.dtype).reshape(a.shape))
+
+
+def allreduce_async_(tensor: torch.Tensor, average: bool = True,
+                     name: Optional[str] = None) -> Handle:
+    """In-place: the result is copied back into ``tensor`` on completion
+    (reference ``allreduce_async_``, torch/mpi_ops.py:156-176)."""
+    if _size() == 1:
+        return handle_manager.completed(tensor)
+
+    def wrap(a: np.ndarray, _t=tensor):
+        with torch.no_grad():
+            _t.copy_(torch.from_numpy(np.ascontiguousarray(a)).to(
+                _t.dtype).reshape(_t.shape))
+        return _t
+
+    return _controller().allreduce_async(
+        _to_numpy(tensor), average=average, name=name, wrap=wrap)
+
+
+def allgather_async(tensor: torch.Tensor,
+                    name: Optional[str] = None) -> Handle:
+    if _size() == 1:
+        return handle_manager.completed(tensor.clone())
+    return _controller().allgather_async(
+        _to_numpy(tensor), name=name,
+        wrap=lambda a: torch.from_numpy(np.ascontiguousarray(a)).to(
+            tensor.dtype).reshape(a.shape))
+
+
+def broadcast_async(tensor: torch.Tensor, root_rank: int,
+                    name: Optional[str] = None) -> Handle:
+    if _size() == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return handle_manager.completed(tensor.clone())
+    return _controller().broadcast_async(
+        _to_numpy(tensor), root_rank=root_rank, name=name,
+        wrap=lambda a: torch.from_numpy(np.ascontiguousarray(a)).to(
+            tensor.dtype).reshape(a.shape))
+
+
+def broadcast_async_(tensor: torch.Tensor, root_rank: int,
+                     name: Optional[str] = None) -> Handle:
+    if _size() == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return handle_manager.completed(tensor)
+
+    def wrap(a: np.ndarray, _t=tensor):
+        with torch.no_grad():
+            _t.copy_(torch.from_numpy(np.ascontiguousarray(a)).to(
+                _t.dtype).reshape(_t.shape))
+        return _t
+
+    return _controller().broadcast_async(
+        _to_numpy(tensor), root_rank=root_rank, name=name, wrap=wrap)
+
+
+def synchronize(handle: Handle):
+    """Join an async op (reference ``synchronize``, torch/mpi_ops.py:422-433)."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    return handle.done()
+
+
+# ---------------------------------------------------------------------------
+# autograd-aware sync ops (reference torch/mpi_ops.py:89-332)
+
+
+class _AllreduceFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, average, name):
+        ctx.average = average
+        return synchronize(allreduce_async(tensor, average, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Gradient of a sum/mean over ranks is the same reduction of the
+        # upstream gradient (reference torch/mpi_ops.py:110-122).
+        return synchronize(
+            allreduce_async(grad_output, ctx.average, None)), None, None
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: Optional[str] = None, compression=None) -> torch.Tensor:
+    if compression is not None:
+        compressed, cctx = compression.compress(tensor)
+        out = _AllreduceFunction.apply(compressed, average, name)
+        return compression.decompress(out, cctx)
+    return _AllreduceFunction.apply(tensor, average, name)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name))
+
+
+class _AllgatherFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0]
+        result = synchronize(allgather_async(tensor, name))
+        # Ranks may contribute different dim-0 sizes (reference supports
+        # variable first dims); gather them so backward can locate this
+        # rank's segment. One extra tiny collective, unconditional on every
+        # rank so the schedules stay aligned.
+        if _size() > 1:
+            sizes = synchronize(
+                allgather_async(torch.tensor([tensor.shape[0]])))
+            rank = basics.state().topology.rank
+            ctx.offset = int(sizes[:rank].sum())
+        else:
+            ctx.offset = 0
+        return result
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Reference backward (torch/mpi_ops.py:236-254): allreduce(sum) the
+        # gathered gradient, then slice out this rank's segment.
+        grad = synchronize(allreduce_async(grad_output, average=False))
+        return grad[ctx.offset:ctx.offset + ctx.dim0], None
+
+
+def allgather(tensor: torch.Tensor, name: Optional[str] = None) -> torch.Tensor:
+    return _AllgatherFunction.apply(tensor, name)
+
+
+class _BroadcastFunction(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        # Reference (torch/mpi_ops.py:318-332): reduce gradients to the root;
+        # non-root inputs get zero gradient.
+        grad = synchronize(allreduce_async(grad_output, average=False))
+        if basics.state().topology.rank != ctx.root_rank:
+            grad = grad * 0
+        return grad, None, None
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: Optional[str] = None) -> torch.Tensor:
+    return _BroadcastFunction.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: Optional[str] = None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))

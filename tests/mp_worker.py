@@ -164,6 +164,61 @@ def scenario_stall_shutdown(rank, size):
         _time.sleep(8)  # never participate
 
 
+def scenario_torch(rank, size):
+    # Reference test/test_torch.py core semantics across real ranks.
+    import torch
+
+    import horovod_tpu.torch as thvd
+
+    x = torch.arange(8, dtype=torch.float32) + rank
+    avg = thvd.allreduce(x, average=True, name="tt.avg")
+    np.testing.assert_allclose(
+        avg.numpy(), np.arange(8) + (size - 1) / 2, rtol=1e-6)
+
+    y = x.clone()
+    thvd.allreduce_(y, average=False, name="tt.sum")
+    np.testing.assert_allclose(
+        y.numpy(), size * np.arange(8) + sum(range(size)), rtol=1e-6)
+
+    # Variable-dim allgather with autograd through it.
+    g_in = torch.full((rank + 1, 2), float(rank), requires_grad=True)
+    gathered = thvd.allgather(g_in, name="tt.gather")
+    want = np.concatenate([np.full((r + 1, 2), r) for r in range(size)])
+    np.testing.assert_array_equal(gathered.detach().numpy(), want)
+    gathered.sum().backward()
+    # d(sum of gathered)/d(own shard) summed over ranks = size.
+    np.testing.assert_allclose(g_in.grad.numpy(),
+                               np.full((rank + 1, 2), float(size)))
+
+    bc = thvd.broadcast(x, root_rank=size - 1, name="tt.bc")
+    np.testing.assert_allclose(bc.numpy(), np.arange(8) + size - 1)
+
+    # DistributedOptimizer: averaged gradient step matches manual math.
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = torch.optim.SGD(model.parameters(), lr=1.0)
+    opt = thvd.DistributedOptimizer(
+        opt, named_parameters=model.named_parameters())
+    inp = torch.ones(1, 2) * (rank + 1)
+    model(inp).sum().backward()
+    opt.step()
+    mean_grad = np.mean([r + 1 for r in range(size)])
+    np.testing.assert_allclose(
+        model.weight.detach().numpy(), 1.0 - mean_grad, rtol=1e-6)
+
+    # broadcast_parameters / broadcast_optimizer_state consistency.
+    model2 = torch.nn.Linear(2, 2)
+    with torch.no_grad():
+        for p in model2.parameters():
+            p.fill_(float(rank + 7))
+    thvd.broadcast_parameters(model2.state_dict(), root_rank=0)
+    for p in model2.parameters():
+        np.testing.assert_allclose(p.detach().numpy(), 7.0)
+    opt2 = torch.optim.Adam(model2.parameters(), lr=0.01)
+    thvd.broadcast_optimizer_state(opt2, root_rank=0)
+
+
 def scenario_optimizer(rank, size):
     # End-to-end eager-tier DistributedOptimizer + broadcast_parameters
     # (reference examples/pytorch_mnist.py pattern).
@@ -183,6 +238,7 @@ def scenario_optimizer(rank, size):
 
 
 SCENARIOS = {
+    "torch": scenario_torch,
     "optimizer": scenario_optimizer,
     "stall": scenario_stall,
     "stall_shutdown": scenario_stall_shutdown,

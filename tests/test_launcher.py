@@ -1,0 +1,53 @@
+"""Launcher end-to-end: the reference CI smoke-runs `horovodrun -np 2`
+(.buildkite/gen-pipeline.sh:101-133); same here via `python -m horovod_tpu.run`."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPT = (
+    "import os; os.environ.setdefault('JAX_PLATFORMS','cpu');"
+    "import jax; jax.config.update('jax_platforms','cpu');"
+    "import numpy as np; import horovod_tpu as hvd; hvd.init();"
+    "out = np.asarray(hvd.allreduce(np.ones(4,np.float32)*(hvd.rank()+1),"
+    "average=True, name='launch.t'));"
+    "expected = np.mean([r+1 for r in range(hvd.size())]);"
+    "assert np.allclose(out, expected), out;"
+    "print(f'rank {hvd.rank()} of {hvd.size()} ok'); hvd.shutdown()"
+)
+
+
+def _run_launcher(args, timeout=180):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run"] + args,
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def test_launch_np2():
+    res = _run_launcher(["-np", "2", sys.executable, "-c", SCRIPT])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "[0]: rank 0 of 2 ok" in res.stdout
+    assert "[1]: rank 1 of 2 ok" in res.stdout
+
+
+def test_launch_failure_propagates():
+    res = _run_launcher(
+        ["-np", "2", sys.executable, "-c", "import sys; sys.exit(3)"])
+    assert res.returncode == 3
+
+
+def test_parse_hosts():
+    from horovod_tpu.run import parse_hosts
+
+    assert parse_hosts("a:2,b:2", 4) == [("a", 2), ("b", 2)]
+    assert parse_hosts(None, 3) == [("localhost", 3)]
+    with pytest.raises(ValueError, match="exceeds total slots"):
+        parse_hosts("a:1", 2)
