@@ -99,6 +99,20 @@ class Controller:
         self._closed = threading.Event()
         self._stall_warned: Dict[str, float] = {}
 
+        # Native ring data plane (C++ core): enabled when the launcher
+        # exported per-rank ring addresses and HOROVOD_CPU_OPS != "star".
+        # Init failure is fatal, not a fallback: path selection must be
+        # identical on every rank or the lockstep data phases deadlock.
+        self._ring = None
+        ring_addrs = os.environ.get("HOROVOD_RING_ADDRS")
+        if (topology.size > 1 and ring_addrs
+                and os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"):
+            from ..common.wire import job_secret
+            from ..core.bindings import RingBackend
+
+            self._ring = RingBackend(topology.rank, topology.size,
+                                     ring_addrs, job_secret())
+
         addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
         if topology.rank == 0:
             self._service = CoordinatorService(addr, topology.size)
@@ -248,6 +262,8 @@ class Controller:
             self._fail_all(exc)
         finally:
             self._closed.set()
+            if self._ring is not None:
+                self._ring.shutdown()
             if self._service:
                 self._service.close()
             if self._client:
@@ -506,7 +522,11 @@ class Controller:
         if self.timeline:
             self.timeline.activity_end(tname)
             self.timeline.activity_start(tname, tl.TCP_COLLECTIVE)
-        if self.topo.rank == 0:
+        if self._use_ring(dtype):
+            # Native C++ ring (bandwidth-optimal; reduce-scatter + allgather).
+            result = np.array(buf, copy=True)
+            self._ring.allreduce_(result, average=False)
+        elif self.topo.rank == 0:
             acc = buf.astype(buf.dtype, copy=True)
             for rank in range(1, self.topo.size):
                 peer = np.frombuffer(
@@ -531,10 +551,24 @@ class Controller:
         if self.timeline:
             self.timeline.activity_end(tname)
 
+    def _use_ring(self, dtype) -> bool:
+        """Path selection must be deterministic across ranks: depends only on
+        global ring availability (all-or-nothing at init) and the negotiated
+        dtype (identical on every rank by validation)."""
+        from ..core.bindings import RingBackend
+
+        return (self._ring is not None
+                and RingBackend.dtype_code(dtype) is not None)
+
     def _execute_allgather(self, entry: _Pending, response: Response) -> None:
         dtype = entry.array.dtype
         rest = entry.array.shape[1:]
-        if self.topo.rank == 0:
+        if self._use_ring(dtype):
+            rest_elems = int(np.prod(rest, dtype=np.int64)) if rest else 1
+            counts = [s * rest_elems for s in response.tensor_sizes]
+            flat = self._ring.allgather(entry.array.ravel(), counts)
+            full = flat.reshape((sum(response.tensor_sizes),) + rest)
+        elif self.topo.rank == 0:
             parts = {0: entry.array}
             for rank in range(1, self.topo.size):
                 raw = np.frombuffer(
@@ -552,6 +586,11 @@ class Controller:
 
     def _execute_broadcast(self, entry: _Pending) -> None:
         root = entry.request.root_rank
+        if self._use_ring(entry.array.dtype):
+            result = np.array(entry.array, copy=True)
+            self._ring.broadcast_(result, root)
+            self._finish(entry, result)
+            return
         if self.topo.rank == 0:
             if root == 0:
                 data = entry.array

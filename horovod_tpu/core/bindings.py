@@ -1,0 +1,171 @@
+"""Build + ctypes bindings for the native core.
+
+The reference ships per-framework shared libraries built by a 1000-line
+feature-probing ``setup.py`` and loads them through ctypes
+(``horovod/common/basics.py:20-28``). Here the native core is dependency-free
+C++ compiled on first use with g++ (cached by source mtime); ctypes loads the
+same C ABI shape.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..common import hvd_logging as logging
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libhvdcore.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed: Optional[str] = None
+
+# Must match enum DType in ring.cc.
+_DTYPE_CODES = {
+    "float32": 0,
+    "float64": 1,
+    "int32": 2,
+    "int64": 3,
+    "uint8": 4,
+    "float16": 5,
+    "bfloat16": 6,
+}
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for fname in os.listdir(_SRC_DIR):
+        if os.path.getmtime(os.path.join(_SRC_DIR, fname)) > lib_mtime:
+            return True
+    return False
+
+
+def build() -> str:
+    """Compile the native core (idempotent, mtime-cached)."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if _needs_build():
+        cmd = [
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+            os.path.join(_SRC_DIR, "ring.cc"),
+            "-o", _LIB_PATH,
+        ]
+        logging.debug("building native core: %s", " ".join(cmd))
+        result = subprocess.run(cmd, capture_output=True, text=True)
+        if result.returncode != 0:
+            raise RuntimeError(
+                f"native core build failed:\n{result.stderr}")
+    return _LIB_PATH
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed); returns None if the toolchain is absent,
+    letting callers fall back to the pure-Python star data plane."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed is not None:
+            return None
+        try:
+            path = build()
+        except (RuntimeError, FileNotFoundError) as exc:
+            _build_failed = str(exc)
+            logging.warning(
+                "native core unavailable (%s); using Python data plane",
+                exc)
+            return None
+        lib = ctypes.CDLL(path)
+        lib.hvd_ring_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvd_ring_init.restype = ctypes.c_int
+        lib.hvd_ring_allreduce.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_int]
+        lib.hvd_ring_allreduce.restype = ctypes.c_int
+        lib.hvd_ring_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_long), ctypes.c_void_p,
+            ctypes.c_int]
+        lib.hvd_ring_allgather.restype = ctypes.c_int
+        lib.hvd_ring_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_int]
+        lib.hvd_ring_broadcast.restype = ctypes.c_int
+        lib.hvd_ring_last_error.restype = ctypes.c_char_p
+        lib.hvd_ring_shutdown.restype = None
+        _lib = lib
+        return _lib
+
+
+class RingBackend:
+    """Thin numpy-facing wrapper over the C ABI. One instance per process,
+    owned by the controller's background thread (single-threaded by
+    contract, like the reference's background-thread-owns-MPI design)."""
+
+    def __init__(self, rank: int, size: int, addrs: str, secret: bytes):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native core unavailable: {_build_failed}")
+        self._lib = lib
+        key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
+        rc = lib.hvd_ring_init(rank, size, addrs.encode(), key, len(secret))
+        if rc != 0:
+            raise RuntimeError(
+                f"hvd_ring_init failed: {self._last_error()}")
+        self._open = True
+
+    def _last_error(self) -> str:
+        return self._lib.hvd_ring_last_error().decode(errors="replace")
+
+    @staticmethod
+    def dtype_code(dtype) -> Optional[int]:
+        return _DTYPE_CODES.get(str(dtype))
+
+    def allreduce_(self, array: np.ndarray, average: bool) -> np.ndarray:
+        """In-place sum (or mean) across ranks."""
+        code = self.dtype_code(array.dtype)
+        assert code is not None, f"unsupported dtype {array.dtype}"
+        assert array.flags.c_contiguous
+        rc = self._lib.hvd_ring_allreduce(
+            array.ctypes.data_as(ctypes.c_void_p), array.size, code,
+            1 if average else 0)
+        if rc != 0:
+            raise RuntimeError(f"ring allreduce failed: {self._last_error()}")
+        return array
+
+    def allgather(self, array: np.ndarray, counts) -> np.ndarray:
+        """Concatenate per-rank blocks (element counts per rank in
+        ``counts``) along a flat axis; caller reshapes."""
+        code = self.dtype_code(array.dtype)
+        assert code is not None, f"unsupported dtype {array.dtype}"
+        assert array.flags.c_contiguous
+        counts_arr = (ctypes.c_long * len(counts))(*counts)
+        out = np.empty(int(sum(counts)), dtype=array.dtype)
+        rc = self._lib.hvd_ring_allgather(
+            array.ctypes.data_as(ctypes.c_void_p), counts_arr,
+            out.ctypes.data_as(ctypes.c_void_p), code)
+        if rc != 0:
+            raise RuntimeError(f"ring allgather failed: {self._last_error()}")
+        return out
+
+    def broadcast_(self, array: np.ndarray, root: int) -> np.ndarray:
+        code = self.dtype_code(array.dtype)
+        assert code is not None, f"unsupported dtype {array.dtype}"
+        assert array.flags.c_contiguous
+        rc = self._lib.hvd_ring_broadcast(
+            array.ctypes.data_as(ctypes.c_void_p), array.size, code, root)
+        if rc != 0:
+            raise RuntimeError(f"ring broadcast failed: {self._last_error()}")
+        return array
+
+    def shutdown(self) -> None:
+        if getattr(self, "_open", False):
+            self._lib.hvd_ring_shutdown()
+            self._open = False

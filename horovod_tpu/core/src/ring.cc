@@ -1,0 +1,555 @@
+// Native TCP ring collectives for host tensors — the data-plane replacement
+// for the reference's MPI CPU ops (horovod/common/ops/mpi_operations.cc):
+// bandwidth-optimal ring allreduce (reduce-scatter + allgather phases, the
+// same algorithm the reference gets from MPI/NCCL underneath), ring
+// allgather with per-rank counts (MPI_Allgatherv equivalent,
+// mpi_operations.cc:95-173), and ring broadcast (mpi_operations.cc:334-358).
+//
+// Exposed as a C ABI consumed over ctypes (the reference exposes its C ABI
+// the same way, horovod/common/operations.cc:1595-1650 + common/basics.py).
+// Single-threaded by contract: only the controller background thread calls
+// in, mirroring the reference's one-background-thread-owns-MPI design
+// (SURVEY.md §5 "Race detection").
+//
+// Connections are authenticated with HMAC-SHA256 over the per-job secret
+// (sha256.h), so a stray connection to a ring port cannot inject data.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "sha256.h"
+
+namespace {
+
+std::string g_error;
+int g_rank = -1, g_size = 0;
+int g_left_fd = -1;   // recv from left neighbor
+int g_right_fd = -1;  // send to right neighbor
+int g_listen_fd = -1;
+
+void set_error(const std::string& msg) { g_error = msg; }
+
+enum DType {
+  DT_F32 = 0,
+  DT_F64 = 1,
+  DT_I32 = 2,
+  DT_I64 = 3,
+  DT_U8 = 4,
+  DT_F16 = 5,
+  DT_BF16 = 6,
+};
+
+size_t dtype_size(int dt) {
+  switch (dt) {
+    case DT_F32: case DT_I32: return 4;
+    case DT_F64: case DT_I64: return 8;
+    case DT_U8: return 1;
+    case DT_F16: case DT_BF16: return 2;
+  }
+  return 0;
+}
+
+// --- half-precision conversions (scalar; reference uses F16C intrinsics
+// with a scalar fallback, common/half.cc:28-78) -----------------------------
+
+float f16_to_f32(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400)) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ff;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000 | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t f32_to_f16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint16_t sign = (uint16_t)((bits >> 16) & 0x8000);
+  int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffff;
+  if (exp >= 31) return sign | 0x7c00;  // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return sign;
+    mant |= 0x800000;
+    uint32_t shift = (uint32_t)(14 - exp);
+    return sign | (uint16_t)(mant >> shift);
+  }
+  return sign | (uint16_t)(exp << 10) | (uint16_t)(mant >> 13);
+}
+
+float bf16_to_f32(uint16_t h) {
+  uint32_t bits = (uint32_t)h << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even on the dropped 16 bits
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return (uint16_t)((bits + rounding) >> 16);
+}
+
+void accumulate(void* dst, const void* src, long count, int dt) {
+  switch (dt) {
+    case DT_F32: {
+      float* d = (float*)dst;
+      const float* s = (const float*)src;
+      for (long i = 0; i < count; i++) d[i] += s[i];
+      break;
+    }
+    case DT_F64: {
+      double* d = (double*)dst;
+      const double* s = (const double*)src;
+      for (long i = 0; i < count; i++) d[i] += s[i];
+      break;
+    }
+    case DT_I32: {
+      int32_t* d = (int32_t*)dst;
+      const int32_t* s = (const int32_t*)src;
+      for (long i = 0; i < count; i++) d[i] += s[i];
+      break;
+    }
+    case DT_I64: {
+      int64_t* d = (int64_t*)dst;
+      const int64_t* s = (const int64_t*)src;
+      for (long i = 0; i < count; i++) d[i] += s[i];
+      break;
+    }
+    case DT_U8: {
+      uint8_t* d = (uint8_t*)dst;
+      const uint8_t* s = (const uint8_t*)src;
+      for (long i = 0; i < count; i++) d[i] = (uint8_t)(d[i] + s[i]);
+      break;
+    }
+    case DT_F16: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      for (long i = 0; i < count; i++)
+        d[i] = f32_to_f16(f16_to_f32(d[i]) + f16_to_f32(s[i]));
+      break;
+    }
+    case DT_BF16: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      for (long i = 0; i < count; i++)
+        d[i] = f32_to_bf16(bf16_to_f32(d[i]) + bf16_to_f32(s[i]));
+      break;
+    }
+  }
+}
+
+void scale(void* buf, long count, int dt, double factor) {
+  switch (dt) {
+    case DT_F32: {
+      float* d = (float*)buf;
+      for (long i = 0; i < count; i++) d[i] = (float)(d[i] * factor);
+      break;
+    }
+    case DT_F64: {
+      double* d = (double*)buf;
+      for (long i = 0; i < count; i++) d[i] *= factor;
+      break;
+    }
+    case DT_F16: {
+      uint16_t* d = (uint16_t*)buf;
+      for (long i = 0; i < count; i++)
+        d[i] = f32_to_f16((float)(f16_to_f32(d[i]) * factor));
+      break;
+    }
+    case DT_BF16: {
+      uint16_t* d = (uint16_t*)buf;
+      for (long i = 0; i < count; i++)
+        d[i] = f32_to_bf16((float)(bf16_to_f32(d[i]) * factor));
+      break;
+    }
+    default:
+      break;  // integer average is not defined; caller avoids it
+  }
+}
+
+// --- socket helpers --------------------------------------------------------
+
+bool wait_fd(int fd, short events) {
+  struct pollfd pfd{fd, events, 0};
+  int rc = poll(&pfd, 1, 60000);
+  if (rc <= 0) {
+    set_error(rc == 0 ? "socket wait timed out (60s)"
+                      : std::string("poll: ") + strerror(errno));
+    return false;
+  }
+  return true;
+}
+
+// Work on both blocking (handshake) and non-blocking (data phase) fds.
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n > 0) {
+    ssize_t k = send(fd, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        if (!wait_fd(fd, POLLOUT)) return false;
+        continue;
+      }
+      set_error(std::string("send: ") + strerror(errno));
+      return false;
+    }
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n > 0) {
+    ssize_t k = recv(fd, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        if (!wait_fd(fd, POLLIN)) return false;
+        continue;
+      }
+      set_error(std::string("recv: ") + strerror(errno));
+      return false;
+    }
+    if (k == 0) {
+      set_error("recv: peer closed");
+      return false;
+    }
+    p += k;
+    n -= (size_t)k;
+  }
+  return true;
+}
+
+// Full-duplex exchange: send `sn` bytes right while receiving `rn` bytes from
+// left. Poll-driven so large segments can't deadlock on filled socket
+// buffers (both neighbors send simultaneously each ring step).
+bool exchange(const void* sbuf, size_t sn, void* rbuf, size_t rn) {
+  size_t soff = 0, roff = 0;
+  while (soff < sn || roff < rn) {
+    struct pollfd fds[2];
+    int nf = 0;
+    int si = -1, ri = -1;
+    if (soff < sn) {
+      fds[nf].fd = g_right_fd;
+      fds[nf].events = POLLOUT;
+      si = nf++;
+    }
+    if (roff < rn) {
+      fds[nf].fd = g_left_fd;
+      fds[nf].events = POLLIN;
+      ri = nf++;
+    }
+    int rc = poll(fds, nf, 60000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      set_error(std::string("poll: ") + strerror(errno));
+      return false;
+    }
+    if (rc == 0) {
+      set_error("ring exchange timed out (60s)");
+      return false;
+    }
+    if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t k = send(g_right_fd, (const char*)sbuf + soff, sn - soff,
+                       MSG_NOSIGNAL);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        set_error(std::string("send: ") + strerror(errno));
+        return false;
+      }
+      if (k > 0) soff += (size_t)k;
+    }
+    if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = recv(g_left_fd, (char*)rbuf + roff, rn - roff, 0);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        set_error(std::string("recv: ") + strerror(errno));
+        return false;
+      }
+      if (k == 0) {
+        set_error("recv: peer closed");
+        return false;
+      }
+      if (k > 0) roff += (size_t)k;
+    }
+  }
+  return true;
+}
+
+bool parse_addr(const std::string& addr, std::string* host, int* port) {
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) return false;
+  *host = addr.substr(0, colon);
+  *port = atoi(addr.c_str() + colon + 1);
+  return true;
+}
+
+std::vector<uint8_t> g_secret;
+
+void auth_token(int sender_rank, uint8_t out[32]) {
+  char msg[64];
+  int n = snprintf(msg, sizeof(msg), "hvd-ring-hello:%d", sender_rank);
+  hvd::hmac_sha256(g_secret.data(), g_secret.size(), (const uint8_t*)msg,
+                   (size_t)n, out);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* hvd_ring_last_error() { return g_error.c_str(); }
+
+// addrs: comma-separated "host:port" per rank, in rank order.
+// secret: raw bytes (hex-decoded on the Python side), length secret_len.
+int hvd_ring_init(int rank, int size, const char* addrs_cstr,
+                  const uint8_t* secret, int secret_len) {
+  g_rank = rank;
+  g_size = size;
+  g_secret.assign(secret, secret + secret_len);
+  if (size == 1) return 0;
+
+  std::vector<std::string> addrs;
+  std::string cur, all(addrs_cstr);
+  for (char c : all) {
+    if (c == ',') {
+      addrs.push_back(cur);
+      cur.clear();
+    } else {
+      cur += c;
+    }
+  }
+  if (!cur.empty()) addrs.push_back(cur);
+  if ((int)addrs.size() != size) {
+    set_error("hvd_ring_init: addrs count != size");
+    return -1;
+  }
+
+  std::string my_host;
+  int my_port = 0;
+  if (!parse_addr(addrs[rank], &my_host, &my_port)) {
+    set_error("hvd_ring_init: bad own address " + addrs[rank]);
+    return -1;
+  }
+
+  // Listen for the left neighbor.
+  g_listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(g_listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in sa;
+  std::memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = INADDR_ANY;
+  sa.sin_port = htons((uint16_t)my_port);
+  if (bind(g_listen_fd, (struct sockaddr*)&sa, sizeof(sa)) < 0) {
+    set_error(std::string("bind ") + addrs[rank] + ": " + strerror(errno));
+    return -1;
+  }
+  if (listen(g_listen_fd, 4) < 0) {
+    set_error(std::string("listen: ") + strerror(errno));
+    return -1;
+  }
+
+  // Connect to the right neighbor, retrying while it comes up (the Python
+  // WorkerClient does the same, controller/service.py).
+  int right = (rank + 1) % size;
+  std::string rhost;
+  int rport;
+  if (!parse_addr(addrs[right], &rhost, &rport)) {
+    set_error("hvd_ring_init: bad right address " + addrs[right]);
+    return -1;
+  }
+  struct addrinfo hints, *res = nullptr;
+  std::memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", rport);
+  if (getaddrinfo(rhost.c_str(), portstr, &hints, &res) != 0 || !res) {
+    set_error("getaddrinfo failed for " + rhost);
+    return -1;
+  }
+  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(120);
+  while (true) {
+    g_right_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(g_right_fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    close(g_right_fd);
+    g_right_fd = -1;
+    if (std::chrono::steady_clock::now() > deadline) {
+      freeaddrinfo(res);
+      set_error("connect to right neighbor timed out: " + addrs[right]);
+      return -1;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  freeaddrinfo(res);
+  setsockopt(g_right_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+
+  // Authenticate to the right neighbor.
+  uint8_t token[36];
+  uint32_t rank_be = htonl((uint32_t)rank);
+  std::memcpy(token, &rank_be, 4);
+  auth_token(rank, token + 4);
+  if (!send_all(g_right_fd, token, sizeof(token))) return -1;
+
+  // Accept + verify the left neighbor.
+  int left = (rank - 1 + size) % size;
+  g_left_fd = accept(g_listen_fd, nullptr, nullptr);
+  if (g_left_fd < 0) {
+    set_error(std::string("accept: ") + strerror(errno));
+    return -1;
+  }
+  setsockopt(g_left_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  uint8_t peer[36];
+  if (!recv_all(g_left_fd, peer, sizeof(peer))) return -1;
+  uint32_t peer_rank_be;
+  std::memcpy(&peer_rank_be, peer, 4);
+  int peer_rank = (int)ntohl(peer_rank_be);
+  uint8_t expect[32];
+  auth_token(peer_rank, expect);
+  if (peer_rank != left || std::memcmp(peer + 4, expect, 32) != 0) {
+    set_error("left-neighbor authentication failed");
+    return -1;
+  }
+
+  // Non-blocking from here on: exchange() interleaves duplex progress via
+  // poll, and a blocking send of a large segment against a neighbor doing
+  // the same would deadlock once both socket buffers fill.
+  for (int fd : {g_left_fd, g_right_fd}) {
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+  }
+  return 0;
+}
+
+// In-place ring allreduce (sum; average divides afterwards for float types).
+int hvd_ring_allreduce(void* buf, long count, int dtype, int average) {
+  if (g_size <= 1) return 0;
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    set_error("unsupported dtype");
+    return -1;
+  }
+  char* base = (char*)buf;
+  long nseg = g_size;
+  long base_len = count / nseg, rem = count % nseg;
+  auto seg_off = [&](long s) { return s * base_len + (s < rem ? s : rem); };
+  auto seg_len = [&](long s) { return base_len + (s < rem ? 1 : 0); };
+
+  std::vector<char> tmp((size_t)(base_len + 1) * esz);
+
+  // Phase 1: reduce-scatter. After size-1 steps, rank r owns the fully
+  // reduced segment (r+1)%size.
+  for (int step = 0; step < g_size - 1; step++) {
+    long s_send = (g_rank - step + g_size) % g_size;
+    long s_recv = (g_rank - step - 1 + g_size) % g_size;
+    if (!exchange(base + seg_off(s_send) * esz, (size_t)seg_len(s_send) * esz,
+                  tmp.data(), (size_t)seg_len(s_recv) * esz))
+      return -1;
+    accumulate(base + seg_off(s_recv) * esz, tmp.data(), seg_len(s_recv),
+               dtype);
+  }
+  // Phase 2: allgather of reduced segments.
+  for (int step = 0; step < g_size - 1; step++) {
+    long s_send = (g_rank + 1 - step + g_size) % g_size;
+    long s_recv = (g_rank - step + g_size) % g_size;
+    if (!exchange(base + seg_off(s_send) * esz, (size_t)seg_len(s_send) * esz,
+                  base + seg_off(s_recv) * esz, (size_t)seg_len(s_recv) * esz))
+      return -1;
+  }
+  if (average) scale(buf, count, dtype, 1.0 / g_size);
+  return 0;
+}
+
+// Ring allgather with per-rank element counts (MPI_Allgatherv equivalent).
+// out must hold sum(counts); own block is copied internally.
+int hvd_ring_allgather(const void* in, const long* counts, void* out,
+                       int dtype) {
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    set_error("unsupported dtype");
+    return -1;
+  }
+  std::vector<long> offs(g_size + 1, 0);
+  for (int r = 0; r < g_size; r++) offs[r + 1] = offs[r] + counts[r];
+  char* base = (char*)out;
+  std::memcpy(base + offs[g_rank] * esz, in, (size_t)counts[g_rank] * esz);
+  for (int step = 0; step < (g_size > 1 ? g_size - 1 : 0); step++) {
+    long b_send = (g_rank - step + g_size) % g_size;
+    long b_recv = (g_rank - step - 1 + g_size) % g_size;
+    if (!exchange(base + offs[b_send] * esz, (size_t)counts[b_send] * esz,
+                  base + offs[b_recv] * esz, (size_t)counts[b_recv] * esz))
+      return -1;
+  }
+  return 0;
+}
+
+// Ring (pipeline) broadcast from root, in place.
+int hvd_ring_broadcast(void* buf, long count, int dtype, int root) {
+  if (g_size <= 1) return 0;
+  size_t esz = dtype_size(dtype);
+  if (esz == 0) {
+    set_error("unsupported dtype");
+    return -1;
+  }
+  size_t nbytes = (size_t)count * esz;
+  int right = (g_rank + 1) % g_size;
+  if (g_rank == root) {
+    return send_all(g_right_fd, buf, nbytes) ? 0 : -1;
+  }
+  if (!recv_all(g_left_fd, buf, nbytes)) return -1;
+  if (right != root) {
+    if (!send_all(g_right_fd, buf, nbytes)) return -1;
+  }
+  return 0;
+}
+
+void hvd_ring_shutdown() {
+  for (int* fd : {&g_left_fd, &g_right_fd, &g_listen_fd}) {
+    if (*fd >= 0) {
+      close(*fd);
+      *fd = -1;
+    }
+  }
+  g_rank = -1;
+  g_size = 0;
+}
+
+}  // extern "C"

@@ -112,6 +112,23 @@ def run(args: argparse.Namespace) -> int:
         if rank >= size:
             break
 
+    # Per-rank addresses for the native C++ ring data plane. Local-only jobs
+    # bind loopback with verified-free ports; with remote hosts in play the
+    # local entries must be reachable, so use the hostname and a common base
+    # port on remote machines (override via HOROVOD_RING_ADDRS if the
+    # heuristic clashes).
+    any_remote = any(not _is_local(h) for _, h, _, _, _ in assignments)
+    ring_base = _free_port()
+    ring_addrs = []
+    for r, host, _, _, _ in assignments:
+        if _is_local(host):
+            addr_host = socket.gethostname() if any_remote else "127.0.0.1"
+            ring_addrs.append(f"{addr_host}:{_free_port()}")
+        else:
+            ring_addrs.append(f"{host}:{ring_base + r}")
+    ring_addrs_env = os.environ.get("HOROVOD_RING_ADDRS",
+                                    ",".join(ring_addrs))
+
     procs: List[subprocess.Popen] = []
     threads = []
     failed = threading.Event()
@@ -120,6 +137,7 @@ def run(args: argparse.Namespace) -> int:
         env = build_rank_env(
             dict(os.environ), rank, size, local_rank, local_size,
             cross_rank, len(hosts), coord_addr, secret, args.bind_chips)
+        env["HOROVOD_RING_ADDRS"] = ring_addrs_env
         if _is_local(host):
             cmd = args.command
         else:

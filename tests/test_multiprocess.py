@@ -28,6 +28,7 @@ def _free_port() -> int:
 def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
               extra_env=None):
     addr = f"127.0.0.1:{_free_port()}"
+    ring_addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(size))
     procs = []
     for rank in range(size):
         env = dict(os.environ)
@@ -37,6 +38,7 @@ def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
             "HOROVOD_LOCAL_RANK": str(rank),
             "HOROVOD_LOCAL_SIZE": str(size),
             "HOROVOD_CONTROLLER_ADDR": addr,
+            "HOROVOD_RING_ADDRS": ring_addrs,
             "HOROVOD_CYCLE_TIME": "1",
             "JAX_PLATFORMS": "cpu",
             "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
@@ -110,3 +112,9 @@ def test_timeline_multiprocess(tmp_path):
 
 def test_three_ranks_broadcast_nonzero_root():
     run_ranks("broadcast", size=3)
+
+
+@pytest.mark.parametrize("scenario", ["allreduce", "allgather", "broadcast"])
+def test_star_data_plane(scenario):
+    # Pure-Python fallback path (HOROVOD_CPU_OPS=star) stays correct.
+    run_ranks(scenario, size=2, extra_env={"HOROVOD_CPU_OPS": "star"})
