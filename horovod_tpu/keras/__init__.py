@@ -1,0 +1,36 @@
+"""Keras user API: ``import horovod_tpu.keras as hvd``.
+
+Reference: ``horovod/keras/__init__.py`` + ``horovod/_keras/__init__.py``
+(shared impl with ``horovod/tensorflow/keras``). With Keras 3 the optimizer
+seam is ``apply_gradients``, so ``DistributedOptimizer`` is shared with the
+TF adapter.
+"""
+
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from ..tensorflow import (  # noqa: F401
+    Compression,
+    DistributedOptimizer,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_variables,
+)
+from . import callbacks  # noqa: F401
+
+
+def broadcast_global_variables(model, root_rank: int = 0) -> None:
+    """Broadcast a model's variables from root (reference
+    ``keras/__init__.py`` delegating to ``_keras``; TF2 needs the model
+    explicitly — there is no global collection)."""
+    broadcast_variables(list(model.variables), root_rank=root_rank)

@@ -1,0 +1,26 @@
+"""MXNet adapter placeholder.
+
+The reference ships ``horovod/mxnet`` (DistributedOptimizer, gluon
+DistributedTrainer, broadcast_parameters — SURVEY.md §2.2). MXNet reached
+end-of-life in 2023 and is not installable in this image; the adapter is
+deliberately a guarded stub: importing it with mxnet absent raises with
+guidance instead of a bare ModuleNotFoundError. If mxnet is present, the
+torch-equivalent surface can be built on the same controller — contributions
+tracked as a documented gap rather than silently missing.
+"""
+
+try:
+    import mxnet  # noqa: F401
+except ImportError as exc:  # pragma: no cover - mxnet never present in CI
+    raise ImportError(
+        "horovod_tpu.mxnet requires the 'mxnet' package, which is "
+        "end-of-life and not installed in this environment. Use "
+        "horovod_tpu.jax (flagship), horovod_tpu.torch or "
+        "horovod_tpu.tensorflow instead."
+    ) from exc
+
+raise ImportError(
+    "horovod_tpu.mxnet: mxnet detected, but the adapter is not implemented "
+    "in this build (mxnet is EOL). The controller API "
+    "(horovod_tpu.controller.Controller) provides the allreduce/allgather/"
+    "broadcast primitives an adapter needs.")

@@ -1,0 +1,188 @@
+"""TensorFlow user API: ``import horovod_tpu.tensorflow as hvd``.
+
+Reference: ``horovod/tensorflow/__init__.py`` (321 lines) + the custom-op
+layer ``tensorflow/mpi_ops.cc``. The reference targets TF1 graph mode
+(AsyncOpKernels + SessionRunHook); this rebuild targets TF2 eager /
+``tf.function`` — the op surface is the same (allreduce with the
+IndexedSlices→allgather sparse path, broadcast_variables,
+DistributedOptimizer, DistributedGradientTape), with collectives executed by
+the shared controller through ``tf.py_function`` so they work inside traced
+``tf.function`` graphs. ``BroadcastGlobalVariablesHook`` (TF1 sessions) has
+no TF2 equivalent surface; use ``broadcast_variables`` /
+``keras.callbacks.BroadcastGlobalVariablesCallback``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import tensorflow as tf
+
+from ..common import basics
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)
+from .compression import Compression  # noqa: F401
+
+
+def _controller():
+    st = basics.state()
+    if st.controller is None:
+        raise RuntimeError(
+            "eager collectives at size > 1 require the background controller; "
+            "launch through horovodrun")
+    return st.controller
+
+
+def _np_collective(fn, tensor: tf.Tensor, out_dtype=None) -> tf.Tensor:
+    """Run a controller collective on a TF tensor, staying graph-compatible:
+    under tf.function the call is embedded as a py_function node (the TF2
+    counterpart of the reference's AsyncOpKernel enqueue,
+    tensorflow/mpi_ops.cc:276-303)."""
+    out_dtype = out_dtype or tensor.dtype
+
+    def runner(t):
+        return tf.convert_to_tensor(fn(t.numpy()), dtype=out_dtype)
+
+    if tf.executing_eagerly():
+        return runner(tensor)
+    return tf.py_function(runner, [tensor], out_dtype)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              compression=Compression.none):
+    """Mean/sum across ranks; ``tf.IndexedSlices`` take the sparse
+    allgather path (reference ``tensorflow/__init__.py:36-87``)."""
+    if isinstance(tensor, tf.IndexedSlices):
+        # Gather values+indices everywhere; averaging divides values by size
+        # (reference tensorflow/__init__.py:62-78).
+        values = allgather(tensor.values,
+                           name=None if name is None else f"{name}.values")
+        indices = allgather(tensor.indices,
+                            name=None if name is None else f"{name}.indices")
+        if average:
+            values = tf.cast(values, tensor.values.dtype) / size()
+        return tf.IndexedSlices(values, indices,
+                                dense_shape=tensor.dense_shape)
+    tensor = tf.convert_to_tensor(tensor)
+    if size() == 1:
+        return tf.identity(tensor)
+    compressed, ctx = compression.compress(tensor)
+    ctrl = _controller()
+    out = _np_collective(
+        lambda a: ctrl.allreduce(a, average=average, name=name),
+        compressed)
+    return compression.decompress(out, ctx)
+
+
+def allgather(tensor, name: Optional[str] = None):
+    tensor = tf.convert_to_tensor(tensor)
+    if size() == 1:
+        return tf.identity(tensor)
+    ctrl = _controller()
+    return _np_collective(lambda a: ctrl.allgather(a, name=name), tensor)
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    tensor = tf.convert_to_tensor(tensor)
+    if size() == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return tf.identity(tensor)
+    ctrl = _controller()
+    return _np_collective(
+        lambda a: ctrl.broadcast(a, root_rank=root_rank, name=name), tensor)
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable root's value (reference
+    ``broadcast_global_variables``/``broadcast_variables``,
+    ``tensorflow/__init__.py:90-109``), async-enqueued then joined so the
+    fusion engine can pack them."""
+    variables = list(variables)
+    if size() == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return
+    ctrl = _controller()
+    handles = [
+        ctrl.broadcast_async(v.numpy(), root_rank=root_rank,
+                             name=f"broadcast.var.{i}")
+        for i, v in enumerate(variables)
+    ]
+    for v, h in zip(variables, handles):
+        v.assign(tf.convert_to_tensor(np.asarray(h.wait()), dtype=v.dtype))
+
+
+def broadcast_global_variables(root_rank: int = 0) -> None:
+    """TF1-compat name (reference ``tensorflow/__init__.py:90-98``): in TF2
+    there is no global collection; broadcast the trackable variables of the
+    current default strategy is not defined — prefer
+    ``broadcast_variables(model.variables)``."""
+    raise NotImplementedError(
+        "TF2 has no global-variables collection; call "
+        "hvd.broadcast_variables(model.variables, root_rank) instead")
+
+
+class DistributedGradientTape(tf.GradientTape):
+    """``tf.GradientTape`` whose ``gradient()`` averages grads across ranks
+    (reference ``tensorflow/__init__.py:247-321``)."""
+
+    def __init__(self, *args, compression=Compression.none,
+                 device_dense="", device_sparse="", **kwargs):
+        super().__init__(*args, **kwargs)
+        self._compression = compression
+
+    def gradient(self, target, sources, output_gradients=None, **kwargs):
+        grads = super().gradient(target, sources, output_gradients, **kwargs)
+        if size() == 1:
+            return grads
+        return [
+            allreduce(g, average=True, name=f"DistributedGradientTape.{i}",
+                      compression=self._compression)
+            if g is not None else None
+            for i, g in enumerate(grads)
+        ]
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none,
+                         device_dense: str = "", device_sparse: str = "",
+                         backward_passes_per_step: int = 1):
+    """Wrap a keras optimizer so ``apply_gradients`` first averages the
+    gradients across ranks (reference ``tensorflow/__init__.py:146-244``;
+    the reference overrides ``compute_gradients`` on TF1 optimizers — the
+    Keras-3 equivalent seam is ``apply_gradients``)."""
+    if backward_passes_per_step != 1:
+        raise ValueError(
+            "backward_passes_per_step > 1 is not supported on the TF tier; "
+            "use hvd.torch or hvd.jax for local gradient accumulation")
+
+    base = optimizer.__class__
+
+    class _Distributed(base):
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            if size() > 1:
+                grads_and_vars = [
+                    (allreduce(g, average=True,
+                               name=f"DistributedOptimizer.grad.{i}",
+                               compression=compression), v)
+                    if g is not None else (g, v)
+                    for i, (g, v) in enumerate(grads_and_vars)
+                ]
+            return super().apply_gradients(grads_and_vars, *args, **kwargs)
+
+    _Distributed.__name__ = f"Distributed{base.__name__}"
+    dist = _Distributed.from_config(optimizer.get_config())
+    return dist

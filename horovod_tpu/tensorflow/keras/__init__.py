@@ -1,0 +1,24 @@
+"""``import horovod_tpu.tensorflow.keras as hvd`` — parity alias for the
+reference's ``horovod/tensorflow/keras`` package (same shared impl as
+``horovod_tpu.keras``)."""
+
+from ...keras import (  # noqa: F401
+    Compression,
+    DistributedOptimizer,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_global_variables,
+    broadcast_variables,
+    callbacks,
+    cross_rank,
+    cross_size,
+    init,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)

@@ -219,6 +219,56 @@ def scenario_torch(rank, size):
     thvd.broadcast_optimizer_state(opt2, root_rank=0)
 
 
+def scenario_tensorflow(rank, size):
+    # Reference test/test_tensorflow.py core semantics across real ranks.
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as tfhvd
+
+    x = tf.constant(np.arange(6, dtype=np.float32) + rank)
+    out = tfhvd.allreduce(x, average=True)
+    np.testing.assert_allclose(
+        out.numpy(), np.arange(6) + (size - 1) / 2, rtol=1e-6)
+
+    # Sparse gradients: IndexedSlices → allgather path
+    # (reference tensorflow/__init__.py:62-78).
+    slices = tf.IndexedSlices(
+        values=tf.constant([[float(rank + 1), 0.0]]),
+        indices=tf.constant([rank]), dense_shape=tf.constant([size, 2]))
+    red = tfhvd.allreduce(slices, average=True)
+    assert isinstance(red, tf.IndexedSlices)
+    assert red.values.shape[0] == size
+    np.testing.assert_allclose(red.values.numpy()[:, 0],
+                               (np.arange(size) + 1) / size)
+
+    v = tf.Variable(np.full(3, float(rank), np.float32))
+    tfhvd.broadcast_variables([v], root_rank=0)
+    np.testing.assert_array_equal(v.numpy(), np.zeros(3))
+
+    w = tf.Variable([float(rank + 1)])
+    with tfhvd.DistributedGradientTape() as tape:
+        loss = w * w
+    (grad,) = tape.gradient(loss, [w])
+    want = np.mean([2.0 * (r + 1) for r in range(size)])
+    np.testing.assert_allclose(grad.numpy(), [want], rtol=1e-6)
+
+    # tf.function tracing: collective embedded via py_function.
+    @tf.function
+    def traced(t):
+        return tfhvd.allreduce(t, average=False)
+
+    tr = traced(tf.constant([1.0, 2.0]))
+    np.testing.assert_allclose(tr.numpy(), [size, 2.0 * size])
+
+    # Keras metric averaging callback.
+    from horovod_tpu.keras.callbacks import MetricAverageCallback
+
+    cb = MetricAverageCallback()
+    logs = {"loss": float(rank)}
+    cb.on_epoch_end(0, logs)
+    np.testing.assert_allclose(logs["loss"], (size - 1) / 2)
+
+
 def scenario_optimizer(rank, size):
     # End-to-end eager-tier DistributedOptimizer + broadcast_parameters
     # (reference examples/pytorch_mnist.py pattern).
@@ -238,6 +288,7 @@ def scenario_optimizer(rank, size):
 
 
 SCENARIOS = {
+    "tensorflow": scenario_tensorflow,
     "torch": scenario_torch,
     "optimizer": scenario_optimizer,
     "stall": scenario_stall,

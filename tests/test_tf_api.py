@@ -1,0 +1,89 @@
+"""TF/Keras adapter, single-process semantics (reference test_tensorflow.py /
+test_keras.py size-independent parts). Cross-rank behavior: "tensorflow"
+scenario in tests/test_multiprocess.py."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def test_ops_size1():
+    hvd.init()
+    x = tf.constant([1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(hvd.allreduce(x).numpy(), x.numpy())
+    np.testing.assert_array_equal(hvd.allgather(x).numpy(), x.numpy())
+    np.testing.assert_array_equal(
+        hvd.broadcast(x, root_rank=0).numpy(), x.numpy())
+
+
+def test_indexed_slices_size1():
+    hvd.init()
+    slices = tf.IndexedSlices(
+        values=tf.constant([[1.0, 2.0]]), indices=tf.constant([3]),
+        dense_shape=tf.constant([5, 2]))
+    out = hvd.allreduce(slices, average=True)
+    assert isinstance(out, tf.IndexedSlices)
+    np.testing.assert_array_equal(out.values.numpy(), [[1.0, 2.0]])
+    np.testing.assert_array_equal(out.indices.numpy(), [3])
+
+
+def test_distributed_gradient_tape_size1():
+    hvd.init()
+    w = tf.Variable([2.0])
+    with hvd.DistributedGradientTape() as tape:
+        loss = w * w
+    (grad,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(grad.numpy(), [4.0])
+
+
+def test_distributed_optimizer_apply():
+    hvd.init()
+    opt = hvd.DistributedOptimizer(tf.keras.optimizers.SGD(0.5))
+    v = tf.Variable(1.0)
+    opt.apply_gradients([(tf.constant(1.0), v)])
+    np.testing.assert_allclose(v.numpy(), 0.5)
+
+
+def test_broadcast_variables_size1():
+    hvd.init()
+    v = tf.Variable([1.0, 2.0])
+    hvd.broadcast_variables([v], root_rank=0)
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast_variables([v], root_rank=2)
+
+
+def test_keras_alias_surface():
+    import horovod_tpu.tensorflow.keras as hvd_tfk
+
+    assert hvd_tfk.DistributedOptimizer is hvd_keras.DistributedOptimizer
+    assert hasattr(hvd_keras.callbacks, "BroadcastGlobalVariablesCallback")
+    assert hasattr(hvd_keras.callbacks, "MetricAverageCallback")
+    assert hasattr(hvd_keras.callbacks, "LearningRateWarmupCallback")
+    assert hasattr(hvd_keras.callbacks, "LearningRateScheduleCallback")
+
+
+def test_lr_schedule_callback_size1():
+    hvd.init()
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, input_shape=(2,))])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+    cb = hvd_keras.callbacks.LearningRateScheduleCallback(
+        multiplier=lambda epoch: 0.5 ** epoch)
+    cb.set_model(model)
+    cb.on_epoch_begin(0)
+    np.testing.assert_allclose(
+        float(model.optimizer.learning_rate.numpy()), 0.1, rtol=1e-6)
+    cb.on_epoch_begin(2)
+    np.testing.assert_allclose(
+        float(model.optimizer.learning_rate.numpy()), 0.025, rtol=1e-6)
+
+
+def test_compression_tf():
+    x = tf.constant([1.0, 2.0])
+    c, ctx = hvd.Compression.fp16.compress(x)
+    assert c.dtype == tf.float16
+    assert hvd.Compression.fp16.decompress(c, ctx).dtype == tf.float32
