@@ -4,6 +4,7 @@ sequence/context parallelism.
 The reference implements data parallelism only (SURVEY.md §2.3); the mesh
 utilities here are its substrate plus the axes future strategies hang off."""
 
+from . import sequence  # noqa: F401
 from .mesh import (  # noqa: F401
     DATA_AXIS,
     make_mesh,

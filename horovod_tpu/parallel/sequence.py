@@ -1,0 +1,129 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+No reference-repo equivalent (SURVEY.md §5: "Long-context — ABSENT"); this is
+the rebuild's first-class long-context layer, built directly on the
+collective substrate the reference's architecture maps to (the ICI ring that
+``NCCLHierarchicalAllreduce`` approximates with NCCL rings is here the
+transport for K/V rotation).
+
+* ``ring_attention`` — blockwise attention with K/V shards rotating around
+  the mesh axis via ``lax.ppermute`` (one neighbor hop per step, riding ICI),
+  accumulating with the online-softmax recurrence. Sequence length scales
+  linearly with the number of chips; per-chip memory stays O(S_local).
+* ``ulysses_attention`` — all-to-all head/sequence reshard: each chip
+  attends over the FULL sequence for 1/N of the heads, then reshards back.
+  Cheaper than ring for moderate S (two all-to-alls), requires H % N == 0.
+
+Both are shard_map-tier functions: call them inside
+``jax.shard_map`` with the sequence axis sharded over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, sm_scale, q_off, k_off, causal, key_mask):
+    """One (Sq_local x Sk_block) attention block in f32: returns
+    (unnormalized acc, running max, running sum) contributions."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :], s, NEG_INF)
+    if causal:
+        qi = q_off + jnp.arange(q.shape[1])[:, None]
+        ki = k_off + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where((ki <= qi)[None, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (b,h,q,1)
+    # Guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1.
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe) * (m > NEG_INF / 2)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m_safe, l
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
+                   sm_scale: Optional[float] = None, key_mask=None):
+    """Attention over a sequence sharded along ``axis_name``.
+
+    Args (local shards, inside shard_map):
+      q, k, v: (B, S_local, H, D); global sequence = concat over the axis in
+        rank order. key_mask: optional (B, S_local) bool for local keys.
+    Returns: (B, S_local, H, D) — attention of local queries over the FULL
+      global sequence.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, hn, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    q_off = my_idx * s_local
+
+    def step(carry, _):
+        k_blk, v_blk, mask_blk, src, m, l, acc = carry
+        k_off = src * s_local
+        a, bm, bl = _block_attend(q, k_blk, v_blk, scale, q_off, k_off,
+                                  causal, mask_blk)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l_new = l * alpha + bl * beta
+        acc_new = acc * alpha + a * beta
+        # Rotate K/V (and mask) to the next neighbor over ICI; the block we
+        # receive originated at src-1.
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        mask_next = (lax.ppermute(mask_blk, axis_name, perm)
+                     if mask_blk is not None else None)
+        src_next = (src - 1) % axis_size
+        return (k_next, v_next, mask_next, src_next, m_new, l_new, acc_new), None
+
+    m0 = jnp.full((b, hn, s_local, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hn, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hn, s_local, d), jnp.float32)
+    carry = (k, v, key_mask, my_idx, m0, l0, acc0)
+    (_, _, _, _, m, l, acc), _ = lax.scan(step, carry, None, length=axis_size)
+
+    out = acc / jnp.maximum(l, 1e-30)  # zeros for fully-masked rows
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
+                      sm_scale: Optional[float] = None, attention_fn=None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern): reshard
+    (B, S_local, H, D) -> (B, S_global, H_local, D), attend over the full
+    sequence with 1/N of the heads, reshard back. Two ``lax.all_to_all``s on
+    ICI replace N-1 ring hops."""
+    axis_size = lax.psum(1, axis_name)
+    hn = q.shape[2]
+    if hn % axis_size:
+        raise ValueError(
+            f"ulysses_attention: heads ({hn}) must divide by axis size "
+            f"({axis_size}); use ring_attention instead")
+
+    def scatter_heads(x):
+        # (B, S_local, H, D) -> (B, S_global, H/N, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if attention_fn is None:
+        from ..ops.attention import reference_attention
+
+        out = reference_attention(qg, kg, vg, causal=causal,
+                                  sm_scale=sm_scale)
+    else:
+        out = attention_fn(qg, kg, vg, None)
+    return gather_heads(out)

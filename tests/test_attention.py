@@ -1,0 +1,148 @@
+"""Flash attention (Pallas, interpreter mode on CPU) and sequence-parallel
+attention (ring + Ulysses) vs the XLA reference implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops.attention import flash_attention, reference_attention
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel.sequence import ring_attention, ulysses_attention
+
+B, S, H, D = 2, 64, 2, 16
+
+
+def _qkv(seed=0, s=S):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, s, H, D).astype(np.float32)) * 0.3
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = reference_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_flash_key_mask():
+    q, k, v = _qkv(1)
+    mask = jnp.asarray(np.random.RandomState(2).rand(B, S) > 0.3)
+    ref = reference_attention(q, k, v, key_mask=mask)
+    out = flash_attention(q, k, v, key_mask=mask, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_flash_gradient():
+    q, k, v = _qkv(3)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                block_q=16, block_k=16) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_flash_block_divisibility_error():
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, v, block_q=48, block_k=48)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention(causal):
+    q, k, v = _qkv(4)
+    mesh = make_mesh({"seq": 8})
+    ref = reference_attention(q, k, v, causal=causal)
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
+                                       causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_key_mask():
+    q, k, v = _qkv(5)
+    mask = jnp.asarray(np.random.RandomState(6).rand(B, S) > 0.3)
+    mesh = make_mesh({"seq": 8})
+    ref = reference_attention(q, k, v, key_mask=mask)
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v, m: ring_attention(q, k, v, axis_name="seq",
+                                          key_mask=m),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq"),
+                  P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    out = f(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention(causal):
+    q, k, v = _qkv(7)
+    # H=2 heads must divide the axis size: use a 2-device submesh.
+    mesh = make_mesh({"seq": 2}, devices=jax.devices()[:2])
+    ref = reference_attention(q, k, v, causal=causal)
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq",
+                                          causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ulysses_head_divisibility():
+    q, k, v = _qkv()
+    mesh = make_mesh({"seq": 8})
+    f = jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq"),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+        check_vma=False,
+    )
+    with pytest.raises(ValueError, match="heads"):
+        f(q, k, v)
+
+
+def test_bert_with_flash_attention():
+    from horovod_tpu.models import BERT_TINY, BertEncoder
+    from horovod_tpu.ops.attention import make_attention_fn
+
+    cfg = BERT_TINY
+    ids = jnp.ones((1, 32), jnp.int32)
+    model_ref = BertEncoder(cfg)
+    variables = model_ref.init(jax.random.PRNGKey(0), ids, deterministic=True)
+    out_ref = model_ref.apply(variables, ids, deterministic=True)
+
+    model_flash = BertEncoder(
+        cfg, attention_fn=make_attention_fn(block_q=16, block_k=16))
+    out_flash = model_flash.apply(variables, ids, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
+                               atol=5e-2, rtol=5e-2)
