@@ -1,0 +1,172 @@
+"""Autotuning: Bayesian optimization of fusion threshold + cycle time.
+
+Reference: ``horovod/common/parameter_manager.{h,cc}`` (tunable-parameter
+stack scored by observed bytes/sec) driven by
+``common/optim/bayesian_optimization.cc`` + ``common/optim/gaussian_process.cc``
+(GP surrogate + expected-improvement acquisition, Eigen + L-BFGS). Same
+architecture here in numpy: a GP with RBF kernel models score(params); each
+tuning step scores the current configuration over a sample window, then
+moves to the acquisition argmax (random-candidate search instead of L-BFGS —
+two smooth dimensions need no quasi-Newton machinery).
+
+Tuned knobs (the eager tier's two continuous parameters, as in the
+reference's joint-Bayesian group, ``parameter_manager.h:35-43``):
+  * fusion threshold, log2-bytes in [20, 28]  (1 MiB .. 256 MiB)
+  * cycle time, ms in [1, 25]
+
+Enabled by ``HOROVOD_AUTOTUNE``; per-step CSV via ``HOROVOD_AUTOTUNE_LOG``
+(reference ``operations.cc:1074-1078``). The coordinator tunes and the new
+values ride the cycle reply to all ranks (reference ``SyncParams``,
+``parameter_manager.cc:223``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """GP regression, RBF kernel + noise (reference
+    ``optim/gaussian_process.{h,cc}``)."""
+
+    def __init__(self, length_scale: float = 1.0, signal_var: float = 1.0,
+                 noise_var: float = 1e-4):
+        self.length_scale = length_scale
+        self.signal_var = signal_var
+        self.noise_var = noise_var
+        self._x: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+        self._chol: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return self.signal_var * np.exp(-0.5 * d2 / self.length_scale ** 2)
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        self._x = np.asarray(x, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        k = self._kernel(self._x, self._x)
+        k[np.diag_indices_from(k)] += self.noise_var
+        self._chol = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._chol.T, np.linalg.solve(self._chol, y))
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.asarray(x, dtype=np.float64)
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._chol, ks.T)
+        var = np.maximum(
+            self.signal_var - (v ** 2).sum(0), 1e-12)
+        return mu, np.sqrt(var)
+
+
+def _norm_pdf(z):
+    return np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+
+
+def _norm_cdf(z):
+    from math import erf
+
+    return 0.5 * (1.0 + np.vectorize(erf)(z / np.sqrt(2.0)))
+
+
+class BayesianOptimizer:
+    """Expected-improvement Bayesian optimization over a box (reference
+    ``optim/bayesian_optimization.{h,cc}``: EI acquisition, xi=0.01)."""
+
+    def __init__(self, bounds: List[Tuple[float, float]], xi: float = 0.01,
+                 seed: int = 0):
+        self.bounds = np.asarray(bounds, dtype=np.float64)
+        self.xi = xi
+        self._rng = np.random.RandomState(seed)
+        self._x: List[np.ndarray] = []
+        self._y: List[float] = []
+        self.gp = GaussianProcess(length_scale=0.25)
+
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (x - lo) / (hi - lo)
+
+    def add_sample(self, x, y: float) -> None:
+        self._x.append(self._normalize(np.asarray(x, dtype=np.float64)))
+        self._y.append(float(y))
+
+    def suggest(self, n_candidates: int = 512) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        if len(self._x) < 2:
+            return lo + self._rng.rand(len(self.bounds)) * (hi - lo)
+        x = np.stack(self._x)
+        y = np.asarray(self._y)
+        # Normalize scores for GP conditioning.
+        y_mean, y_std = y.mean(), max(y.std(), 1e-9)
+        self.gp.fit(x, (y - y_mean) / y_std)
+        cand = self._rng.rand(n_candidates, len(self.bounds))
+        mu, sigma = self.gp.predict(cand)
+        best = ((y - y_mean) / y_std).max()
+        imp = mu - best - self.xi
+        z = imp / sigma
+        ei = imp * _norm_cdf(z) + sigma * _norm_pdf(z)
+        pick = cand[int(np.argmax(ei))]
+        return lo + pick * (hi - lo)
+
+
+class ParameterManager:
+    """Scores the live configuration by observed throughput and proposes the
+    next one (reference ``parameter_manager.cc:155-222`` Update/Tune)."""
+
+    WARMUP_SAMPLES = 3      # discarded after every parameter change
+    SAMPLES_PER_STEP = 10   # scored cycles per configuration
+
+    def __init__(self, fusion_threshold: int, cycle_time_ms: float,
+                 log_path: Optional[str] = None, seed: int = 0):
+        # (log2 fusion bytes, cycle ms)
+        self._bo = BayesianOptimizer([(20.0, 28.0), (1.0, 25.0)], seed=seed)
+        self.fusion_threshold = int(fusion_threshold)
+        self.cycle_time_ms = float(cycle_time_ms)
+        self._warmup_left = self.WARMUP_SAMPLES
+        self._bytes = 0
+        self._seconds = 0.0
+        self._samples = 0
+        self._log_path = log_path
+        self._best_score = -np.inf
+        self.best_fusion_threshold = self.fusion_threshold
+        self.best_cycle_time_ms = self.cycle_time_ms
+
+    def record(self, nbytes: int, seconds: float) -> Optional[Tuple[int, float]]:
+        """Feed one cycle's totals; returns new (fusion_threshold, cycle_ms)
+        when the manager moves to a new configuration, else None."""
+        if nbytes <= 0 or seconds <= 0:
+            return None
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            return None
+        self._bytes += nbytes
+        self._seconds += seconds
+        self._samples += 1
+        if self._samples < self.SAMPLES_PER_STEP:
+            return None
+
+        score = self._bytes / self._seconds  # bytes/sec, higher is better
+        params = (np.log2(self.fusion_threshold), self.cycle_time_ms)
+        self._bo.add_sample(params, score)
+        if score > self._best_score:
+            self._best_score = score
+            self.best_fusion_threshold = self.fusion_threshold
+            self.best_cycle_time_ms = self.cycle_time_ms
+        if self._log_path:
+            with open(self._log_path, "a") as f:
+                f.write(f"{time.time():.3f},{self.fusion_threshold},"
+                        f"{self.cycle_time_ms:.3f},{score:.1f}\n")
+
+        nxt = self._bo.suggest()
+        self.fusion_threshold = int(2 ** nxt[0])
+        self.cycle_time_ms = float(nxt[1])
+        self._bytes = 0
+        self._seconds = 0.0
+        self._samples = 0
+        self._warmup_left = self.WARMUP_SAMPLES
+        return self.fusion_threshold, self.cycle_time_ms

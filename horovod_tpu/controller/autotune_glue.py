@@ -1,0 +1,15 @@
+"""Controller-side autotuner construction (kept separate so the controller
+module stays importable without numpy-linalg-heavy paths on the hot import)."""
+
+from __future__ import annotations
+
+from ..common.autotune import ParameterManager
+from ..common.config import Config
+
+
+def make_parameter_manager(config: Config) -> ParameterManager:
+    return ParameterManager(
+        fusion_threshold=config.fusion_threshold_bytes,
+        cycle_time_ms=config.cycle_time_ms,
+        log_path=config.autotune_log,
+    )

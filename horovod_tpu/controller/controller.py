@@ -98,6 +98,16 @@ class Controller:
         self._shutdown_requested = False
         self._closed = threading.Event()
         self._stall_warned: Dict[str, float] = {}
+        # Live (autotunable) copies of the two continuous knobs (reference
+        # ParameterManager owns these, parameter_manager.h:35-43).
+        self._fusion_threshold = config.fusion_threshold_bytes
+        self._cycle_time_ms = config.cycle_time_ms
+        self._param_manager = None
+        self._pending_tune = None
+        if config.autotune and topology.rank == 0:
+            from .autotune_glue import make_parameter_manager
+
+            self._param_manager = make_parameter_manager(config)
 
         # Native ring data plane (C++ core): enabled when the launcher
         # exported per-rank ring addresses and HOROVOD_CPU_OPS != "star".
@@ -254,7 +264,7 @@ class Controller:
                     # their arrivals (reference sleeps cycle_time in every
                     # rank's loop, operations.cc:1250-1255).
                     elapsed = time.monotonic() - started
-                    delay = self.cfg.cycle_time_ms / 1e3 - elapsed
+                    delay = self._cycle_time_ms / 1e3 - elapsed
                     if delay > 0 and not self._shutdown_requested:
                         time.sleep(delay)
         except Exception as exc:  # transport failure: fail all pending work
@@ -299,11 +309,19 @@ class Controller:
     def _cycle(self) -> None:
         tick = self._build_tick()
         if self.topo.rank == 0:
+            t0 = time.monotonic()
             reply = self._coordinate(tick)
+            nbytes = self._process_reply(reply)
+            if self._param_manager is not None:
+                tuned = self._param_manager.record(
+                    nbytes, time.monotonic() - t0)
+                if tuned is not None:
+                    self._fusion_threshold, self._cycle_time_ms = tuned
+                    self._pending_tune = tuned
         else:
             self._client.send(tick)
             reply = self._client.recv()
-        self._process_reply(reply)
+            self._process_reply(reply)
 
     # ------------------------------------------------------- coordinator side
 
@@ -358,6 +376,10 @@ class Controller:
             "invalid_mask": invalid_mask,
             "responses": ResponseList(responses=responses, shutdown=shutdown),
         }
+        if self._pending_tune is not None:
+            # Parameter sync (reference SyncParams, parameter_manager.cc:223).
+            reply["tune"] = self._pending_tune
+            self._pending_tune = None
         self._service.send_all(reply)
         return reply
 
@@ -383,7 +405,7 @@ class Controller:
                 if (cand.response_type == ResponseType.ALLREDUCE
                         and self._response_dtype(cand) == dtype):
                     nbytes = self._response_bytes(cand)
-                    if total + nbytes <= self.cfg.fusion_threshold_bytes:
+                    if total + nbytes <= self._fusion_threshold:
                         fused.tensor_names.extend(cand.tensor_names)
                         total += nbytes
                         pending.pop(i)
@@ -429,7 +451,11 @@ class Controller:
 
     # ----------------------------------------------------------- both sides
 
-    def _process_reply(self, reply: dict) -> None:
+    def _process_reply(self, reply: dict) -> int:
+        tune = reply.get("tune")
+        if tune is not None:
+            self._fusion_threshold, self._cycle_time_ms = tune
+        executed_bytes = 0
         for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
             name = None
             with self._lock:
@@ -445,18 +471,19 @@ class Controller:
             with self._lock:
                 self._cache.touch(bit)
                 name = self._bit_pending.pop(bit)
-            self._execute(Response(
+            executed_bytes += self._execute(Response(
                 response_type=response.response_type,
                 tensor_names=[name],
                 tensor_sizes=list(response.tensor_sizes)), cache_put=False)
 
         rlist: ResponseList = reply["responses"]
         for response in rlist.responses:
-            self._execute(response, cache_put=True)
+            executed_bytes += self._execute(response, cache_put=True)
 
         if rlist.shutdown or self._shutdown_requested:
             self._fail_all(ShutdownError("Horovod has been shut down"))
             self._closed.set()
+        return executed_bytes
 
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
@@ -470,14 +497,14 @@ class Controller:
 
     # ------------------------------------------------------------ data plane
 
-    def _execute(self, response: Response, cache_put: bool) -> None:
+    def _execute(self, response: Response, cache_put: bool) -> int:
         names = response.tensor_names
         if response.response_type == ResponseType.ERROR:
             with self._lock:
                 entries = [self._table.pop(n) for n in names]
             for entry in entries:
                 entry.handle.set_error(RuntimeError(response.error_message))
-            return
+            return 0
 
         with self._lock:
             entries = [self._table[n] for n in names]
@@ -503,6 +530,7 @@ class Controller:
                                  tensor_sizes=list(response.tensor_sizes)))
         if self.timeline:
             self.timeline.end(tname)
+        return sum(e.array.nbytes for e in entries)
 
     def _finish(self, entry: _Pending, out: np.ndarray) -> None:
         if entry.postprocess is not None:

@@ -134,6 +134,16 @@ def scenario_duplicate_name(rank, size):
         raise AssertionError("duplicate name did not raise")
 
 
+def scenario_autotune(rank, size):
+    # Autotuner keeps results correct while retuning fusion/cycle params
+    # (reference HOROVOD_AUTOTUNE, operations.cc:1040-1078).
+    for it in range(60):
+        x = np.ones(256, np.float32) * (rank + it)
+        out = np.asarray(hvd.allreduce(x, average=False, name=f"at.{it}"))
+        want = np.ones(256) * (size * it + sum(range(size)))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
 def scenario_stall(rank, size):
     # Reference test/test_stall.py: one rank joins late; the coordinator must
     # warn (HOROVOD_STALL_CHECK_TIME_SECONDS=1 set by the parent) and the op
@@ -288,6 +298,7 @@ def scenario_optimizer(rank, size):
 
 
 SCENARIOS = {
+    "autotune": scenario_autotune,
     "tensorflow": scenario_tensorflow,
     "torch": scenario_torch,
     "optimizer": scenario_optimizer,

@@ -1,0 +1,61 @@
+"""Autotuner unit tests (reference tunes these through parameter_manager.cc;
+its CI never unit-tests the GP directly — we do)."""
+
+import numpy as np
+
+from horovod_tpu.common.autotune import (
+    BayesianOptimizer,
+    GaussianProcess,
+    ParameterManager,
+)
+
+
+def test_gp_fits_smooth_function():
+    rng = np.random.RandomState(0)
+    x = rng.rand(30, 1)
+    y = np.sin(4 * x[:, 0])
+    gp = GaussianProcess(length_scale=0.3)
+    gp.fit(x, y)
+    xq = np.array([[0.25], [0.5], [0.75]])
+    mu, sigma = gp.predict(xq)
+    np.testing.assert_allclose(mu, np.sin(4 * xq[:, 0]), atol=0.15)
+    assert (sigma >= 0).all()
+
+
+def test_bayesian_optimizer_finds_peak():
+    # Score peaked at x = (0.7, 0.3) in the unit box.
+    def score(p):
+        return -((p[0] - 0.7) ** 2 + (p[1] - 0.3) ** 2)
+
+    bo = BayesianOptimizer([(0.0, 1.0), (0.0, 1.0)], seed=1)
+    x = np.array([0.1, 0.9])
+    for _ in range(25):
+        bo.add_sample(x, score(x))
+        x = bo.suggest()
+    best = max(zip(bo._y, bo._x), key=lambda t: t[0])[1]
+    assert abs(best[0] - 0.7) < 0.25 and abs(best[1] - 0.3) < 0.25
+
+
+def test_parameter_manager_cycles():
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0, seed=2)
+    changed = 0
+    for step in range(200):
+        out = pm.record(nbytes=1 << 20, seconds=0.005)
+        if out is not None:
+            changed += 1
+            thr, cyc = out
+            assert (1 << 20) <= thr <= (1 << 28)
+            assert 1.0 <= cyc <= 25.0
+    assert changed >= 5  # warmup 3 + 10 samples per step
+    assert pm.best_fusion_threshold >= 1 << 20
+
+
+def test_parameter_manager_log(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          log_path=str(log), seed=3)
+    for _ in range(40):
+        pm.record(nbytes=1 << 20, seconds=0.004)
+    content = log.read_text().strip().splitlines()
+    assert len(content) >= 1
+    assert len(content[0].split(",")) == 4

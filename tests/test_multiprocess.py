@@ -114,6 +114,16 @@ def test_three_ranks_broadcast_nonzero_root():
     run_ranks("broadcast", size=3)
 
 
+def test_autotune_stays_correct(tmp_path):
+    log = tmp_path / "autotune.csv"
+    run_ranks("autotune", size=2, extra_env={
+        "HOROVOD_AUTOTUNE": "1",
+        "HOROVOD_AUTOTUNE_LOG": str(log),
+    })
+    # Coordinator scored at least one configuration.
+    assert log.exists() and log.read_text().strip()
+
+
 @pytest.mark.parametrize("scenario", ["allreduce", "allgather", "broadcast"])
 def test_star_data_plane(scenario):
     # Pure-Python fallback path (HOROVOD_CPU_OPS=star) stays correct.
