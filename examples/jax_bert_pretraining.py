@@ -1,0 +1,91 @@
+"""BERT masked-LM pretraining step benchmark with flash attention.
+
+The rebuild's second flagship target (BASELINE.md: "ResNet-50 and BERT-base").
+Synthetic token streams; flags pick the model size and sequence length.
+
+    python examples/jax_bert_pretraining.py --model tiny --seq-len 128
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import BERT_BASE, BERT_LARGE, BERT_TINY, BertEncoder, mlm_loss
+from horovod_tpu.ops.attention import make_attention_fn
+
+CONFIGS = {"tiny": BERT_TINY, "base": BERT_BASE, "large": BERT_LARGE}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", choices=list(CONFIGS), default="base")
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-chip batch")
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--no-flash", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    mesh = hvd.parallel.mesh()
+    n = hvd.local_num_devices()
+    cfg = CONFIGS[args.model]
+
+    attention_fn = None if args.no_flash else make_attention_fn(
+        block_q=min(128, args.seq_len), block_k=min(128, args.seq_len))
+    model = BertEncoder(cfg, attention_fn=attention_fn)
+
+    batch = args.batch_size * n
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                  (batch, args.seq_len)), jnp.int32)
+    mask_positions = jnp.asarray(rng.rand(batch, args.seq_len) < 0.15)
+
+    params = model.init(jax.random.PRNGKey(0), ids[:1],
+                        deterministic=True)["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(1e-4), axis_name="data")
+    opt_state = tx.init(params)
+
+    def loss_fn(p, ids, labels, mask):
+        logits = model.apply({"params": p}, ids, deterministic=True)
+        return mlm_loss(logits, labels, mask)
+
+    def train_step(p, s, ids, labels, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels, mask)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, hvd.allreduce(loss)
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P("data"), P("data"), P("data")),
+        out_specs=(P(), P(), P()), check_vma=False,
+    ), donate_argnums=(0, 1))
+
+    ids_s = hvd.parallel.shard_batch(ids, mesh)
+    mask_s = hvd.parallel.shard_batch(mask_positions, mesh)
+    params = hvd.parallel.replicate(params, mesh)
+    opt_state = hvd.parallel.replicate(opt_state, mesh)
+
+    params, opt_state, loss = step(params, opt_state, ids_s, ids_s, mask_s)
+    float(loss)  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, loss = step(params, opt_state, ids_s, ids_s, mask_s)
+    float(loss)
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        seq_per_sec = batch * args.num_iters / dt
+        print(f"BERT-{args.model} seq={args.seq_len}: "
+              f"{seq_per_sec:.1f} sequences/sec "
+              f"({seq_per_sec / n:.1f}/chip), loss={float(loss):.3f}")
+
+
+if __name__ == "__main__":
+    main()

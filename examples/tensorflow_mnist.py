@@ -1,0 +1,66 @@
+"""MNIST with the TensorFlow adapter (TF2 eager + DistributedGradientTape).
+
+Counterpart of the reference's ``examples/tensorflow_mnist.py`` (TF1 graph
+mode there; the TF2 idiom here). Launch:
+
+    bin/horovodrun -np 2 python examples/tensorflow_mnist.py
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=n).astype(np.int64)
+    centers = rng.rand(10, 784).astype(np.float32)
+    x = centers[y] + 0.3 * rng.rand(n, 784).astype(np.float32)
+    return x, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    args = parser.parse_args()
+
+    hvd.init()
+    x, y = synthetic_mnist()
+    x = x[hvd.rank()::hvd.size()]
+    y = y[hvd.rank()::hvd.size()]
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.size())
+
+    first_batch = True
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(x))
+        total = 0.0
+        for i in range(0, len(x) - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            with hvd.DistributedGradientTape() as tape:
+                loss = loss_obj(y[idx], model(x[idx], training=True))
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            if first_batch:
+                # Consistent start after variables exist (reference
+                # BroadcastGlobalVariablesHook semantics).
+                hvd.broadcast_variables(model.variables, root_rank=0)
+                hvd.broadcast_variables(opt.variables, root_rank=0)
+                first_batch = False
+            total += float(loss)
+        avg = hvd.allreduce(tf.constant(total), name="epoch_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: mean rank loss {float(avg):.4f}")
+
+
+if __name__ == "__main__":
+    main()

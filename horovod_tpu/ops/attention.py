@@ -131,7 +131,37 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
     return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+# The mask rides as a *differentiable* float32 argument with a zero
+# cotangent: nondiff_argnums may not receive tracers (jit/shard_map callers
+# pass traced masks), so only the static config lives there.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, maskf != 0, causal, sm_scale, block_q,
+                          block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, maskf, causal, sm_scale, block_q, block_k,
+                    interpret):
+    out = _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k,
+                 interpret)
+    return out, (q, k, v, maskf)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, maskf = res
+    # Rematerialized backward through the XLA reference path.
+    def f(q, k, v):
+        return reference_attention(q, k, v, key_mask=maskf != 0,
+                                   causal=causal, sm_scale=sm_scale)
+
+    _, vjp = jax.vjp(f, q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(maskf)
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
 def flash_attention(q, k, v, key_mask=None, causal: bool = False,
                     sm_scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128, interpret: Optional[bool] = None):
@@ -139,30 +169,11 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     interpreter mode off-TPU (hermetic CPU tests run the same kernel)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q,
-                          block_k, interpret)
-
-
-def _flash_fwd_rule(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
-                    interpret):
-    out = flash_attention(q, k, v, key_mask, causal, sm_scale, block_q,
-                          block_k, interpret)
-    return out, (q, k, v)
-
-
-def _flash_bwd_rule(key_mask, causal, sm_scale, block_q, block_k, interpret,
-                    res, g):
-    q, k, v = res
-    # Rematerialized backward through the XLA reference path.
-    def f(q, k, v):
-        return reference_attention(q, k, v, key_mask=key_mask, causal=causal,
-                                   sm_scale=sm_scale)
-
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
-
-
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+    b, sk = k.shape[0], k.shape[1]
+    maskf = (jnp.ones((b, sk), jnp.float32) if key_mask is None
+             else key_mask.astype(jnp.float32))
+    return _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k,
+                  interpret)
 
 
 def make_attention_fn(causal: bool = False, use_flash: bool = True,

@@ -1,0 +1,49 @@
+"""Example smoke runs — the reference CI does the same for its examples
+(.buildkite/gen-pipeline.sh:101-133)."""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+EX = os.path.join(REPO, "examples")
+
+
+def _run(cmd, timeout=300, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env.update(extra_env or {})
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=timeout, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    return res.stdout
+
+
+def test_jax_mnist_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_mnist.py"),
+                "--epochs", "1", "--batch-size", "256"])
+    assert "epoch 0" in out
+
+
+def test_torch_mnist_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "torch_mnist.py"),
+                "--epochs", "1", "--batch-size", "128"])
+    assert "epoch 0" in out
+
+
+def test_ring_attention_example_smoke():
+    out = _run([sys.executable,
+                os.path.join(EX, "jax_long_context_ring_attention.py"),
+                "--seq-len", "64", "--heads", "2", "--head-dim", "8"])
+    assert "ring attention" in out
+
+
+def test_bert_example_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_bert_pretraining.py"),
+                "--model", "tiny", "--seq-len", "32", "--batch-size", "1",
+                "--num-iters", "2"])
+    assert "sequences/sec" in out
