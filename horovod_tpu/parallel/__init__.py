@@ -4,7 +4,11 @@ sequence/context parallelism.
 The reference implements data parallelism only (SURVEY.md §2.3); the mesh
 utilities here are its substrate plus the axes future strategies hang off."""
 
-from . import sequence  # noqa: F401
+from . import hierarchical, sequence  # noqa: F401
+from .hierarchical import (  # noqa: F401
+    hierarchical_allgather,
+    hierarchical_allreduce,
+)
 from .mesh import (  # noqa: F401
     DATA_AXIS,
     make_mesh,

@@ -1,0 +1,56 @@
+"""Two-level (hierarchical) collectives for multi-slice topologies.
+
+Reference: ``NCCLHierarchicalAllreduce`` (``horovod/common/ops/
+nccl_operations.cc:167-363``: NCCL reduce-scatter within the node → MPI
+allreduce across nodes → NCCL allgather within the node) and
+``MPIHierarchicalAllgather`` (``mpi_operations.cc:179-329``). The TPU
+analogue: the fast inner fabric is ICI within a pod slice, the slow outer
+fabric is DCN across slices. With a 2-D mesh ``(outer, inner)`` the same
+bandwidth structure is:
+
+    psum_scatter over inner (ICI)  →  psum over outer (DCN, 1/inner of the
+    bytes)  →  all_gather over inner (ICI)
+
+which sends the minimum possible volume over the slow axis — exactly the
+reference's trick, expressed as three XLA collectives that the compiler
+schedules/overlaps.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def hierarchical_allreduce(x, inner_axis: str, outer_axis: str,
+                           average: bool = False):
+    """Allreduce over ``inner_axis`` x ``outer_axis`` with the
+    cross-``outer`` traffic reduced to 1/|inner| of the payload (reference
+    nccl_operations.cc:219-327). Works on any shape: internally flattened
+    and padded to the inner axis size, as the reference pads fused buffers
+    to ``local_size * FUSION_BUFFER_ATOMIC_UNIT``
+    (nccl_operations.cc:210-216)."""
+    inner = lax.psum(1, inner_axis)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % inner
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, inner_axis, tiled=True)   # ICI
+    shard = lax.psum(shard, outer_axis)                      # DCN, 1/inner
+    full = lax.all_gather(shard, inner_axis, tiled=True)     # ICI
+    out = full[:n].reshape(shape)
+    if average:
+        out = out / (inner * lax.psum(1, outer_axis))
+    return out
+
+
+def hierarchical_allgather(x, inner_axis: str, outer_axis: str):
+    """Two-level allgather: gather within the fast axis first, then across
+    the slow axis (reference MPIHierarchicalAllgather: node-shared-memory
+    gather + cross-node Allgatherv, mpi_operations.cc:179-329).
+
+    Result rank order follows (outer, inner) mesh order."""
+    inner_gathered = lax.all_gather(x, inner_axis, tiled=True)
+    return lax.all_gather(inner_gathered, outer_axis, tiled=True)

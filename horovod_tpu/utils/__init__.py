@@ -1,0 +1,8 @@
+"""Utility layer: checkpoint/resume helpers (orbax-backed, reference
+broadcast-consistency contract)."""
+
+from .checkpoint import (  # noqa: F401
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)

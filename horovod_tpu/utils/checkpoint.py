@@ -1,0 +1,70 @@
+"""Checkpoint/resume helpers.
+
+The reference has no checkpointing in core; its contract is a *pattern*
+(SURVEY.md §5): rank 0 saves framework-native checkpoints, and on resume
+every rank restores consistency by broadcasting state from rank 0
+(``BroadcastGlobalVariablesHook``, ``broadcast_parameters``/
+``broadcast_optimizer_state``, e.g. ``examples/pytorch_imagenet_resnet50.py``).
+
+Same contract here with the TPU-native storage layer (orbax):
+``save_checkpoint`` writes on rank 0 only; ``restore_checkpoint`` loads
+everywhere and — in eager multi-process mode — re-broadcasts from root so a
+rank that read a stale/partial file cannot diverge.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from ..common import basics
+from ..common import hvd_logging as logging
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, tree: Any, root_rank: int = 0,
+                    force: bool = True) -> None:
+    """Write ``tree`` at ``path`` from ``root_rank`` only (the reference's
+    rank-0-saves pattern). No-op on other ranks; all ranks may call it."""
+    st = basics.state()
+    if st.topology.rank != root_rank:
+        return
+    path = os.path.abspath(path)
+    _checkpointer().save(path, tree, force=force)
+    logging.debug("saved checkpoint at %s", path)
+
+
+def restore_checkpoint(path: str, like: Optional[Any] = None,
+                       root_rank: int = 0, broadcast: bool = True) -> Any:
+    """Restore a pytree; with ``broadcast`` (default) and a multi-process
+    job, root's restored values are re-broadcast so every rank resumes
+    identically — the reference's consistency contract."""
+    path = os.path.abspath(path)
+    restored = _checkpointer().restore(path, item=like)
+    st = basics.state()
+    if broadcast and st.topology.size > 1:
+        from ..jax import broadcast_parameters
+
+        restored = broadcast_parameters(restored, root_rank=root_rank)
+    return restored
+
+
+def latest_checkpoint(directory: str, prefix: str = "ckpt_") -> Optional[str]:
+    """Newest ``<directory>/<prefix><step>`` path, or None."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        if name.startswith(prefix):
+            try:
+                step = int(name[len(prefix):])
+            except ValueError:
+                continue
+            if step > best_step:
+                best, best_step = os.path.join(directory, name), step
+    return best

@@ -114,6 +114,36 @@ def scenario_error_mismatch(rank, size):
     else:
         raise AssertionError("mismatched dtypes did not raise")
 
+    # broadcast root mismatch (reference test_horovod_broadcast_rank_error).
+    try:
+        hvd.broadcast(np.ones(3, np.float32), root_rank=rank % size,
+                      name="bad.root")
+    except RuntimeError as exc:
+        expect("Mismatched broadcast root ranks" in str(exc),
+               f"wrong error: {exc}")
+    else:
+        raise AssertionError("mismatched roots did not raise")
+
+    # allgather rank (ndim) mismatch.
+    xg = np.ones((2,) * (rank + 1), dtype=np.float32)
+    try:
+        hvd.allgather(xg, name="bad.gather.rank")
+    except RuntimeError as exc:
+        expect("Mismatched allgather tensor ranks" in str(exc),
+               f"wrong error: {exc}")
+    else:
+        raise AssertionError("mismatched allgather ndims did not raise")
+
+    # allgather trailing-dim mismatch.
+    xg2 = np.ones((2, 2 + rank), dtype=np.float32)
+    try:
+        hvd.allgather(xg2, name="bad.gather.shape")
+    except RuntimeError as exc:
+        expect("Mismatched allgather tensor shapes" in str(exc),
+               f"wrong error: {exc}")
+    else:
+        raise AssertionError("mismatched allgather dims did not raise")
+
     # After errors, the controller must still work.
     ok = np.asarray(hvd.allreduce(np.ones(3, np.float32), average=False,
                                   name="good.after"))

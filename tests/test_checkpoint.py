@@ -1,0 +1,33 @@
+"""Checkpoint utilities (orbax-backed, rank-0-saves contract)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.utils import (
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    hvd.init()
+    tree = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "opt": {"mu": jnp.ones(4)}}
+    path = str(tmp_path / "ckpt_100")
+    save_checkpoint(path, tree)
+    restored = restore_checkpoint(path, like=tree)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(tree["w"]))
+    np.testing.assert_array_equal(np.asarray(restored["opt"]["mu"]),
+                                  np.asarray(tree["opt"]["mu"]))
+
+
+def test_latest_checkpoint(tmp_path):
+    hvd.init()
+    assert latest_checkpoint(str(tmp_path)) is None
+    for step in (10, 200, 30):
+        save_checkpoint(str(tmp_path / f"ckpt_{step}"), {"x": jnp.ones(1)})
+    latest = latest_checkpoint(str(tmp_path))
+    assert latest is not None and latest.endswith("ckpt_200")

@@ -1,0 +1,65 @@
+"""Spark integration — rendezvous logic without a Spark cluster.
+
+The reference's test (``test/test_spark.py``) runs local Spark; pyspark is
+not installed here, so the driver service + assignment logic (everything
+except the ``sc.parallelize`` call) is tested with threads standing in for
+executors."""
+
+import threading
+
+import pytest
+
+from horovod_tpu.spark.driver import (
+    SparkDriverService,
+    compute_assignments,
+    register_task,
+)
+
+
+def test_compute_assignments_host_grouping():
+    regs = [
+        {"index": 0, "host": "a", "ring_port": 10, "controller_port": 20},
+        {"index": 1, "host": "b", "ring_port": 11, "controller_port": 21},
+        {"index": 2, "host": "a", "ring_port": 12, "controller_port": 22},
+        {"index": 3, "host": "b", "ring_port": 13, "controller_port": 23},
+    ]
+    out = compute_assignments(regs)
+    assert [a["rank"] for a in out] == [0, 1, 2, 3]
+    assert [a["local_rank"] for a in out] == [0, 0, 1, 1]
+    assert all(a["local_size"] == 2 for a in out)
+    assert [a["cross_rank"] for a in out] == [0, 1, 0, 1]
+    assert all(a["cross_size"] == 2 for a in out)
+    assert out[0]["controller_addr"] == "a:20"
+    assert out[0]["ring_addrs"] == "a:10,b:11,a:12,b:13"
+    assert all(a["secret"] == out[0]["secret"] for a in out)
+
+
+def test_driver_service_round_trip():
+    num = 3
+    driver = SparkDriverService(num, timeout=30.0)
+    addr = f"127.0.0.1:{driver.port}"
+    results = {}
+
+    def worker(i):
+        results[i] = register_task(addr, i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(num)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    driver.join()
+    assert set(results) == {0, 1, 2}
+    assert all(results[i]["rank"] == i for i in range(num))
+    assert all(results[i]["size"] == num for i in range(num))
+    # All on one host here: local ranks = global ranks.
+    assert all(results[i]["local_rank"] == i for i in range(num))
+    assert results[0]["controller_addr"].endswith(
+        str(results[0]["controller_addr"].rsplit(":", 1)[1]))
+
+
+def test_spark_run_requires_pyspark():
+    import horovod_tpu.spark as hs
+
+    with pytest.raises((ImportError, RuntimeError)):
+        hs.run(lambda: None)
