@@ -1,0 +1,77 @@
+"""Allreduce bus-bandwidth benchmark (one of BASELINE.md's tracked metrics).
+
+Two modes, chosen automatically:
+  * size() == 1 (no launcher): SPMD-tier psum over the local device mesh —
+    the ICI path used by training.
+  * size() > 1 (under horovodrun): eager-tier fused allreduce through the
+    controller + native C++ ring — the host-tensor path.
+
+Bus bandwidth uses the standard convention: 2*(N-1)/N * bytes / time.
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def spmd_mode(args):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.parallel.mesh()
+    n = hvd.local_num_devices()
+    elems = args.size_mb * (1 << 20) // 4
+    x = hvd.parallel.shard_batch(
+        jnp.ones((n, elems // n), jnp.float32), mesh)
+    f = jax.jit(jax.shard_map(
+        lambda t: hvd.allreduce(t, average=False),
+        mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False))
+    out = f(x)
+    _ = np.asarray(out[0, 0])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = f(out)
+    _ = np.asarray(out[0, 0])
+    dt = (time.perf_counter() - t0) / args.iters
+    bus = 2 * (n - 1) / max(n, 1) * elems * 4 / dt if n > 1 else elems * 4 / dt
+    print(f"SPMD psum {args.size_mb} MiB over {n} device(s): "
+          f"{dt * 1e3:.2f} ms, bus bandwidth {bus / 1e9:.2f} GB/s")
+
+
+def eager_mode(args):
+    elems = args.size_mb * (1 << 20) // 4
+    x = np.ones(elems, np.float32) * hvd.rank()
+    # warmup + correctness
+    out = np.asarray(hvd.allreduce(x, average=False, name="bw.warm"))
+    expected = sum(range(hvd.size()))
+    assert abs(float(out[0]) - expected) < 1e-3, out[0]
+    t0 = time.perf_counter()
+    for i in range(args.iters):
+        hvd.allreduce(x, average=False, name=f"bw.{i}")
+    dt = (time.perf_counter() - t0) / args.iters
+    n = hvd.size()
+    bus = 2 * (n - 1) / n * elems * 4 / dt
+    if hvd.rank() == 0:
+        print(f"eager ring allreduce {args.size_mb} MiB over {n} ranks: "
+              f"{dt * 1e3:.2f} ms, bus bandwidth {bus / 1e9:.2f} GB/s")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size-mb", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+    hvd.init()
+    if hvd.size() > 1:
+        eager_mode(args)
+    else:
+        spmd_mode(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""Decoder-only (Llama-style) LM training benchmark.
+
+BASELINE.json lists "Llama-3-8B — stress fused allreduce at LLM gradient
+sizes" among the target configs; this script runs the same shape of workload
+at any size:
+
+    python examples/jax_llama_training.py --model tiny --seq-len 256
+    python examples/jax_llama_training.py --model 1b --seq-len 2048
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import LLAMA_1B, LLAMA_8B, LLAMA_TINY, LlamaLM, causal_lm_loss
+from horovod_tpu.ops.attention import make_attention_fn
+
+CONFIGS = {"tiny": LLAMA_TINY, "1b": LLAMA_1B, "8b": LLAMA_8B}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", choices=list(CONFIGS), default="tiny")
+    parser.add_argument("--seq-len", type=int, default=256)
+    parser.add_argument("--batch-size", type=int, default=4,
+                        help="per-chip batch")
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--no-flash", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    mesh = hvd.parallel.mesh()
+    n = hvd.local_num_devices()
+    cfg = CONFIGS[args.model]
+
+    attention_fn = None if args.no_flash else make_attention_fn(
+        causal=True, block_q=min(128, args.seq_len),
+        block_k=min(128, args.seq_len))
+    model = LlamaLM(cfg, attention_fn=attention_fn)
+
+    batch = args.batch_size * n
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, args.seq_len)), jnp.int32)
+
+    params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(3e-4), axis_name="data")
+    opt_state = tx.init(params)
+
+    def loss_fn(p, ids):
+        return causal_lm_loss(model.apply({"params": p}, ids), ids)
+
+    def train_step(p, s, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, hvd.allreduce(loss)
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P("data")), out_specs=(P(), P(), P()),
+        check_vma=False,
+    ), donate_argnums=(0, 1))
+
+    ids_s = hvd.parallel.shard_batch(ids, mesh)
+    params = hvd.parallel.replicate(params, mesh)
+    opt_state = hvd.parallel.replicate(opt_state, mesh)
+
+    params, opt_state, loss = step(params, opt_state, ids_s)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, loss = step(params, opt_state, ids_s)
+    float(loss)
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        tok_per_sec = batch * args.seq_len * args.num_iters / dt
+        print(f"llama-{args.model} seq={args.seq_len}: "
+              f"{tok_per_sec:.0f} tokens/sec ({tok_per_sec / n:.0f}/chip), "
+              f"loss={float(loss):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,14 @@ from .bert import (  # noqa: F401
     BertEncoder,
     mlm_loss,
 )
+from .llama import (  # noqa: F401
+    LLAMA_1B,
+    LLAMA_8B,
+    LLAMA_TINY,
+    LlamaConfig,
+    LlamaLM,
+    causal_lm_loss,
+)
 from .mlp import MnistMLP  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet,

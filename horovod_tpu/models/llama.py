@@ -1,0 +1,144 @@
+"""Decoder-only transformer LM (Llama-style: RMSNorm, rotary embeddings,
+SwiGLU, grouped-query attention).
+
+No reference-repo equivalent (2019-era); required by the rebuild's target
+workloads (BASELINE.json config "Llama-3-8B — stress fused allreduce at LLM
+gradient sizes"). TPU-first: bf16 activations / fp32 params, einsum
+attention with the same ``attention_fn`` seam as BERT (flash / ring
+attention plug in), static shapes, GQA K/V repeated to full heads before the
+kernel (cheap under XLA fusion).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    ffn_hidden: int = 14336
+    rope_theta: float = 500000.0
+    max_seq_len: int = 8192
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+
+LLAMA_8B = LlamaConfig()
+LLAMA_1B = LlamaConfig(dim=2048, num_layers=16, num_heads=32, num_kv_heads=8,
+                       ffn_hidden=8192)
+LLAMA_TINY = LlamaConfig(vocab_size=512, dim=64, num_layers=2, num_heads=4,
+                         num_kv_heads=2, ffn_hidden=128, max_seq_len=256)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           jnp.float32)
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jnp.reciprocal(
+            jnp.sqrt(jnp.mean(x32 ** 2, axis=-1, keepdims=True) + self.eps))
+        return (norm * scale).astype(self.dtype)
+
+
+def rotary_embedding(x, theta: float):
+    """Apply RoPE to (B, S, H, D)."""
+    b, s, h, d = x.shape
+    half = d // 2
+    freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
+    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    x32_1, x32_2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate(
+        [x32_1 * cos - x32_2 * sin, x32_1 * sin + x32_2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        head_dim = cfg.dim // cfg.num_heads
+        dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            features=(heads, head_dim), axis=-1, use_bias=False,
+            dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        q = rotary_embedding(dense(cfg.num_heads, "wq")(x), cfg.rope_theta)
+        k = rotary_embedding(dense(cfg.num_kv_heads, "wk")(x), cfg.rope_theta)
+        v = dense(cfg.num_kv_heads, "wv")(x)
+        if cfg.num_kv_heads != cfg.num_heads:
+            rep = cfg.num_heads // cfg.num_kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        if self.attention_fn is not None:
+            ctx = self.attention_fn(q, k, v, None)
+        else:
+            from ..ops.attention import reference_attention
+
+            ctx = reference_attention(q, k, v, causal=True)
+        return nn.DenseGeneral(features=cfg.dim, axis=(-2, -1),
+                               use_bias=False, dtype=cfg.dtype,
+                               param_dtype=jnp.float32, name="wo")(ctx)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x + LlamaAttention(cfg, attention_fn=self.attention_fn,
+                               name="attention")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x))
+        h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
+        dense = lambda f, name: nn.Dense(  # noqa: E731
+            f, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
+            name=name)
+        gated = nn.silu(dense(cfg.ffn_hidden, "w_gate")(h)) * \
+            dense(cfg.ffn_hidden, "w_up")(h)
+        return x + dense(cfg.dim, "w_down")(gated)
+
+
+class LlamaLM(nn.Module):
+    """Causal LM: embeddings + blocks + tied-free output head."""
+
+    config: LlamaConfig
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
+                     name="tok_embeddings")(input_ids).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = LlamaBlock(cfg, attention_fn=self.attention_fn,
+                           name=f"layer_{i}")(x)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="lm_head")(x)
+
+
+def causal_lm_loss(logits, input_ids):
+    """Next-token cross entropy (shifted)."""
+    logp = nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    targets = input_ids[:, 1:]
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -ll.mean()

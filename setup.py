@@ -1,0 +1,35 @@
+"""Build integration for the native core.
+
+The reference's ``setup.py`` is a 1000-line feature-probing build (CUDA/NCCL/
+framework ABI detection, ``HOROVOD_GPU_ALLREDUCE=`` option matrix,
+``setup.py:391-502``). None of that machinery is needed on TPU: the native
+core is dependency-free C++17 compiled with the system g++, and the XLA data
+plane needs no compilation at all. Building here is therefore just "compile
+``horovod_tpu/core/src`` into the package"; the library also self-builds on
+first import (``horovod_tpu/core/bindings.py``), so installation without a
+compiler still works — the controller falls back to the Python star data
+plane.
+"""
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNativeCore(build_py):
+    def run(self):
+        super().run()
+        try:
+            import os
+            import sys
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from horovod_tpu.core.bindings import build
+
+            lib = build()
+            print(f"built native core: {lib}")
+        except Exception as exc:  # non-fatal: runtime fallback exists
+            print(f"warning: native core not built ({exc}); the Python "
+                  "data plane will be used until g++ is available")
+
+
+setup(cmdclass={"build_py": BuildWithNativeCore})
