@@ -81,13 +81,30 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         topology = detect(ranks)
         logging.set_rank(topology.rank)
         _state = HorovodTpuState(config, topology)
-        if config.timeline_filename and topology.rank == 0:
+        # Engine selection for the multi-process eager tier: the native C++
+        # engine (negotiation + fusion + cache + timeline in engine.cc over
+        # the TCP ring) is the default whenever the launcher exported ring
+        # addresses; HOROVOD_ENGINE=python (or the star data plane) keeps the
+        # Python controller. The choice must be identical on every rank —
+        # both derive from launcher-exported env, so it is.
+        from .config import ring_data_plane_enabled
+
+        engine = os.environ.get("HOROVOD_ENGINE")
+        if engine is None:
+            engine = "native" if ring_data_plane_enabled() else "python"
+        use_native = topology.size > 1 and engine == "native"
+        if config.timeline_filename and topology.rank == 0 and not use_native:
+            # Native engine writes the timeline itself (C++ writer thread).
             from .timeline import Timeline
 
             _state.timeline = Timeline(config.timeline_filename,
                                        mark_cycles=config.timeline_mark_cycles)
-        if topology.size > 1 and os.environ.get("HOROVOD_CONTROLLER_ADDR"):
-            # Multi-process eager tier: bring up the TCP control plane.
+        if use_native:
+            from ..controller.native import NativeController
+
+            _state.controller = NativeController(config, topology)
+        elif topology.size > 1 and os.environ.get("HOROVOD_CONTROLLER_ADDR"):
+            # Python controller over the TCP star.
             from ..controller.controller import Controller
 
             _state.controller = Controller(config, topology,

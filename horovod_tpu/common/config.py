@@ -26,6 +26,16 @@ DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_CHECK_SECONDS = 60.0
 
 
+def ring_data_plane_enabled() -> bool:
+    """True when the launcher exported per-rank ring addresses and the
+    operator did not force the pure-Python star data plane. The single
+    source of truth for both engine selection (basics.init) and the Python
+    controller's ring construction — the predicate must be identical on
+    every rank, and both sites must agree."""
+    return bool(os.environ.get("HOROVOD_RING_ADDRS")) and \
+        os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"
+
+
 def _env_bool(name: str, default: bool = False) -> bool:
     val = os.environ.get(name)
     if val is None:

@@ -36,7 +36,7 @@ import numpy as np
 
 from ..common import hvd_logging as logging
 from ..common import timeline as tl
-from ..common.config import Config
+from ..common.config import Config, ring_data_plane_enabled
 from ..common.handles import Handle, HandleManager
 from ..common.message import (
     Request,
@@ -115,8 +115,7 @@ class Controller:
         # identical on every rank or the lockstep data phases deadlock.
         self._ring = None
         ring_addrs = os.environ.get("HOROVOD_RING_ADDRS")
-        if (topology.size > 1 and ring_addrs
-                and os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"):
+        if topology.size > 1 and ring_data_plane_enabled():
             from ..common.wire import job_secret
             from ..core.bindings import RingBackend
 

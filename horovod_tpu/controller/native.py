@@ -1,0 +1,294 @@
+"""Native controller: the eager tier running entirely in the C++ engine.
+
+The Python ``Controller`` (controller.py) keeps the negotiation/fusion/cache
+machine in Python over a TCP star. This twin drives the C++ engine
+(``core/src/engine.cc``) instead, the way the reference's Python layer drives
+``horovod/common/operations.cc`` over ctypes (``common/basics.py:20-28``):
+enqueue copies the host buffer into the engine, the engine's background
+thread negotiates/fuses/executes over the authenticated TCP ring (control
+token + data phases on the same connections), and completion surfaces
+through int handles (reference ``torch/handle_manager.h``).
+
+Python keeps the parts that belong to the API layer, exactly as the
+reference does: averaging as a post-divide (``torch/mpi_ops_v2.cc:66-72``),
+compression round-trips (``torch/compression.py``), and the GP autotuner
+(the coordinator samples engine cycle stats and pushes tuned parameters
+down, reference ``SyncParams`` ``parameter_manager.cc:223``).
+
+Selected by ``HOROVOD_ENGINE=native`` (the default when the launcher
+exported ring addresses); ``HOROVOD_ENGINE=python`` keeps the Python
+controller (and is implied by ``HOROVOD_CPU_OPS=star``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..common import hvd_logging as logging
+from ..common.config import Config
+from ..common.topology import Topology
+from ..common.wire import job_secret
+from ..core import bindings
+
+_OP_CODES = {"allreduce": 0, "allgather": 1, "broadcast": 2}
+
+_SHUTDOWN_MSG = "Horovod has been shut down"
+
+
+class NativeHandle:
+    """Handle over an engine operation. API-compatible with
+    ``common.handles.Handle`` (wait/done), so ``hvd.synchronize``/``poll``
+    work unchanged."""
+
+    __slots__ = ("_ctl", "_id", "_postprocess", "_result", "_error", "_taken")
+
+    def __init__(self, ctl: "NativeController", handle_id: int,
+                 postprocess: Optional[Callable[[np.ndarray], Any]]):
+        self._ctl = ctl
+        self._id = handle_id
+        self._postprocess = postprocess
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._taken = False
+
+    @classmethod
+    def failed(cls, exc: BaseException) -> "NativeHandle":
+        h = cls.__new__(cls)
+        h._ctl = None
+        h._id = -1
+        h._postprocess = None
+        h._result = None
+        h._error = exc
+        h._taken = True
+        return h
+
+    def done(self) -> bool:
+        if self._taken:
+            return True
+        return self._ctl._lib.hvd_eng_poll(self._id) != 0
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self._taken:
+            self._take(timeout)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _take(self, timeout: Optional[float]) -> None:
+        lib = self._ctl._lib
+        # ctypes releases the GIL while these block.
+        if timeout is None:
+            rc = lib.hvd_eng_wait(self._id)
+        else:
+            rc = lib.hvd_eng_wait_for(self._id, float(timeout))
+            if rc == -2:
+                raise TimeoutError(
+                    f"handle {self._id} not complete after {timeout}s")
+        try:
+            if rc == 0:
+                ndim = lib.hvd_eng_result_ndim(self._id)
+                shape_arr = (ctypes.c_longlong * max(ndim, 1))()
+                lib.hvd_eng_result_shape(self._id, shape_arr)
+                shape = tuple(shape_arr[i] for i in range(ndim))
+                dtype = bindings.dtype_from_code(
+                    lib.hvd_eng_result_dtype(self._id))
+                out = np.empty(shape, dtype=dtype)
+                if out.nbytes:
+                    lib.hvd_eng_result_copy(
+                        self._id, out.ctypes.data_as(ctypes.c_void_p))
+                if self._postprocess is not None:
+                    out = self._postprocess(out)
+                self._result = out
+            else:
+                msg = lib.hvd_eng_handle_error(self._id).decode(
+                    errors="replace")
+                if _SHUTDOWN_MSG in msg:
+                    from .controller import ShutdownError
+
+                    self._error = ShutdownError(msg)
+                else:
+                    self._error = RuntimeError(msg)
+        finally:
+            lib.hvd_eng_release(self._id)
+            self._taken = True
+
+
+class NativeController:
+    """Same public surface as ``controller.Controller``, backed by the C++
+    engine."""
+
+    def __init__(self, config: Config, topology: Topology):
+        lib = bindings.load()
+        if lib is None:
+            raise RuntimeError("native engine unavailable (toolchain absent)")
+        self._lib = lib
+        self.cfg = config
+        self.topo = topology
+        self._lock = threading.Lock()
+        self._autoname_counter: Dict[str, int] = {}
+        self._shut = False
+
+        ring_addrs = os.environ.get("HOROVOD_RING_ADDRS", "")
+        if topology.size > 1 and not ring_addrs:
+            raise RuntimeError(
+                "native engine requires HOROVOD_RING_ADDRS (exported by "
+                "horovodrun); set HOROVOD_ENGINE=python to use the TCP star")
+        secret = job_secret()
+        key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
+        timeline = (config.timeline_filename or "") if topology.rank == 0 else ""
+        rc = lib.hvd_eng_init(
+            topology.rank, topology.size, ring_addrs.encode(), key,
+            len(secret), config.cycle_time_ms, config.fusion_threshold_bytes,
+            config.cache_capacity, 1 if config.stall_check_disable else 0,
+            config.stall_check_seconds, config.stall_shutdown_seconds,
+            timeline.encode(), 1 if config.timeline_mark_cycles else 0)
+        if rc != 0:
+            raise RuntimeError(
+                "native engine init failed: "
+                + lib.hvd_eng_last_error().decode(errors="replace"))
+
+        # Coordinator-side autotuner: sample engine throughput, retune with
+        # the GP, push parameters into the engine (reference ParameterManager
+        # scoring bytes/sec, parameter_manager.cc:155-223; fusion threshold
+        # and cycle pacing both live on the coordinator in the token design).
+        self._tuner_stop = threading.Event()
+        self._tuner = None
+        if config.autotune and topology.rank == 0:
+            from .autotune_glue import make_parameter_manager
+
+            self._param_manager = make_parameter_manager(config)
+            self._tuner = threading.Thread(
+                target=self._tune_loop, name="hvd-native-autotune",
+                daemon=True)
+            self._tuner.start()
+
+    # ------------------------------------------------------------------ API
+
+    def _autoname(self, kind: str, name: Optional[str]) -> str:
+        if name is not None:
+            return name
+        with self._lock:
+            n = self._autoname_counter.get(kind, 0)
+            self._autoname_counter[kind] = n + 1
+        return f"{kind}.noname.{n}"
+
+    def _enqueue(self, kind: str, name: Optional[str], array,
+                 root_rank: int = -1,
+                 postprocess: Optional[Callable] = None) -> NativeHandle:
+        name = self._autoname(kind, name)
+        array = np.asarray(array)
+        if not array.flags.c_contiguous:
+            # ascontiguousarray promotes 0-d to 1-d; preserve the shape.
+            array = np.ascontiguousarray(array).reshape(array.shape)
+        code = bindings.RingBackend.dtype_code(array.dtype)
+        if code is None:
+            return NativeHandle.failed(RuntimeError(
+                f"dtype {array.dtype} is not supported by the native engine "
+                "(supported: float32/float64/int32/int64/uint8/float16/"
+                "bfloat16); set HOROVOD_ENGINE=python for arbitrary dtypes"))
+        shape = (ctypes.c_longlong * max(array.ndim, 1))(*array.shape)
+        h = self._lib.hvd_eng_enqueue(
+            _OP_CODES[kind], name.encode(),
+            array.ctypes.data_as(ctypes.c_void_p), shape, array.ndim, code,
+            root_rank)
+        if h == -2:
+            return NativeHandle.failed(RuntimeError(
+                f"Duplicate tensor name {name!r}: a collective with this "
+                "name is already pending; names must be unique until the "
+                "operation completes."))
+        if h < 0:
+            from .controller import ShutdownError
+
+            return NativeHandle.failed(ShutdownError(_SHUTDOWN_MSG))
+        return NativeHandle(self, h, postprocess)
+
+    def allreduce_async(self, tensor, average: bool = True,
+                        name: Optional[str] = None, compression=None,
+                        wrap: Optional[Callable] = None) -> NativeHandle:
+        array = np.asarray(tensor)
+        ctx = None
+        if compression is not None:
+            compressed, ctx = compression.compress(array)
+            array = np.asarray(compressed)
+        size = self.topo.size
+
+        def post(out, _ctx=ctx, _compression=compression):
+            if _compression is not None:
+                out = np.asarray(_compression.decompress(out, _ctx))
+            if average:
+                out = out / size
+            return wrap(out) if wrap is not None else out
+
+        return self._enqueue("allreduce", name, array, postprocess=post)
+
+    def allgather_async(self, tensor, name: Optional[str] = None,
+                        wrap: Optional[Callable] = None) -> NativeHandle:
+        return self._enqueue("allgather", name, np.asarray(tensor),
+                             postprocess=wrap)
+
+    def broadcast_async(self, tensor, root_rank: int,
+                        name: Optional[str] = None,
+                        wrap: Optional[Callable] = None) -> NativeHandle:
+        return self._enqueue("broadcast", name, np.asarray(tensor),
+                             root_rank=root_rank, postprocess=wrap)
+
+    def allreduce(self, tensor, average: bool = True,
+                  name: Optional[str] = None, compression=None,
+                  wrap: Optional[Callable] = None):
+        return self.allreduce_async(tensor, average, name, compression,
+                                    wrap=wrap).wait()
+
+    def allgather(self, tensor, name: Optional[str] = None,
+                  wrap: Optional[Callable] = None):
+        return self.allgather_async(tensor, name, wrap=wrap).wait()
+
+    def broadcast(self, tensor, root_rank: int, name: Optional[str] = None,
+                  wrap: Optional[Callable] = None):
+        return self.broadcast_async(tensor, root_rank, name, wrap=wrap).wait()
+
+    def reducescatter(self, tensor, average: bool = True):
+        raise NotImplementedError(
+            "reducescatter is an SPMD-tier extension; use it inside "
+            "jit/shard_map (the reference has no eager reducescatter either)")
+
+    def alltoall(self, tensor):
+        raise NotImplementedError(
+            "alltoall is an SPMD-tier extension; use it inside jit/shard_map")
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _tune_loop(self) -> None:
+        cycles = ctypes.c_longlong()
+        nbytes = ctypes.c_longlong()
+        busy = ctypes.c_double()
+        last_bytes, last_busy = 0, 0.0
+        # Sample fast enough that short bursts of traffic still yield the
+        # warmup+scoring sample count before the job ends.
+        while not self._tuner_stop.wait(0.01):
+            self._lib.hvd_eng_get_stats(
+                ctypes.byref(cycles), ctypes.byref(nbytes), ctypes.byref(busy))
+            delta_bytes = nbytes.value - last_bytes
+            delta_busy = busy.value - last_busy
+            last_bytes, last_busy = nbytes.value, busy.value
+            if delta_bytes <= 0 or delta_busy <= 0:
+                continue
+            tuned = self._param_manager.record(delta_bytes, delta_busy)
+            if tuned is not None:
+                threshold, cycle_ms = tuned
+                self._lib.hvd_eng_set_params(int(threshold), float(cycle_ms))
+                logging.debug("native autotune: threshold=%d cycle=%.2fms",
+                              int(threshold), float(cycle_ms))
+
+    def shutdown(self) -> None:
+        if self._shut:
+            return
+        self._shut = True
+        self._tuner_stop.set()
+        if self._tuner is not None:
+            self._tuner.join(timeout=2.0)
+        self._lib.hvd_eng_shutdown()

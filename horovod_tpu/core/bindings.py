@@ -37,6 +37,16 @@ _DTYPE_CODES = {
     "float16": 5,
     "bfloat16": 6,
 }
+_DTYPE_NAMES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+def dtype_from_code(code: int) -> np.dtype:
+    name = _DTYPE_NAMES[code]
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
 
 
 def _needs_build() -> bool:
@@ -53,9 +63,12 @@ def build() -> str:
     """Compile the native core (idempotent, mtime-cached)."""
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if _needs_build():
+        sources = sorted(
+            os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
+            if f.endswith(".cc"))
         cmd = [
-            "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-            os.path.join(_SRC_DIR, "ring.cc"),
+            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+            *sources,
             "-o", _LIB_PATH,
         ]
         logging.debug("building native core: %s", " ".join(cmd))
@@ -100,6 +113,49 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_ring_broadcast.restype = ctypes.c_int
         lib.hvd_ring_last_error.restype = ctypes.c_char_p
         lib.hvd_ring_shutdown.restype = None
+        # Native eager-tier engine (engine.cc; reference C ABI shape at
+        # horovod/common/operations.cc:1595-1650).
+        lib.hvd_eng_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_double,
+            ctypes.c_longlong, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_eng_init.restype = ctypes.c_int
+        lib.hvd_eng_enqueue.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
+        lib.hvd_eng_enqueue.restype = ctypes.c_longlong
+        lib.hvd_eng_poll.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_poll.restype = ctypes.c_int
+        lib.hvd_eng_wait.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_wait.restype = ctypes.c_int
+        lib.hvd_eng_wait_for.argtypes = [ctypes.c_longlong, ctypes.c_double]
+        lib.hvd_eng_wait_for.restype = ctypes.c_int
+        lib.hvd_eng_result_nbytes.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_result_nbytes.restype = ctypes.c_longlong
+        lib.hvd_eng_result_ndim.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_result_ndim.restype = ctypes.c_int
+        lib.hvd_eng_result_dtype.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_result_dtype.restype = ctypes.c_int
+        lib.hvd_eng_result_shape.argtypes = [
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_eng_result_shape.restype = None
+        lib.hvd_eng_result_copy.argtypes = [ctypes.c_longlong, ctypes.c_void_p]
+        lib.hvd_eng_result_copy.restype = ctypes.c_int
+        lib.hvd_eng_handle_error.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_handle_error.restype = ctypes.c_char_p
+        lib.hvd_eng_release.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_release.restype = None
+        lib.hvd_eng_set_params.argtypes = [ctypes.c_longlong, ctypes.c_double]
+        lib.hvd_eng_set_params.restype = None
+        lib.hvd_eng_get_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_double)]
+        lib.hvd_eng_get_stats.restype = None
+        lib.hvd_eng_shutdown.restype = ctypes.c_int
+        lib.hvd_eng_last_error.restype = ctypes.c_char_p
         _lib = lib
         return _lib
 

@@ -1,0 +1,1012 @@
+// Native eager-tier engine: background coordinator thread, negotiation,
+// Tensor Fusion, response cache with bitvector coordination, stall detection
+// and the Chrome-trace timeline — the C++ runtime around the ring data plane.
+//
+// Reference: horovod/common/operations.cc — a singleton HorovodGlobalState
+// owns a background thread (BackgroundThreadLoop, operations.cc:857) that
+// ticks every cycle_time_ms (RunLoopOnce, operations.cc:1246), drains the
+// request queue, negotiates globally-ready tensors, packs fusion groups
+// (FuseResponses, operations.cc:450-573), executes collectives and fires
+// completion callbacks; a bit-indexed response cache short-circuits repeat
+// negotiations (operations.cc:1166-1381) and the coordinator warns/aborts on
+// stalled ranks (operations.cc:688-769).
+//
+// Same machine, different transport: where the reference runs negotiation as
+// MPI_Gatherv/Bcast among host processes and the data plane on MPI/NCCL,
+// this engine circulates a control token around the authenticated TCP ring
+// (ring.cc) — rank 0 starts a token carrying its RequestList + cache
+// bitvectors, every rank appends its own, rank 0 receives the full set,
+// negotiates, and sends the fused ResponseList around the same ring. Data
+// phases then run as ring collectives in ResponseList order, which is
+// identical on every rank (the invariant the negotiation establishes).
+// Python half: horovod_tpu/controller/native.py over the C ABI below (the
+// reference exposes its C ABI the same way, operations.cc:1595-1650).
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "message.h"
+#include "response_cache.h"
+#include "timeline.h"
+
+// Ring data plane C ABI (ring.cc).
+extern "C" {
+int hvd_ring_init(int rank, int size, const char* addrs, const uint8_t* secret,
+                  int secret_len);
+int hvd_ring_allreduce(void* buf, long count, int dtype, int average);
+int hvd_ring_allgather(const void* in, const long* counts, void* out,
+                       int dtype);
+int hvd_ring_broadcast(void* buf, long count, int dtype, int root);
+int hvd_ring_send_right(const void* buf, long n);
+int hvd_ring_recv_left(void* buf, long n);
+void hvd_ring_shutdown();
+const char* hvd_ring_last_error();
+}
+
+namespace hvd {
+
+// numpy-style names for ring.cc DType codes (error-message parity with the
+// Python controller's construct_response).
+std::string dtype_name(uint8_t code) {
+  switch (code) {
+    case 0: return "float32";
+    case 1: return "float64";
+    case 2: return "int32";
+    case 3: return "int64";
+    case 4: return "uint8";
+    case 5: return "float16";
+    case 6: return "bfloat16";
+  }
+  return "dtype#" + std::to_string((int)code);
+}
+
+namespace {
+
+size_t dtype_size(uint8_t dt) {
+  switch (dt) {
+    case 0: case 2: return 4;
+    case 1: case 3: return 8;
+    case 4: return 1;
+    case 5: case 6: return 2;
+  }
+  return 0;
+}
+
+double mono_s() {
+  return std::chrono::duration_cast<std::chrono::duration<double>>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+constexpr const char* kShutdownMsg = "Horovod has been shut down";
+
+const char* op_name(uint8_t t) {
+  switch (t) {
+    case RESP_ALLREDUCE: return "ALLREDUCE";
+    case RESP_ALLGATHER: return "ALLGATHER";
+    case RESP_BROADCAST: return "BROADCAST";
+  }
+  return "ERROR";
+}
+
+// Async-op handle slot (reference torch/handle_manager.h:31-42).
+struct HandleSlot {
+  int status = 0;  // 0 pending, 1 ok, 2 error
+  std::string error;
+  uint8_t dtype = 0;
+  std::vector<int64_t> shape;
+  std::vector<uint8_t> data;
+};
+
+// Tensor-table entry (reference TensorTableEntry, common/common.h:167-184).
+struct Entry {
+  Request request;
+  std::vector<uint8_t> data;
+  long long handle = -1;
+};
+
+struct Tick {
+  int32_t rank = 0;
+  bool shutdown = false;
+  std::vector<uint64_t> cache_words;
+  std::vector<uint64_t> invalid_words;
+  std::vector<Request> requests;
+};
+
+struct Reply {
+  bool shutdown = false;
+  std::vector<uint64_t> bypass_words;
+  std::vector<uint64_t> invalid_words;
+  ResponseList responses;
+};
+
+void write_tick(Writer& w, const Tick& t) {
+  w.i32(t.rank);
+  w.u8(t.shutdown ? 1 : 0);
+  w.u64vec(t.cache_words);
+  w.u64vec(t.invalid_words);
+  w.u32((uint32_t)t.requests.size());
+  for (const auto& r : t.requests) write_request(w, r);
+}
+
+Tick read_tick(Reader& r) {
+  Tick t;
+  t.rank = r.i32();
+  t.shutdown = r.u8() != 0;
+  t.cache_words = r.u64vec();
+  t.invalid_words = r.u64vec();
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; i < n && r.ok; i++) t.requests.push_back(read_request(r));
+  return t;
+}
+
+void write_reply(Writer& w, const Reply& rep) {
+  w.u8(rep.shutdown ? 1 : 0);
+  w.u64vec(rep.bypass_words);
+  w.u64vec(rep.invalid_words);
+  w.u32((uint32_t)rep.responses.responses.size());
+  for (const auto& resp : rep.responses.responses) write_response(w, resp);
+}
+
+Reply read_reply(Reader& r) {
+  Reply rep;
+  rep.shutdown = r.u8() != 0;
+  rep.bypass_words = r.u64vec();
+  rep.invalid_words = r.u64vec();
+  uint32_t n = r.u32();
+  for (uint32_t i = 0; i < n && r.ok; i++)
+    rep.responses.responses.push_back(read_response(r));
+  rep.responses.shutdown = rep.shutdown;
+  return rep;
+}
+
+class EngineError : public std::runtime_error {
+ public:
+  using std::runtime_error::runtime_error;
+};
+
+// The engine singleton (reference HorovodGlobalState, global_state.h:44).
+class Engine {
+ public:
+  Engine(int rank, int size, double cycle_ms, long long fusion_threshold,
+         int cache_capacity, bool stall_disable, double stall_warn_s,
+         double stall_shutdown_s, const std::string& timeline_path,
+         bool timeline_mark_cycles)
+      : rank_(rank),
+        size_(size),
+        cycle_ms_(cycle_ms),
+        fusion_threshold_(fusion_threshold),
+        stall_disable_(stall_disable),
+        stall_warn_s_(stall_warn_s),
+        stall_shutdown_s_(stall_shutdown_s),
+        cache_(cache_capacity) {
+    if (!timeline_path.empty() && rank == 0)
+      timeline_ = std::make_unique<Timeline>(timeline_path,
+                                             timeline_mark_cycles);
+    thread_ = std::thread([this] { run_loop(); });
+  }
+
+  ~Engine() {
+    request_shutdown();
+    if (thread_.joinable()) thread_.join();
+    if (timeline_) timeline_->close();
+  }
+
+  // ------------------------------------------------------- enqueue (any thread)
+
+  // Returns handle >= 0; -2 duplicate name; -3 shut down.
+  long long enqueue(uint8_t op, const std::string& name, const void* data,
+                    const int64_t* shape, int ndim, uint8_t dtype,
+                    int32_t root_rank) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (closed_ || shutdown_requested_) return -3;
+    if (table_.count(name)) return -2;  // reference IncrementTensorCount dup
+    Entry e;
+    e.request.request_rank = rank_;
+    e.request.request_type = op;
+    e.request.dtype = dtype;
+    e.request.root_rank = root_rank;
+    e.request.shape.assign(shape, shape + ndim);
+    e.request.tensor_name = name;
+    size_t count = 1;
+    for (int i = 0; i < ndim; i++) count *= (size_t)shape[i];
+    size_t nbytes = count * dtype_size(dtype);
+    e.data.resize(nbytes);
+    if (nbytes) std::memcpy(e.data.data(), data, nbytes);
+    long long h = next_handle_++;
+    e.handle = h;
+    handles_.emplace(h, HandleSlot{});
+    table_.emplace(name, std::move(e));
+    queue_.push_back(name);
+    return h;
+  }
+
+  // -------------------------------------------------------- handles (any thread)
+
+  int poll(long long h) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = handles_.find(h);
+    if (it == handles_.end()) return -1;
+    return it->second.status;
+  }
+
+  int wait(long long h) {
+    std::unique_lock<std::mutex> lk(mu_);
+    for (;;) {
+      auto it = handles_.find(h);
+      if (it == handles_.end()) return -1;
+      if (it->second.status != 0) return it->second.status == 1 ? 0 : 1;
+      handle_cv_.wait(lk);
+    }
+  }
+
+  // 0 ok, 1 error, -1 unknown handle, -2 timed out.
+  int wait_for(long long h, double timeout_s) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::duration<double>(timeout_s);
+    for (;;) {
+      auto it = handles_.find(h);
+      if (it == handles_.end()) return -1;
+      if (it->second.status != 0) return it->second.status == 1 ? 0 : 1;
+      if (handle_cv_.wait_until(lk, deadline) == std::cv_status::timeout) {
+        auto it2 = handles_.find(h);
+        if (it2 != handles_.end() && it2->second.status != 0)
+          return it2->second.status == 1 ? 0 : 1;
+        return -2;
+      }
+    }
+  }
+
+  HandleSlot* slot(long long h) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = handles_.find(h);
+    return it == handles_.end() ? nullptr : &it->second;
+  }
+
+  void release(long long h) {
+    std::lock_guard<std::mutex> g(mu_);
+    handles_.erase(h);
+  }
+
+  void set_params(long long fusion_threshold, double cycle_ms) {
+    if (fusion_threshold > 0) fusion_threshold_ = fusion_threshold;
+    if (cycle_ms > 0) cycle_ms_ = cycle_ms;
+  }
+
+  void get_stats(long long* cycles, long long* bytes, double* busy_s) {
+    *cycles = cycles_.load();
+    *bytes = processed_bytes_.load();
+    *busy_s = busy_us_.load() / 1e6;
+  }
+
+  void request_shutdown() { shutdown_requested_ = true; }
+  bool closed() {
+    std::lock_guard<std::mutex> g(mu_);
+    return closed_;
+  }
+
+  // Cooperative teardown: flag the shutdown, wait for the loop to exit
+  // (the flag must circulate so every rank closes on the same cycle), then
+  // release the bulk memory. The Engine object itself stays alive — see the
+  // note at hvd_eng_shutdown.
+  void finish() {
+    request_shutdown();
+    if (thread_.joinable()) thread_.join();
+    if (timeline_) timeline_->close();
+    std::lock_guard<std::mutex> g(mu_);
+    fusion_buffer_.clear();
+    fusion_buffer_.shrink_to_fit();
+    finished_ = true;
+  }
+
+  bool finished() {
+    std::lock_guard<std::mutex> g(mu_);
+    return finished_;
+  }
+
+ private:
+  // ------------------------------------------------------------- cycle loop
+
+  void run_loop() {
+    try {
+      while (true) {
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          if (closed_) break;
+        }
+        if (rank_ == 0) {
+          // The coordinator paces the token (reference sleeps cycle_time in
+          // every rank's loop, operations.cc:1250-1255; workers here are
+          // paced by token arrival instead).
+          std::this_thread::sleep_for(
+              std::chrono::duration<double, std::milli>(cycle_ms_));
+        }
+        double t0 = mono_s();
+        if (timeline_) timeline_->mark_cycle_start();
+        cycle();
+        busy_us_ += (long long)((mono_s() - t0) * 1e6);
+        cycles_++;
+      }
+    } catch (const std::exception& exc) {
+      std::fprintf(stderr, "[hvd-native:%d] engine loop failed: %s\n", rank_,
+                   exc.what());
+      fail_all_and_close(exc.what());
+    }
+    if (size_ > 1) hvd_ring_shutdown();
+    if (timeline_) timeline_->close();
+  }
+
+  Tick build_tick() {
+    std::lock_guard<std::mutex> g(mu_);
+    Tick t;
+    t.rank = rank_;
+    t.shutdown = shutdown_requested_;
+    BitMask cache_mask(cache_.capacity());
+    BitMask invalid_mask(cache_.capacity());
+    for (const std::string& name : queue_) {
+      auto& entry = table_.at(name);
+      int bit = cache_.lookup(entry.request);
+      if (bit >= 0) {
+        bit_pending_[bit] = name;
+        continue;
+      }
+      int stale = cache_.stale_bit(entry.request);
+      if (stale >= 0) invalid_mask.set(stale);
+      t.requests.push_back(entry.request);
+    }
+    queue_.clear();
+    for (const auto& kv : bit_pending_) cache_mask.set(kv.first);
+    t.cache_words = cache_mask.words();
+    t.invalid_words = invalid_mask.words();
+    return t;
+  }
+
+  void cycle() {
+    Tick own = build_tick();
+    Reply reply;
+    if (size_ == 1) {
+      reply = coordinate({own});
+    } else if (rank_ == 0) {
+      // Start the token with our tick; receive it back with everyone's.
+      Writer w;
+      w.u32(1);
+      write_tick(w, own);
+      send_frame(w.buf);
+      std::vector<uint8_t> token = recv_frame();
+      Reader r(token.data(), token.size());
+      uint32_t n = r.u32();
+      std::vector<Tick> ticks;
+      for (uint32_t i = 0; i < n && r.ok; i++) ticks.push_back(read_tick(r));
+      if (!r.ok || ticks.size() != (size_t)size_)
+        throw EngineError("malformed control token");
+      std::sort(ticks.begin(), ticks.end(),
+                [](const Tick& a, const Tick& b) { return a.rank < b.rank; });
+      reply = coordinate(ticks);
+      Writer rw;
+      write_reply(rw, reply);
+      send_frame(rw.buf);
+    } else {
+      // Append our tick to the token and pass it on.
+      std::vector<uint8_t> token = recv_frame();
+      Reader r(token.data(), token.size());
+      uint32_t n = r.u32();
+      Writer w;
+      w.u32(n + 1);
+      w.buf.insert(w.buf.end(), token.begin() + 4, token.end());
+      write_tick(w, own);
+      send_frame(w.buf);
+      // Receive the reply; forward before processing so downstream ranks
+      // enter the data phase too.
+      std::vector<uint8_t> raw = recv_frame();
+      if ((rank_ + 1) % size_ != 0) send_frame(raw);
+      Reader rr(raw.data(), raw.size());
+      reply = read_reply(rr);
+      if (!rr.ok) throw EngineError("malformed control reply");
+    }
+    process_reply(reply);
+  }
+
+  // --------------------------------------------------------- control frames
+
+  void send_frame(const std::vector<uint8_t>& payload) {
+    uint32_t len = (uint32_t)payload.size();
+    if (hvd_ring_send_right(&len, 4) != 0 ||
+        hvd_ring_send_right(payload.data(), (long)payload.size()) != 0)
+      throw EngineError(std::string("control send failed: ") +
+                        hvd_ring_last_error());
+  }
+
+  std::vector<uint8_t> recv_frame() {
+    uint32_t len = 0;
+    if (hvd_ring_recv_left(&len, 4) != 0)
+      throw EngineError(std::string("control recv failed: ") +
+                        hvd_ring_last_error());
+    if (len > (1u << 28)) throw EngineError("oversized control frame");
+    std::vector<uint8_t> payload(len);
+    if (len && hvd_ring_recv_left(payload.data(), (long)len) != 0)
+      throw EngineError(std::string("control recv failed: ") +
+                        hvd_ring_last_error());
+    return payload;
+  }
+
+  // ------------------------------------------------------- coordinator side
+
+  Reply coordinate(const std::vector<Tick>& ticks) {
+    Reply reply;
+    BitMask and_mask(ticks[0].cache_words.empty()
+                         ? BitMask(cache_.capacity())
+                         : BitMask(ticks[0].cache_words));
+    BitMask invalid(cache_.capacity());
+    for (const auto& t : ticks) {
+      reply.shutdown = reply.shutdown || t.shutdown;
+      invalid.or_with(BitMask(t.invalid_words));
+      and_mask.and_with(BitMask(t.cache_words));
+    }
+    and_mask.and_not(invalid);
+
+    // Negotiation (reference operations.cc:1388-1475): accumulate per-tensor
+    // requests; a tensor is ready when every rank reported it.
+    double now = mono_s();
+    std::vector<Response> ready;
+    for (const auto& t : ticks) {
+      for (const auto& req : t.requests) {
+        auto& entry = message_table_[req.tensor_name];
+        if (entry.empty()) {
+          first_seen_[req.tensor_name] = now;
+          if (timeline_)
+            timeline_->negotiate_start(req.tensor_name,
+                                       op_name(req.request_type));
+        }
+        if (timeline_)
+          timeline_->negotiate_rank_ready(req.tensor_name, t.rank);
+        entry[t.rank] = req;
+      }
+    }
+    for (auto it = message_table_.begin(); it != message_table_.end();) {
+      if ((int)it->second.size() == size_) {
+        std::vector<Request> requests;
+        for (int r = 0; r < size_; r++) requests.push_back(it->second[r]);
+        ready.push_back(construct_response(requests, size_));
+        if (timeline_)
+          timeline_->negotiate_end(it->first,
+                                   op_name(requests[0].request_type));
+        first_seen_.erase(it->first);
+        stall_warned_.erase(it->first);
+        it = message_table_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+
+    check_stalls(now);
+    reply.responses.responses = fuse_responses(std::move(ready));
+    reply.responses.shutdown = reply.shutdown;
+    reply.bypass_words = and_mask.words();
+    reply.invalid_words = invalid.words();
+    return reply;
+  }
+
+  // Tensor Fusion packing (reference FuseResponses, operations.cc:450-573):
+  // join ALLREDUCE responses of equal dtype while the fused byte count stays
+  // under the threshold, with look-ahead past mismatched dtypes.
+  std::vector<Response> fuse_responses(std::vector<Response> responses) {
+    std::vector<Response> out;
+    std::deque<Response> pending(
+        std::make_move_iterator(responses.begin()),
+        std::make_move_iterator(responses.end()));
+    while (!pending.empty()) {
+      Response first = std::move(pending.front());
+      pending.pop_front();
+      if (first.response_type != RESP_ALLREDUCE) {
+        out.push_back(std::move(first));
+        continue;
+      }
+      uint8_t dtype = response_dtype(first);
+      long long total = response_bytes(first);
+      for (size_t i = 0; i < pending.size();) {
+        Response& cand = pending[i];
+        if (cand.response_type == RESP_ALLREDUCE &&
+            response_dtype(cand) == dtype) {
+          long long nbytes = response_bytes(cand);
+          if (total + nbytes <= fusion_threshold_) {
+            for (auto& n : cand.tensor_names)
+              first.tensor_names.push_back(std::move(n));
+            total += nbytes;
+            pending.erase(pending.begin() + (long)i);
+            continue;
+          }
+        }
+        i++;  // look-ahead (reference operations.cc:483-499)
+      }
+      out.push_back(std::move(first));
+    }
+    return out;
+  }
+
+  uint8_t response_dtype(const Response& r) {
+    std::lock_guard<std::mutex> g(mu_);
+    return table_.at(r.tensor_names[0]).request.dtype;
+  }
+
+  long long response_bytes(const Response& r) {
+    std::lock_guard<std::mutex> g(mu_);
+    long long total = 0;
+    for (const auto& name : r.tensor_names)
+      total += (long long)table_.at(name).data.size();
+    return total;
+  }
+
+  // Reference CheckForStalledTensors (operations.cc:688-769).
+  void check_stalls(double now) {
+    if (stall_disable_) return;
+    for (const auto& kv : first_seen_) {
+      const std::string& name = kv.first;
+      double age = now - kv.second;
+      if (age <= stall_warn_s_) continue;
+      double last = stall_warned_.count(name) ? stall_warned_[name] : 0.0;
+      if (now - last > stall_warn_s_) {
+        std::string missing;
+        const auto& seen = message_table_[name];
+        for (int r = 0; r < size_; r++) {
+          if (!seen.count(r)) {
+            if (!missing.empty()) missing += ", ";
+            missing += std::to_string(r);
+          }
+        }
+        std::fprintf(stderr,
+                     "[hvd-native:%d] WARNING: One or more tensors were "
+                     "submitted to be reduced, gathered or broadcasted by "
+                     "subset of ranks and are waiting for remainder of ranks "
+                     "for more than %ds. Stalled op: %s [missing ranks: %s]\n",
+                     rank_, (int)stall_warn_s_, name.c_str(), missing.c_str());
+        stall_warned_[name] = now;
+      }
+      if (stall_shutdown_s_ > 0 && age > stall_shutdown_s_) {
+        std::fprintf(stderr,
+                     "[hvd-native:%d] ERROR: Stall duration exceeded "
+                     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS: aborting job "
+                     "(stalled op: %s)\n",
+                     rank_, name.c_str());
+        shutdown_requested_ = true;
+      }
+    }
+  }
+
+  // ----------------------------------------------------------- both sides
+
+  void process_reply(const Reply& reply) {
+    BitMask invalid(reply.invalid_words);
+    for (int bit : invalid.bits()) {
+      std::lock_guard<std::mutex> g(mu_);
+      cache_.evict_bit(bit);
+      auto it = bit_pending_.find(bit);
+      if (it != bit_pending_.end()) {
+        // Cache entry died under a pending hit: renegotiate.
+        queue_.push_back(it->second);
+        bit_pending_.erase(it);
+      }
+    }
+
+    BitMask bypass(reply.bypass_words);
+    for (int bit : bypass.bits()) {
+      // Cached fast path (reference RunBypass, operations.cc:1166-1215).
+      std::string cached_name;
+      Response cached;
+      std::string name;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        if (!cache_.get(bit, &cached_name, &cached))
+          throw EngineError("bypass bit not in cache");
+        cache_.touch(bit);
+        auto it = bit_pending_.find(bit);
+        if (it == bit_pending_.end())
+          throw EngineError("bypass bit with no pending tensor");
+        name = it->second;
+        bit_pending_.erase(it);
+      }
+      Response r;
+      r.response_type = cached.response_type;
+      r.tensor_names.push_back(name);
+      r.tensor_sizes = cached.tensor_sizes;
+      execute(r, /*cache_put=*/false);
+    }
+
+    for (const auto& resp : reply.responses.responses)
+      execute(resp, /*cache_put=*/true);
+
+    // Act only on the *circulated* shutdown flag, never the local one: a
+    // locally-set flag must first ride a tick so every rank closes on the
+    // same cycle (otherwise this rank would drop out of the token chain
+    // while peers still expect its hops).
+    if (reply.shutdown) fail_all_and_close(kShutdownMsg);
+  }
+
+  // Fail every pending op and close — in ONE critical section, so an
+  // enqueue racing the teardown either lands before (and is failed here) or
+  // observes closed_ and returns the shutdown error; no handle can slip
+  // into the table after the sweep and hang its waiter.
+  void fail_all_and_close(const std::string& msg) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& kv : table_) {
+        auto it = handles_.find(kv.second.handle);
+        if (it != handles_.end() && it->second.status == 0) {
+          it->second.status = 2;
+          it->second.error = msg;
+        }
+      }
+      table_.clear();
+      queue_.clear();
+      bit_pending_.clear();
+      closed_ = true;
+    }
+    handle_cv_.notify_all();
+  }
+
+  // ------------------------------------------------------------ data plane
+
+  void execute(const Response& response, bool cache_put) {
+    if (response.response_type == RESP_ERROR) {
+      std::vector<long long> hs;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        for (const auto& name : response.tensor_names) {
+          auto it = table_.find(name);
+          if (it == table_.end()) continue;
+          auto hit = handles_.find(it->second.handle);
+          if (hit != handles_.end()) {
+            hit->second.status = 2;
+            hit->second.error = response.error_message;
+          }
+          table_.erase(it);
+        }
+      }
+      handle_cv_.notify_all();
+      return;
+    }
+
+    // Entries stay in the table until completion; only this thread mutates
+    // them after enqueue, so reading outside the lock is safe.
+    std::vector<Entry*> entries;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (const auto& name : response.tensor_names)
+        entries.push_back(&table_.at(name));
+    }
+    std::string tname =
+        entries.size() == 1
+            ? entries[0]->request.tensor_name
+            : "fused[" + std::to_string(entries.size()) + "]";
+    if (timeline_) timeline_->start(tname, op_name(response.response_type));
+
+    long long nbytes = 0;
+    if (response.response_type == RESP_ALLREDUCE)
+      nbytes = execute_allreduce(entries, tname);
+    else if (response.response_type == RESP_ALLGATHER)
+      nbytes = execute_allgather(*entries[0], response, tname);
+    else
+      nbytes = execute_broadcast(*entries[0], tname);
+    processed_bytes_ += nbytes;
+
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (Entry* e : entries) {
+        if (cache_put) {
+          Response single;
+          single.response_type = response.response_type;
+          single.tensor_names.push_back(e->request.tensor_name);
+          single.tensor_sizes = response.tensor_sizes;
+          cache_.put(e->request, single);
+        }
+        table_.erase(e->request.tensor_name);
+      }
+    }
+    if (timeline_) timeline_->end(tname);
+    handle_cv_.notify_all();
+  }
+
+  void complete(Entry* e, std::vector<int64_t> shape,
+                std::vector<uint8_t> data) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = handles_.find(e->handle);
+    if (it == handles_.end()) return;
+    it->second.status = 1;
+    it->second.dtype = e->request.dtype;
+    it->second.shape = std::move(shape);
+    it->second.data = std::move(data);
+  }
+
+  long long execute_allreduce(std::vector<Entry*>& entries,
+                              const std::string& tname) {
+    uint8_t dtype = entries[0]->request.dtype;
+    size_t esz = dtype_size(dtype);
+    size_t total_bytes = 0;
+    for (Entry* e : entries) total_bytes += e->data.size();
+
+    if (entries.size() == 1) {
+      // Unfused: reduce in place on the entry's own contiguous copy and
+      // hand the buffer to the handle — no fusion-buffer staging (the
+      // reference likewise reduces unfused entries in place,
+      // mpi_operations.cc:40-49).
+      Entry* e = entries[0];
+      if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
+      if (size_ > 1) {
+        if (hvd_ring_allreduce(e->data.data(), (long)(total_bytes / esz),
+                               dtype, 0) != 0)
+          throw EngineError(std::string("ring allreduce failed: ") +
+                            hvd_ring_last_error());
+      }
+      if (timeline_) timeline_->activity_end(tname);
+      complete(e, e->request.shape, std::move(e->data));
+      return (long long)total_bytes;
+    }
+
+    // Fusion buffer (reference FusionBufferManager: one persistent buffer,
+    // lazily allocated, fusion_buffer_manager.cc:21-45).
+    if (fusion_buffer_.capacity() < total_bytes) {
+      if (timeline_) timeline_->activity_start(tname, "INIT_FUSION_BUFFER");
+      fusion_buffer_.reserve(std::max(
+          total_bytes, (size_t)std::min<long long>(fusion_threshold_,
+                                                   64ll << 20)));
+      if (timeline_) timeline_->activity_end(tname);
+    }
+    fusion_buffer_.resize(total_bytes);
+
+    if (timeline_) timeline_->activity_start(tname, "MEMCPY_IN_FUSION_BUFFER");
+    size_t off = 0;
+    for (Entry* e : entries) {
+      std::memcpy(fusion_buffer_.data() + off, e->data.data(), e->data.size());
+      off += e->data.size();
+    }
+    if (timeline_) {
+      timeline_->activity_end(tname);
+      timeline_->activity_start(tname, "TCP_COLLECTIVE");
+    }
+    if (size_ > 1) {
+      if (hvd_ring_allreduce(fusion_buffer_.data(),
+                             (long)(total_bytes / esz), dtype, 0) != 0)
+        throw EngineError(std::string("ring allreduce failed: ") +
+                          hvd_ring_last_error());
+    }
+    if (timeline_) {
+      timeline_->activity_end(tname);
+      timeline_->activity_start(tname, "MEMCPY_OUT_FUSION_BUFFER");
+    }
+    off = 0;
+    for (Entry* e : entries) {
+      std::vector<uint8_t> out(e->data.size());
+      std::memcpy(out.data(), fusion_buffer_.data() + off, out.size());
+      off += out.size();
+      complete(e, e->request.shape, std::move(out));
+    }
+    if (timeline_) timeline_->activity_end(tname);
+    return (long long)total_bytes;
+  }
+
+  long long execute_allgather(Entry& e, const Response& response,
+                              const std::string& tname) {
+    uint8_t dtype = e.request.dtype;
+    size_t esz = dtype_size(dtype);
+    long long trailing = 1;
+    for (size_t i = 1; i < e.request.shape.size(); i++)
+      trailing *= e.request.shape[i];
+    std::vector<long> counts;
+    long long total_elems = 0;
+    for (int64_t s : response.tensor_sizes) {
+      counts.push_back((long)(s * trailing));
+      total_elems += s * trailing;
+    }
+    std::vector<uint8_t> out((size_t)total_elems * esz);
+    if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
+    if (size_ > 1) {
+      if (hvd_ring_allgather(e.data.data(), counts.data(), out.data(),
+                             dtype) != 0)
+        throw EngineError(std::string("ring allgather failed: ") +
+                          hvd_ring_last_error());
+    } else {
+      std::memcpy(out.data(), e.data.data(), e.data.size());
+    }
+    if (timeline_) timeline_->activity_end(tname);
+    std::vector<int64_t> shape = e.request.shape;
+    int64_t dim0 = 0;
+    for (int64_t s : response.tensor_sizes) dim0 += s;
+    shape[0] = dim0;
+    long long nbytes = (long long)out.size();
+    complete(&e, std::move(shape), std::move(out));
+    return nbytes;
+  }
+
+  long long execute_broadcast(Entry& e, const std::string& tname) {
+    std::vector<uint8_t> out = e.data;
+    size_t esz = dtype_size(e.request.dtype);
+    if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
+    if (size_ > 1) {
+      if (hvd_ring_broadcast(out.data(), (long)(out.size() / esz),
+                             e.request.dtype, e.request.root_rank) != 0)
+        throw EngineError(std::string("ring broadcast failed: ") +
+                          hvd_ring_last_error());
+    }
+    if (timeline_) timeline_->activity_end(tname);
+    long long nbytes = (long long)out.size();
+    complete(&e, e.request.shape, std::move(out));
+    return nbytes;
+  }
+
+  // ------------------------------------------------------------ members
+
+  int rank_, size_;
+  std::atomic<double> cycle_ms_;
+  std::atomic<long long> fusion_threshold_;
+  bool stall_disable_;
+  double stall_warn_s_, stall_shutdown_s_;
+
+  std::mutex mu_;  // guards table_/queue_/handles_/bit_pending_/cache_/closed_
+  std::condition_variable handle_cv_;
+  std::deque<std::string> queue_;
+  std::map<std::string, Entry> table_;
+  std::map<long long, HandleSlot> handles_;
+  std::map<int, std::string> bit_pending_;
+  ResponseCache cache_;
+  long long next_handle_ = 0;
+  bool closed_ = false;
+  bool finished_ = false;
+  std::atomic<bool> shutdown_requested_{false};
+
+  // Coordinator-only (reference MessageTable, global_state.h:34).
+  std::map<std::string, std::map<int, Request>> message_table_;
+  std::map<std::string, double> first_seen_;
+  std::map<std::string, double> stall_warned_;
+
+  std::vector<uint8_t> fusion_buffer_;
+  std::unique_ptr<Timeline> timeline_;
+
+  std::atomic<long long> cycles_{0};
+  std::atomic<long long> processed_bytes_{0};
+  std::atomic<long long> busy_us_{0};
+
+  std::thread thread_;
+};
+
+// Intentionally leaked on shutdown: C-ABI accessors (wait/slot/release) read
+// this pointer without a lock from arbitrary Python threads, so destroying
+// the Engine while a waiter is inside it would be a use-after-free. Shutdown
+// instead joins the background thread and releases the bulk buffers
+// (Engine::finish); the husk stays valid so late waiters resolve cleanly.
+// The reference keeps its HorovodGlobalState singleton alive for the process
+// lifetime the same way (horovod/common/operations.cc:90).
+Engine* g_engine = nullptr;
+std::mutex g_engine_mu;
+std::string g_last_error;
+
+}  // namespace
+}  // namespace hvd
+
+// ----------------------------------------------------------------- C ABI
+// (reference operations.cc:1595-1650 exposes the same lifecycle surface.)
+
+extern "C" {
+
+const char* hvd_eng_last_error() { return hvd::g_last_error.c_str(); }
+
+int hvd_eng_init(int rank, int size, const char* ring_addrs,
+                 const uint8_t* secret, int secret_len, double cycle_ms,
+                 long long fusion_threshold, int cache_capacity,
+                 int stall_disable, double stall_warn_s,
+                 double stall_shutdown_s, const char* timeline_path,
+                 int timeline_mark_cycles) {
+  std::lock_guard<std::mutex> g(hvd::g_engine_mu);
+  if (hvd::g_engine && !hvd::g_engine->finished()) {
+    hvd::g_last_error = "engine already initialized";
+    return -1;
+  }
+  if (size > 1) {
+    if (hvd_ring_init(rank, size, ring_addrs, secret, secret_len) != 0) {
+      hvd::g_last_error = hvd_ring_last_error();
+      return -1;
+    }
+  }
+  // A previous finished engine is leaked deliberately (see g_engine note).
+  hvd::g_engine = new hvd::Engine(
+      rank, size, cycle_ms, fusion_threshold, cache_capacity,
+      stall_disable != 0, stall_warn_s, stall_shutdown_s,
+      timeline_path ? timeline_path : "", timeline_mark_cycles != 0);
+  return 0;
+}
+
+long long hvd_eng_enqueue(int op, const char* name, const void* data,
+                          const long long* shape, int ndim, int dtype,
+                          int root_rank) {
+  if (!hvd::g_engine) {
+    hvd::g_last_error = "engine not initialized";
+    return -1;
+  }
+  return hvd::g_engine->enqueue((uint8_t)op, name, data,
+                                (const int64_t*)shape, ndim, (uint8_t)dtype,
+                                root_rank);
+}
+
+int hvd_eng_poll(long long h) {
+  return hvd::g_engine ? hvd::g_engine->poll(h) : -1;
+}
+
+int hvd_eng_wait(long long h) {
+  return hvd::g_engine ? hvd::g_engine->wait(h) : -1;
+}
+
+int hvd_eng_wait_for(long long h, double timeout_s) {
+  return hvd::g_engine ? hvd::g_engine->wait_for(h, timeout_s) : -1;
+}
+
+long long hvd_eng_result_nbytes(long long h) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  return s ? (long long)s->data.size() : -1;
+}
+
+int hvd_eng_result_ndim(long long h) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  return s ? (int)s->shape.size() : -1;
+}
+
+int hvd_eng_result_dtype(long long h) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  return s ? (int)s->dtype : -1;
+}
+
+void hvd_eng_result_shape(long long h, long long* out) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  if (!s) return;
+  for (size_t i = 0; i < s->shape.size(); i++) out[i] = s->shape[i];
+}
+
+int hvd_eng_result_copy(long long h, void* dst) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  if (!s) return -1;
+  std::memcpy(dst, s->data.data(), s->data.size());
+  return 0;
+}
+
+const char* hvd_eng_handle_error(long long h) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  return s ? s->error.c_str() : "unknown handle";
+}
+
+void hvd_eng_release(long long h) {
+  if (hvd::g_engine) hvd::g_engine->release(h);
+}
+
+void hvd_eng_set_params(long long fusion_threshold, double cycle_ms) {
+  if (hvd::g_engine) hvd::g_engine->set_params(fusion_threshold, cycle_ms);
+}
+
+void hvd_eng_get_stats(long long* cycles, long long* bytes, double* busy_s) {
+  if (hvd::g_engine)
+    hvd::g_engine->get_stats(cycles, bytes, busy_s);
+  else {
+    *cycles = 0;
+    *bytes = 0;
+    *busy_s = 0;
+  }
+}
+
+int hvd_eng_shutdown() {
+  std::lock_guard<std::mutex> g(hvd::g_engine_mu);
+  if (!hvd::g_engine) return 0;
+  hvd::g_engine->finish();  // join loop + free buffers; husk stays valid
+  return 0;
+}
+
+}  // extern "C"

@@ -541,6 +541,17 @@ int hvd_ring_broadcast(void* buf, long count, int dtype, int root) {
   return 0;
 }
 
+// Raw neighbor I/O for the native engine's control token (engine.cc): the
+// token and the fused ResponseList ride the same authenticated connections
+// as the data phases, in strict alternation from the single engine thread.
+int hvd_ring_send_right(const void* buf, long n) {
+  return send_all(g_right_fd, buf, (size_t)n) ? 0 : -1;
+}
+
+int hvd_ring_recv_left(void* buf, long n) {
+  return recv_all(g_left_fd, buf, (size_t)n) ? 0 : -1;
+}
+
 void hvd_ring_shutdown() {
   for (int* fd : {&g_left_fd, &g_right_fd, &g_listen_fd}) {
     if (*fd >= 0) {

@@ -128,3 +128,29 @@ def test_autotune_stays_correct(tmp_path):
 def test_star_data_plane(scenario):
     # Pure-Python fallback path (HOROVOD_CPU_OPS=star) stays correct.
     run_ranks(scenario, size=2, extra_env={"HOROVOD_CPU_OPS": "star"})
+
+
+@pytest.mark.parametrize("scenario", [
+    "allreduce", "fusion", "cache", "error_mismatch", "duplicate_name",
+])
+def test_python_engine(scenario):
+    # The Python controller (TCP star control plane) remains selectable via
+    # HOROVOD_ENGINE=python; the default above exercises the native C++
+    # engine (engine.cc) whenever ring addresses are exported.
+    run_ranks(scenario, size=2, extra_env={"HOROVOD_ENGINE": "python"})
+
+
+def test_native_engine_timeline_stall_parity(tmp_path):
+    # The native engine's C++ timeline writes the same vocabulary the Python
+    # timeline test asserts (reference test/test_timeline.py markers).
+    tl_file = tmp_path / "native_timeline.json"
+    outs = run_ranks("stall", size=2, extra_env={
+        "HOROVOD_ENGINE": "native",
+        "HOROVOD_TIMELINE": str(tl_file),
+        "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+        "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+    })
+    assert "waiting for remainder of ranks" in outs[0]
+    content = tl_file.read_text()
+    assert "NEGOTIATE_ALLREDUCE" in content
+    assert "CYCLE_START" in content
