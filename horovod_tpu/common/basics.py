@@ -144,6 +144,21 @@ def state() -> HorovodTpuState:
     return _ensure_initialized()
 
 
+def controller():
+    """The running eager-tier background controller, or a curated error.
+
+    Shared guard for every framework adapter (torch/tf/mxnet/ops): the eager
+    data plane needs the TCP controller that ``horovodrun`` bootstraps."""
+    st = state()
+    if st.controller is None:
+        raise RuntimeError(
+            "eager collectives at size > 1 require the background controller; "
+            "launch through horovodrun (which exports HOROVOD_CONTROLLER_ADDR) "
+            "or use the SPMD tier (collectives inside jit/shard_map over a "
+            "multi-host mesh)")
+    return st.controller
+
+
 def is_initialized() -> bool:
     return _state is not None and _state.initialized
 

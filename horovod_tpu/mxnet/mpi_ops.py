@@ -1,0 +1,142 @@
+"""MXNet collective ops on the TCP controller.
+
+Reference: ``horovod/mxnet/mpi_ops.py`` (232 lines) + the engine-push C++
+layer ``mxnet/mpi_ops.cc`` it wraps. Same public surface — ``allreduce``,
+``allreduce_``, ``allgather``, ``broadcast``, ``broadcast_`` each taking
+``(tensor, ..., name, priority)`` — but instead of pushing an async op into
+the MXNet engine (``mxnet/mpi_ops.cc:67-120 DoHorovodOperation``) we bridge
+NDArray → numpy → controller, which is the native path on a TPU host: MXNet
+NDArrays live in host memory, device math belongs to the JAX tier.
+
+``priority`` is accepted for API parity. The reference forwards it to the
+MXNet engine scheduler; our controller negotiates readiness per cycle the
+same way regardless of hint, so it is a no-op here (documented, not silent).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..common import basics
+from ..common.basics import (  # noqa: F401  (re-exported, reference parity)
+    init, shutdown, rank, size, local_rank, local_size,
+    mpi_threads_supported,
+)
+
+
+def _mx():
+    import mxnet as mx
+    return mx
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return np.ascontiguousarray(tensor.asnumpy())
+
+
+def _new_like(tensor, arr: np.ndarray):
+    """Create a fresh NDArray holding ``arr`` in ``tensor``'s context."""
+    mx = _mx()
+    kwargs = {}
+    ctx = getattr(tensor, "context", None) or getattr(tensor, "ctx", None)
+    if ctx is not None:
+        kwargs["ctx"] = ctx
+    return mx.nd.array(arr, dtype=arr.dtype, **kwargs)
+
+
+def _copy_into(tensor, arr: np.ndarray):
+    tensor[:] = arr.reshape(tensor.shape)
+    return tensor
+
+
+def _controller():
+    return basics.controller()
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              priority: int = 0):
+    """Sum/average ``tensor`` across ranks; returns a new NDArray
+    (reference ``mxnet/mpi_ops.py:45``)."""
+    if basics.size() == 1:
+        return _new_like(tensor, _to_numpy(tensor))
+    out = _controller().allreduce(_to_numpy(tensor), average=average,
+                                  name=name)
+    return _new_like(tensor, np.asarray(out))
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
+               priority: int = 0):
+    """In-place allreduce (reference ``mxnet/mpi_ops.py:87``)."""
+    synchronize(allreduce_async_(tensor, average=average, name=name,
+                                 priority=priority))
+    return tensor
+
+
+def allreduce_async_(tensor, average: bool = True,
+                     name: Optional[str] = None, priority: int = 0):
+    """Enqueue an in-place allreduce; returns a handle for ``synchronize``
+    (None at size 1). The reference gets asynchrony from the MXNet engine
+    push (``mxnet/mpi_ops.cc:67-120``); here it comes from the controller's
+    async API — batch-enqueueing gradients through this is what lets Tensor
+    Fusion pack them into one collective."""
+    if basics.size() == 1:
+        return None
+    return _controller().allreduce_async(
+        _to_numpy(tensor), average=average, name=name,
+        wrap=lambda out: _copy_into(tensor, np.asarray(out)))
+
+
+def broadcast_async_(tensor, root_rank: int, name: Optional[str] = None,
+                     priority: int = 0):
+    """Enqueue an in-place broadcast; returns a handle for ``synchronize``
+    (None at size 1)."""
+    if basics.size() == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return None
+    return _controller().broadcast_async(
+        _to_numpy(tensor), root_rank=root_rank, name=name,
+        wrap=lambda out: _copy_into(tensor, np.asarray(out)))
+
+
+def synchronize(handles):
+    """Wait for one handle or a list of handles (None entries are size-1
+    no-ops)."""
+    if handles is None:
+        return
+    if not isinstance(handles, (tuple, list)):
+        handles = [handles]
+    for h in handles:
+        if h is not None:
+            h.wait()
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0):
+    """Concatenate ``tensor`` from all ranks along the first dimension;
+    first dims may differ per rank (reference ``mxnet/mpi_ops.py:122``)."""
+    if basics.size() == 1:
+        return _new_like(tensor, _to_numpy(tensor))
+    out = _controller().allgather(_to_numpy(tensor), name=name)
+    return _new_like(tensor, np.asarray(out))
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              priority: int = 0):
+    """Broadcast from ``root_rank``; returns a new NDArray
+    (reference ``mxnet/mpi_ops.py:161``)."""
+    if basics.size() == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return _new_like(tensor, _to_numpy(tensor))
+    out = _controller().broadcast(_to_numpy(tensor), root_rank=root_rank,
+                                  name=name)
+    return _new_like(tensor, np.asarray(out))
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
+               priority: int = 0):
+    """In-place broadcast (reference ``mxnet/mpi_ops.py:201``)."""
+    synchronize(broadcast_async_(tensor, root_rank=root_rank, name=name,
+                                 priority=priority))
+    return tensor

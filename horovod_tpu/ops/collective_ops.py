@@ -93,14 +93,7 @@ def _resolve_average(average: Optional[bool], op: Optional[str]) -> bool:
 
 
 def _controller():
-    st = basics.state()
-    if st.controller is None:
-        raise RuntimeError(
-            "eager collectives at size > 1 require the background controller; "
-            "launch through horovodrun (which exports HOROVOD_CONTROLLER_ADDR) "
-            "or use the SPMD tier (collectives inside jit/shard_map over a "
-            "multi-host mesh)")
-    return st.controller
+    return basics.controller()
 
 
 # ---------------------------------------------------------------------------

@@ -36,12 +36,7 @@ from .compression import Compression  # noqa: F401
 
 
 def _controller():
-    st = basics.state()
-    if st.controller is None:
-        raise RuntimeError(
-            "eager collectives at size > 1 require the background controller; "
-            "launch through horovodrun")
-    return st.controller
+    return basics.controller()
 
 
 def _np_collective(fn, tensor: tf.Tensor, out_dtype=None) -> tf.Tensor:

@@ -27,12 +27,7 @@ def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
 
 
 def _controller():
-    st = basics.state()
-    if st.controller is None:
-        raise RuntimeError(
-            "eager collectives at size > 1 require the background controller; "
-            "launch through horovodrun")
-    return st.controller
+    return basics.controller()
 
 
 def _size() -> int:

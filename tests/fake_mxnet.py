@@ -1,0 +1,197 @@
+"""Minimal in-tree stand-in for the EOL ``mxnet`` package.
+
+Implements exactly the NDArray / optimizer / gluon / io / metric surfaces
+that ``horovod_tpu.mxnet`` touches, backed by numpy, so the adapter's logic
+(reference parity with ``horovod/mxnet``) is testable without MXNet.
+Install with ``sys.modules["mxnet"] = fake_mxnet.module()`` BEFORE importing
+``horovod_tpu.mxnet``.
+"""
+
+from __future__ import annotations
+
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None, ctx=None):
+        self._data = np.array(data, dtype=dtype)
+        self.context = ctx if ctx is not None else "cpu(0)"
+
+    def asnumpy(self):
+        return self._data.copy()
+
+    def wait_to_read(self):
+        return None
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data[key] = value
+
+    def __getitem__(self, key):
+        return NDArray(self._data[key])
+
+    def __repr__(self):
+        return f"NDArray({self._data!r})"
+
+
+def _nd_array(data, dtype=None, ctx=None):
+    if isinstance(data, NDArray):
+        data = data._data
+    return NDArray(data, dtype=dtype, ctx=ctx)
+
+
+def _nd_zeros(shape, dtype="float32", ctx=None):
+    return NDArray(np.zeros(shape, dtype=dtype), ctx=ctx)
+
+
+class Optimizer:
+    """Shape of ``mx.optimizer.Optimizer``: ``rescale_grad`` plus
+    ``update(index, weight, grad, state)``."""
+
+    def __init__(self, learning_rate=0.1, rescale_grad=1.0):
+        self.lr = learning_rate
+        self.rescale_grad = rescale_grad
+        self.updates = []
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        self.updates.append(index)
+        if isinstance(index, (tuple, list)):
+            return  # aggregated update: recording the call is enough
+        weight[:] = weight.asnumpy() - self.lr * self.rescale_grad \
+            * grad.asnumpy()
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = args_wd_mult
+
+
+class DeferredInitializationError(Exception):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, data=None, grad=None, grad_req="write"):
+        self.name = name
+        self._data = data
+        self._grad = grad
+        self.grad_req = grad_req
+
+    def data(self):
+        if self._data is None:
+            raise DeferredInitializationError(self.name)
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+    def _init_impl(self, data):
+        self._data = NDArray(np.array(data))
+
+
+class ParameterDict:
+    """Deliberately NOT a dict subclass (matching real mxnet), so
+    ``broadcast_parameters``'s ``isinstance(params, dict)``-first dispatch
+    takes the ParameterDict branch."""
+
+    def __init__(self):
+        self._params = {}
+
+    def __setitem__(self, key, value):
+        self._params[key] = value
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def items(self):
+        return self._params.items()
+
+
+class Trainer:
+    """Shape of ``mx.gluon.Trainer``: ``_params``, ``_scale``, ``step``."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        if isinstance(params, dict):
+            params = [params[k] for k in sorted(params)]
+        self._params = list(params)
+        self._optimizer = optimizer
+        self._scale = (optimizer_params or {}).get("rescale_grad", 1.0)
+        self._kvstore = kvstore
+
+    def _allreduce_grads(self):
+        raise NotImplementedError
+
+    def step(self, batch_size):
+        self._allreduce_grads()
+        for param in self._params:
+            if param.grad_req == "null":
+                continue
+            w, g = param.data(), param.list_grad()[0]
+            w[:] = w.asnumpy() - self._optimizer.lr * self._scale \
+                * g.asnumpy()
+
+
+class ResizeIter:
+    """Shape of ``mx.io.ResizeIter``: wraps an iter, padded to ``size``
+    batches."""
+
+    def __init__(self, data_iter, size):
+        self.data_iter = data_iter
+        self.size = size
+
+
+class EvalMetric:
+    """Shape of ``mx.metric.EvalMetric``: accumulate (labels, preds)
+    updates."""
+
+    def __init__(self, name="fake"):
+        self.name = name
+        self.num_updates = 0
+        self.seen = []
+
+    def update(self, labels, preds):
+        self.num_updates += 1
+        self.seen.append(([np.asarray(t.asnumpy()) for t in labels],
+                          [np.asarray(t.asnumpy()) for t in preds]))
+
+
+def module():
+    """Assemble the fake as a module object exposing the ``mx.*`` attribute
+    chains the adapter uses."""
+    mx = types.ModuleType("mxnet")
+    mx.nd = types.SimpleNamespace(array=_nd_array, zeros=_nd_zeros,
+                                  NDArray=NDArray)
+    mx.optimizer = types.SimpleNamespace(Optimizer=Optimizer)
+    mx.gluon = types.SimpleNamespace(
+        Trainer=Trainer,
+        parameter=types.SimpleNamespace(
+            ParameterDict=ParameterDict,
+            Parameter=Parameter,
+            DeferredInitializationError=DeferredInitializationError),
+    )
+    mx.io = types.SimpleNamespace(ResizeIter=ResizeIter)
+    mx.metric = types.SimpleNamespace(EvalMetric=EvalMetric)
+    mx.NDArray = NDArray
+    return mx

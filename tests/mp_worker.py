@@ -327,7 +327,68 @@ def scenario_optimizer(rank, size):
     np.testing.assert_allclose(np.asarray(updates["w"]), want, rtol=1e-6)
 
 
+def scenario_mxnet(rank, size):
+    """MXNet adapter across real ranks, via the in-tree fake mxnet
+    (reference test/test_mxnet.py scope)."""
+    import fake_mxnet
+    mx = fake_mxnet.module()
+    sys.modules.setdefault("mxnet", mx)
+    import horovod_tpu.mxnet as hvd_mx
+
+    # allreduce_ sum across ranks
+    g = mx.nd.array(np.arange(4, dtype=np.float32) + rank)
+    hvd_mx.allreduce_(g, average=False, name="mx.grad")
+    np.testing.assert_allclose(
+        g.asnumpy(), size * np.arange(4) + sum(range(size)))
+
+    # broadcast_parameters: non-root ranks converge to root values
+    d = {"w": mx.nd.array(np.full(3, float(rank), dtype=np.float32))}
+    hvd_mx.broadcast_parameters(d, root_rank=0)
+    np.testing.assert_allclose(d["w"].asnumpy(), 0.0)
+
+    # DistributedOptimizer: identical updates on every rank
+    opt = mx.optimizer.Optimizer(learning_rate=1.0)
+    dopt = hvd_mx.DistributedOptimizer(opt)
+    expect(abs(opt.rescale_grad - 1.0 / size) < 1e-12,
+           "rescale_grad not folded by size")
+    w = mx.nd.array(np.zeros(2, dtype=np.float32))
+    grad = mx.nd.array(np.full(2, float(rank + 1), dtype=np.float32))
+    dopt.update(0, w, grad, None)
+    mean_grad = sum(r + 1 for r in range(size)) / size
+    np.testing.assert_allclose(w.asnumpy(), -mean_grad, rtol=1e-6)
+
+    # ResizeEvalDataIter pads every rank to the max batch count
+    class FakeIter:
+        def __init__(self, n):
+            self.n = n
+
+        def __iter__(self):
+            return iter(range(self.n))
+
+        def reset(self):
+            pass
+
+    resized = hvd_mx.ResizeEvalDataIter(FakeIter(3 + rank))
+    expect(resized.size == 3 + size - 1,
+           f"ResizeEvalDataIter got {resized.size}")
+
+    # DistributedEvalMetric replays per-rank updates on rank 0
+    Metric = hvd_mx.DistributedEvalMetric(fake_mxnet.EvalMetric)
+    m = Metric()
+    labels = [mx.nd.array(np.full((2 + rank,), float(rank)))]
+    preds = [mx.nd.array(np.full((2 + rank,), float(rank) + 10))]
+    m.update(labels, preds)
+    if rank == 0:
+        expect(m.num_updates == size, f"metric updates {m.num_updates}")
+        for r in range(size):
+            np.testing.assert_allclose(m.seen[r][0][0], float(r))
+            np.testing.assert_allclose(m.seen[r][1][0], float(r) + 10)
+    else:
+        expect(m.num_updates == 0, "non-root rank must not update")
+
+
 SCENARIOS = {
+    "mxnet": scenario_mxnet,
     "autotune": scenario_autotune,
     "tensorflow": scenario_tensorflow,
     "torch": scenario_torch,
