@@ -17,6 +17,7 @@ from .llama import (  # noqa: F401
     LlamaLM,
     causal_lm_loss,
 )
+from .inception import InceptionV3  # noqa: F401
 from .mlp import MnistMLP  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet,
@@ -24,4 +25,12 @@ from .resnet import (  # noqa: F401
     ResNet101,
     ResNet152,
     ResNetTiny,
+)
+from .vgg import (  # noqa: F401
+    VGG,
+    VGG11,
+    VGG13,
+    VGG16,
+    VGG19,
+    VGGTiny,
 )

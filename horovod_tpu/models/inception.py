@@ -1,0 +1,188 @@
+"""Inception V3 in flax — the reference's first headline benchmark model
+(90% scaling efficiency at 512 GPUs, reference ``README.md:58``,
+``docs/benchmarks.md:5-6``).
+
+From-scratch TPU-first implementation of Szegedy et al. 2015
+(arXiv:1512.00567): NHWC, bf16 activations / fp32 parameters+batch-stats,
+every conv bias-free and followed by BatchNorm+ReLU. The mixed blocks
+(A/B/C/D/E) concatenate parallel towers on the channel axis — XLA fuses the
+concat with the consumers, and the many small convs batch onto the MXU.
+Aux-logits head included (used only when ``train`` and ``aux_logits``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+Ints = Union[int, Tuple[int, int]]
+
+
+def _pair(v: Ints) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else v
+
+
+class ConvBN(nn.Module):
+    """Conv -> BatchNorm -> ReLU, the Inception building unit."""
+
+    features: int
+    kernel: Ints = 1
+    strides: Ints = 1
+    padding: Union[str, Sequence[Tuple[int, int]]] = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, _pair(self.kernel), _pair(self.strides),
+                    padding=self.padding, use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype,
+                         param_dtype=jnp.float32)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    """35x35 block: 1x1 / 5x5 / double-3x3 / pool towers."""
+
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        t1 = cbn(64)(x, train)
+        t5 = cbn(64, 5)(cbn(48)(x, train), train)
+        t3 = cbn(96, 3)(cbn(96, 3)(cbn(64)(x, train), train), train)
+        tp = cbn(self.pool_features)(_avg_pool_same(x), train)
+        return jnp.concatenate([t1, t5, t3, tp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35x35 -> 17x17 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        t3 = cbn(384, 3, 2, "VALID")(x, train)
+        td = cbn(96, 3, 2, "VALID")(
+            cbn(96, 3)(cbn(64)(x, train), train), train)
+        tp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([t3, td, tp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 block with factorized 7x7 (1x7 + 7x1) towers."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        c7 = self.channels_7x7
+        t1 = cbn(192)(x, train)
+        t7 = cbn(192, (1, 7))(
+            cbn(c7, (7, 1))(cbn(c7)(x, train), train), train)
+        td = cbn(c7)(x, train)
+        for k, f in [((7, 1), c7), ((1, 7), c7), ((7, 1), c7), ((1, 7), 192)]:
+            td = cbn(f, k)(td, train)
+        tp = cbn(192)(_avg_pool_same(x), train)
+        return jnp.concatenate([t1, t7, td, tp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17x17 -> 8x8 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        t3 = cbn(320, 3, 2, "VALID")(cbn(192)(x, train), train)
+        t7 = cbn(192, 3, 2, "VALID")(
+            cbn(192, (7, 1))(
+                cbn(192, (1, 7))(cbn(192)(x, train), train), train), train)
+        tp = nn.max_pool(x, (3, 3), strides=(2, 2))
+        return jnp.concatenate([t3, t7, tp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 block with expanded-filterbank (split 1x3 / 3x1) towers."""
+
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        t1 = cbn(320)(x, train)
+        a = cbn(384)(x, train)
+        t3 = jnp.concatenate(
+            [cbn(384, (1, 3))(a, train), cbn(384, (3, 1))(a, train)], axis=-1)
+        b = cbn(384, 3)(cbn(448)(x, train), train)
+        td = jnp.concatenate(
+            [cbn(384, (1, 3))(b, train), cbn(384, (3, 1))(b, train)], axis=-1)
+        tp = cbn(192)(_avg_pool_same(x), train)
+        return jnp.concatenate([t1, t3, td, tp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception V3 classifier. Input 299x299x3 (any HxW >= 75 works).
+
+    ``aux_logits``: when True and ``train``, returns ``(logits, aux_logits)``
+    as in the paper; otherwise just ``logits``.
+    """
+
+    num_classes: int = 1000
+    aux_logits: bool = False
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = partial(ConvBN, dtype=self.dtype)
+        x = jnp.asarray(x, self.dtype)
+        # Stem: 299 -> 35x35x192.
+        x = cbn(32, 3, 2, "VALID")(x, train)
+        x = cbn(32, 3, 1, "VALID")(x, train)
+        x = cbn(64, 3)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = cbn(80, 1, 1, "VALID")(x, train)
+        x = cbn(192, 3, 1, "VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        # 3x A @35, B, 4x C @17, D, 2x E @8.
+        for pool_features in (32, 64, 64):
+            x = InceptionA(pool_features, dtype=self.dtype)(x, train)
+        x = InceptionB(dtype=self.dtype)(x, train)
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, dtype=self.dtype)(x, train)
+        aux = None
+        if self.aux_logits and train:
+            # 5x5/3 pool as in the paper; clamped so sub-299 inputs (tests)
+            # keep a non-empty grid.
+            win = (min(5, x.shape[1]), min(5, x.shape[2]))
+            a = nn.avg_pool(x, win, strides=(3, 3))
+            a = cbn(128)(a, train)
+            a = cbn(768, a.shape[1:3], padding="VALID")(a, train)
+            a = jnp.mean(a, axis=(1, 2))
+            aux = nn.Dense(self.num_classes, dtype=jnp.float32,
+                           param_dtype=jnp.float32, name="aux_head")(a)
+        x = InceptionD(dtype=self.dtype)(x, train)
+        for _ in range(2):
+            x = InceptionE(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        if aux is not None:
+            return x, aux
+        return x

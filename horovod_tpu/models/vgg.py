@@ -1,0 +1,64 @@
+"""VGG family in flax — the third model of the reference's headline benchmark
+table (68% scaling efficiency for VGG-16 at 512 GPUs, reference
+``README.md:58``, ``docs/benchmarks.md:6``).
+
+TPU-first: NHWC layout, bfloat16 activations / float32 parameters, and the
+classifier MLP expressed as plain Dense layers so the big 25088x4096 matmul
+lands on the MXU in bf16. VGG is deliberately the communication-heavy member
+of the benchmark set (138M parameters, mostly in the classifier) — it is the
+model that stresses gradient all-reduce bandwidth rather than compute, which
+is why the reference reports its scaling separately.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG(nn.Module):
+    """VGG-A/B/D/E ("11/13/16/19-layer") convnet.
+
+    ``stage_sizes`` gives the number of 3x3 convs per stage; each stage ends
+    with a 2x2 max-pool. ``num_filters`` doubles per stage, capped at 512.
+    """
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    classifier_width: int = 4096
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        x = jnp.asarray(x, self.dtype)
+        for i, n_convs in enumerate(self.stage_sizes):
+            filters = min(self.num_filters * 2 ** i, 512)
+            for j in range(n_convs):
+                x = conv(filters, name=f"conv{i}_{j}")(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for k in range(2):
+            x = nn.Dense(self.classifier_width, dtype=self.dtype,
+                         param_dtype=jnp.float32, name=f"fc{k}")(x)
+            x = nn.relu(x)
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+VGG11 = partial(VGG, stage_sizes=[1, 1, 2, 2, 2])
+VGG13 = partial(VGG, stage_sizes=[2, 2, 2, 2, 2])
+VGG16 = partial(VGG, stage_sizes=[2, 2, 3, 3, 3])
+VGG19 = partial(VGG, stage_sizes=[2, 2, 4, 4, 4])
+# Tiny variant for hermetic CPU tests.
+VGGTiny = partial(VGG, stage_sizes=[1, 1], num_filters=8,
+                  classifier_width=32, num_classes=10)

@@ -97,7 +97,11 @@ class DistributedTrainer(mx.gluon.Trainer):
 
     def __init__(self, params, optimizer, optimizer_params=None):
         if isinstance(optimizer, DistributedOptimizer):
+            # Undo the wrapper's rescale_grad /= size before gluon reads it
+            # into _scale, or the division below would apply twice
+            # (1/size**2 effective average).
             optimizer = optimizer._optimizer
+            optimizer.rescale_grad *= size()
             warnings.warn("DistributedTrainer does not take "
                           "DistributedOptimizer as its optimizer. We have "
                           "unwrapped it for you.")

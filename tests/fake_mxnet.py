@@ -136,8 +136,13 @@ class Trainer:
         if isinstance(params, dict):
             params = [params[k] for k in sorted(params)]
         self._params = list(params)
+        if optimizer_params:
+            for k, v in optimizer_params.items():
+                setattr(optimizer, k, v)
         self._optimizer = optimizer
-        self._scale = (optimizer_params or {}).get("rescale_grad", 1.0)
+        # Real gluon: Trainer._scale mirrors the optimizer's rescale_grad and
+        # step() writes _scale/batch_size back into the optimizer.
+        self._scale = optimizer.rescale_grad
         self._kvstore = kvstore
 
     def _allreduce_grads(self):
@@ -145,12 +150,13 @@ class Trainer:
 
     def step(self, batch_size):
         self._allreduce_grads()
+        self._optimizer.rescale_grad = self._scale / batch_size
         for param in self._params:
             if param.grad_req == "null":
                 continue
             w, g = param.data(), param.list_grad()[0]
-            w[:] = w.asnumpy() - self._optimizer.lr * self._scale \
-                * g.asnumpy()
+            w[:] = w.asnumpy() - self._optimizer.lr \
+                * self._optimizer.rescale_grad * g.asnumpy()
 
 
 class ResizeIter:

@@ -7,8 +7,10 @@ import numpy as np
 from horovod_tpu.models import (
     BERT_TINY,
     BertEncoder,
+    InceptionV3,
     MnistMLP,
     ResNetTiny,
+    VGGTiny,
     mlm_loss,
 )
 
@@ -60,6 +62,38 @@ def test_bert_attention_mask():
                        deterministic=True)
     np.testing.assert_allclose(np.asarray(out_masked[0, :4]),
                                np.asarray(out2[0, :4]), atol=1e-5)
+
+
+def test_vgg_tiny_forward():
+    model = VGGTiny(dtype=jnp.float32)
+    x = jnp.ones((2, 16, 16, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_inception_v3_forward():
+    # 75x75 is the smallest valid input; keeps the CPU test fast while
+    # exercising every block type (A/B/C/D/E + stem).
+    model = InceptionV3(num_classes=7, dtype=jnp.float32)
+    x = jnp.ones((1, 75, 75, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (1, 7)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_inception_v3_aux_logits():
+    model = InceptionV3(num_classes=5, aux_logits=True, dtype=jnp.float32)
+    x = jnp.ones((1, 75, 75, 3))
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x, train=True)
+    (logits, aux), _ = model.apply(
+        variables, x, train=True, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(2)})
+    assert logits.shape == (1, 5) and aux.shape == (1, 5)
 
 
 def test_mnist_mlp():

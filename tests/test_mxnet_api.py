@@ -101,6 +101,27 @@ def test_distributed_trainer_unwraps_and_scales():
     np.testing.assert_allclose(skip.data().asnumpy(), [0.0])
 
 
+def test_distributed_trainer_unwrap_no_double_divide(monkeypatch):
+    """At size>1 the unwrap path must yield _scale = rescale/size, not
+    rescale/size**2 (wrapper already divided rescale_grad once)."""
+    monkeypatch.setattr(hvd_mx, "size", lambda: 4)
+    opt = mx.optimizer.Optimizer(learning_rate=1.0, rescale_grad=2.0)
+    dopt = hvd_mx.DistributedOptimizer(opt)
+    assert opt.rescale_grad == 0.5  # 2.0 / 4
+    p = fake_mxnet.Parameter(
+        "w", data=mx.nd.array(np.ones(2, dtype=np.float32)),
+        grad=mx.nd.array(np.ones(2, dtype=np.float32)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        trainer = hvd_mx.DistributedTrainer([p], dopt)
+    # unwrap restored rescale_grad to 2.0, then _scale = 2.0 / 4.
+    assert trainer._optimizer is opt
+    assert trainer._scale == 0.5
+    trainer.step(batch_size=1)
+    # real-gluon semantics: step writes rescale_grad = _scale / batch_size
+    assert opt.rescale_grad == 0.5
+
+
 def test_broadcast_parameters_dict_and_deferred():
     d = {"b": mx.nd.array(np.ones(2)), "a": mx.nd.array(np.zeros(2))}
     hvd_mx.broadcast_parameters(d)  # size 1: no-op, must not raise
