@@ -1,0 +1,77 @@
+"""MNIST via the Spark integration: each executor becomes a rank.
+
+Counterpart of the reference's ``examples/keras_spark_rossmann.py`` pattern
+(`horovod.spark.run(fn)` after ETL): Spark owns the data prep, then every
+executor runs the same training function as a rank of one distributed job.
+Needs a local pyspark:
+
+    python examples/spark_mnist.py --num-proc 2
+"""
+
+import argparse
+
+
+def train(epochs, batch_size, lr):
+    # Runs on each Spark executor as one rank; topology is already in the
+    # environment when horovod_tpu.spark.run hands control to us.
+    import numpy as np
+    import torch
+    import torch.nn.functional as F
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    torch.manual_seed(0)
+
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 10, size=2048).astype(np.int64)
+    centers = rng.rand(10, 28 * 28).astype(np.float32)
+    x = centers[y] + 0.3 * rng.rand(2048, 28 * 28).astype(np.float32)
+    x, y = x[hvd.rank()::hvd.size()], y[hvd.rank()::hvd.size()]
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(28 * 28, 128), torch.nn.ReLU(),
+        torch.nn.Linear(128, 10))
+    optimizer = torch.optim.SGD(model.parameters(), lr=lr * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    loss_val = None
+    for _ in range(epochs):
+        for i in range(0, len(x) - batch_size + 1, batch_size):
+            xb = torch.from_numpy(x[i:i + batch_size])
+            yb = torch.from_numpy(y[i:i + batch_size])
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(xb), yb)
+            loss.backward()
+            optimizer.step()
+            loss_val = float(loss)
+    return hvd.rank(), loss_val
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-proc", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    args = parser.parse_args()
+
+    from pyspark.sql import SparkSession
+
+    spark = (SparkSession.builder.master(f"local[{args.num_proc}]")
+             .appName("horovod_tpu_spark_mnist").getOrCreate())
+
+    import horovod_tpu.spark
+
+    results = horovod_tpu.spark.run(
+        train, args=(args.epochs, args.batch_size, args.lr),
+        num_proc=args.num_proc)
+    for rank, loss in results:
+        print(f"rank {rank}: final loss={loss:.4f}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

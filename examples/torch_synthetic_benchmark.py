@@ -1,0 +1,83 @@
+"""Synthetic benchmark on the torch eager tier — img/sec per rank and total.
+
+Counterpart of the reference's ``examples/pytorch_synthetic_benchmark.py``:
+a conv net on synthetic ImageNet-shaped batches, gradients averaged by the
+wrapped optimizer every step. torch in this image is CPU-only, so the model
+defaults to a small stand-in; the point of the script is measuring the
+framework's eager collective path, same as the reference's.
+
+    bin/horovodrun -np 2 python examples/torch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallConvNet(nn.Module):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 32, 3, stride=2, padding=1)
+        self.conv2 = nn.Conv2d(32, 64, 3, stride=2, padding=1)
+        self.pool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(64, num_classes)
+
+    def forward(self, x):
+        x = F.relu(self.conv1(x))
+        x = F.relu(self.conv2(x))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=64)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--num-warmup", type=int, default=2)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+
+    model = SmallConvNet()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, args.image_size, args.image_size)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup):
+        benchmark_step()
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        benchmark_step()
+    elapsed = time.perf_counter() - t0
+
+    img_sec = args.batch_size * args.num_iters / elapsed
+    # Reference prints per-rank then a rank-0 total averaged via allreduce
+    # (pytorch_synthetic_benchmark.py); same shape here.
+    print(f"rank {hvd.rank()}: {img_sec:.1f} img/sec")
+    total = hvd.allreduce(torch.tensor(img_sec), average=False,
+                          name="bench.img_sec")
+    if hvd.rank() == 0:
+        print(f"total img/sec on {hvd.size()} ranks: {float(total):.1f}")
+
+
+if __name__ == "__main__":
+    main()

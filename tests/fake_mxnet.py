@@ -44,6 +44,28 @@ class NDArray:
     def __repr__(self):
         return f"NDArray({self._data!r})"
 
+    def mean(self):
+        return NDArray(self._data.mean())
+
+    def asscalar(self):
+        return self._data.reshape(-1)[0].item()
+
+    def backward(self):
+        """Reverse pass for the one graph shape the fake supports:
+        Dense → SoftmaxCrossEntropyLoss (see ``Dense.__call__`` /
+        ``SoftmaxCrossEntropyLoss.__call__``)."""
+        ctx = getattr(self, "_ce_ctx", None)
+        if ctx is None:
+            return
+        logits, probs, labels = ctx
+        d = probs.copy()
+        d[np.arange(len(labels)), labels] -= 1.0
+        dense_ctx = getattr(logits, "_dense_ctx", None)
+        if dense_ctx is not None:
+            layer, x = dense_ctx
+            layer.weight._grad[:] = (d.T @ x.asnumpy()).astype(np.float32)
+            layer.bias._grad[:] = d.sum(axis=0).astype(np.float32)
+
 
 def _nd_array(data, dtype=None, ctx=None):
     if isinstance(data, NDArray):
@@ -135,6 +157,8 @@ class Trainer:
                  kvstore=None):
         if isinstance(params, dict):
             params = [params[k] for k in sorted(params)]
+        elif isinstance(params, ParameterDict):
+            params = [v for _, v in sorted(params.items())]
         self._params = list(params)
         if optimizer_params:
             for k, v in optimizer_params.items():
@@ -157,6 +181,72 @@ class Trainer:
             w, g = param.data(), param.list_grad()[0]
             w[:] = w.asnumpy() - self._optimizer.lr \
                 * self._optimizer.rescale_grad * g.asnumpy()
+
+
+class _AutogradState:
+    recording = False
+
+
+class _RecordScope:
+    def __enter__(self):
+        _AutogradState.recording = True
+        return self
+
+    def __exit__(self, *exc):
+        _AutogradState.recording = False
+        return False
+
+
+def _autograd_record():
+    return _RecordScope()
+
+
+class Dense:
+    """Shape of ``mx.gluon.nn.Dense(units, in_units=...)`` — enough for the
+    mnist example: forward matmul, analytic backward via the loss below."""
+
+    def __init__(self, units, in_units):
+        self._units, self._in_units = units, in_units
+        self.weight = Parameter("dense0_weight")
+        self.bias = Parameter("dense0_bias")
+
+    def initialize(self, init=None):
+        rng = np.random.RandomState(0)
+        self.weight._data = NDArray(
+            rng.randn(self._units, self._in_units).astype(np.float32) * 0.01)
+        self.weight._grad = NDArray(
+            np.zeros((self._units, self._in_units), np.float32))
+        self.bias._data = NDArray(np.zeros(self._units, np.float32))
+        self.bias._grad = NDArray(np.zeros(self._units, np.float32))
+
+    def collect_params(self):
+        pd = ParameterDict()
+        pd[self.weight.name] = self.weight
+        pd[self.bias.name] = self.bias
+        return pd
+
+    def __call__(self, x):
+        y = NDArray(x.asnumpy() @ self.weight.data().asnumpy().T
+                    + self.bias.data().asnumpy())
+        if _AutogradState.recording:
+            y._dense_ctx = (self, x)
+        return y
+
+
+class SoftmaxCrossEntropyLoss:
+    """Shape of ``mx.gluon.loss.SoftmaxCrossEntropyLoss``: per-sample loss
+    vector whose ``backward()`` fills the producing Dense layer's grads."""
+
+    def __call__(self, logits, labels):
+        z = logits.asnumpy()
+        z = z - z.max(axis=1, keepdims=True)
+        p = np.exp(z)
+        p /= p.sum(axis=1, keepdims=True)
+        lab = labels.asnumpy().astype(int)
+        loss = -np.log(np.clip(p[np.arange(len(lab)), lab], 1e-12, None))
+        out = NDArray(loss.astype(np.float32))
+        out._ce_ctx = (logits, p, lab)
+        return out
 
 
 class ResizeIter:
@@ -189,14 +279,18 @@ def module():
     mx = types.ModuleType("mxnet")
     mx.nd = types.SimpleNamespace(array=_nd_array, zeros=_nd_zeros,
                                   NDArray=NDArray)
-    mx.optimizer = types.SimpleNamespace(Optimizer=Optimizer)
+    mx.optimizer = types.SimpleNamespace(Optimizer=Optimizer, SGD=Optimizer)
     mx.gluon = types.SimpleNamespace(
         Trainer=Trainer,
+        nn=types.SimpleNamespace(Dense=Dense),
+        loss=types.SimpleNamespace(
+            SoftmaxCrossEntropyLoss=SoftmaxCrossEntropyLoss),
         parameter=types.SimpleNamespace(
             ParameterDict=ParameterDict,
             Parameter=Parameter,
             DeferredInitializationError=DeferredInitializationError),
     )
+    mx.autograd = types.SimpleNamespace(record=_autograd_record)
     mx.io = types.SimpleNamespace(ResizeIter=ResizeIter)
     mx.metric = types.SimpleNamespace(EvalMetric=EvalMetric)
     mx.NDArray = NDArray

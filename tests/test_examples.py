@@ -15,6 +15,10 @@ def _run(cmd, timeout=300, extra_env=None):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["HOROVOD_CYCLE_TIME"] = "1"
+    # These subprocesses are CPU-only; without this the axon sitecustomize
+    # tries to claim the TPU the pytest parent already holds and each
+    # interpreter blocks minutes on the grant timeout.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(extra_env or {})
     res = subprocess.run(cmd, env=env, capture_output=True, text=True,
                          timeout=timeout, cwd=REPO)
@@ -47,3 +51,41 @@ def test_bert_example_smoke():
                 "--model", "tiny", "--seq-len", "32", "--batch-size", "1",
                 "--num-iters", "2"])
     assert "sequences/sec" in out
+
+
+def test_word2vec_example_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_word2vec.py"),
+                "--steps", "50", "--batch-size", "256",
+                "--vocab-size", "2000", "--embedding-dim", "32"])
+    assert "pairs/sec" in out
+
+
+def test_mxnet_example_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "mxnet_mnist.py"),
+                "--epochs", "1"])
+    assert "epoch 0" in out
+
+
+def test_imagenet_resnet50_checkpoint_resume(tmp_path):
+    ck = str(tmp_path / "ckpts")
+    script = os.path.join(EX, "jax_imagenet_resnet50.py")
+    args = ["--image-size", "32", "--batch-per-chip", "1", "--warmup-steps",
+            "2", "--checkpoint-dir", ck, "--checkpoint-every", "2"]
+    # Small mesh + persistent compile cache keep the two ResNet-50 compiles
+    # affordable on the 1-core CI box.
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+           "JAX_COMPILATION_CACHE_DIR": str(tmp_path / "xla_cache")}
+    _run([sys.executable, script, "--steps", "2"] + args, extra_env=env)
+    out = _run([sys.executable, script, "--steps", "3"] + args,
+               extra_env=env)
+    assert "resumed" in out and "ckpt_2" in out
+
+
+def test_torch_synthetic_benchmark_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable,
+                os.path.join(EX, "torch_synthetic_benchmark.py"),
+                "--num-iters", "2", "--num-warmup", "1",
+                "--batch-size", "8", "--image-size", "32"])
+    assert "total img/sec on 2 ranks" in out

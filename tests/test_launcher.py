@@ -26,6 +26,8 @@ def _run_launcher(args, timeout=180):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["HOROVOD_CYCLE_TIME"] = "1"
+    # CPU-only children must not contend for the TPU the parent holds.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     return subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run"] + args,
         env=env, capture_output=True, text=True, timeout=timeout)

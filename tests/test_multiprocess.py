@@ -43,6 +43,9 @@ def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
             "JAX_PLATFORMS": "cpu",
             "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         })
+        # CPU-only rank processes must not contend for the TPU the pytest
+        # parent holds (axon sitecustomize blocks minutes on the grant).
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, WORKER, scenario],
