@@ -121,7 +121,9 @@ def main():
         for _ in range(args.steps):
             out = step(*state, xb, yb)
             state, loss = out[:-1], out[-1]
-            jax.block_until_ready(loss)
+            # Host fetch as the sync barrier: on the tunneled platform,
+            # block_until_ready can return before execution completes.
+            jax.device_get(loss)
         dt = time.perf_counter() - t0
         return b * args.steps / dt
 

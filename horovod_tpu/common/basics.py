@@ -63,6 +63,42 @@ _state: Optional[HorovodTpuState] = None
 _state_lock = threading.Lock()
 
 
+def _maybe_init_jax_distributed() -> None:
+    """Join the JAX distributed runtime when the launcher requested SPMD
+    multi-host mode (``horovodrun --spmd``).
+
+    This is the TPU-native analogue of the reference's multi-node data plane
+    (NCCL ring over the cluster, ``horovod/common/ops/nccl_operations.cc``):
+    after ``jax.distributed.initialize`` every process sees the *global*
+    device set, ``hvd.parallel.mesh()`` spans all hosts, and collectives
+    inside ``jit`` ride ICI within a slice and DCN across slices — no
+    per-tensor controller needed (the SPMD program itself is the negotiation,
+    SURVEY.md §5)."""
+    coord = os.environ.get("HOROVOD_SPMD_COORDINATOR")
+    if not coord:
+        return
+    rank = os.environ.get("HOROVOD_RANK")
+    size = os.environ.get("HOROVOD_SIZE")
+    if rank is None or size is None:
+        raise RuntimeError(
+            "HOROVOD_SPMD_COORDINATOR is set but HOROVOD_RANK/HOROVOD_SIZE "
+            "are not; launch through horovodrun --spmd (or export all three)")
+    import jax
+
+    try:
+        from jax._src import distributed as _dist
+
+        already = _dist.global_state.client is not None
+    except Exception:
+        already = False
+    if already:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(size),
+        process_id=int(rank))
+
+
 def init(ranks: Optional[Sequence[int]] = None) -> None:
     """Initialize horovod_tpu. Idempotent, like the reference's
     ``InitializeHorovodOnce`` (``horovod/common/operations.cc:1566-1583``).
@@ -78,6 +114,7 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
             return
         config = Config.from_env()
         logging.configure(config.log_level, config.log_hide_timestamp)
+        _maybe_init_jax_distributed()
         topology = detect(ranks)
         logging.set_rank(topology.rank)
         _state = HorovodTpuState(config, topology)

@@ -68,7 +68,7 @@ def _is_local(host: str) -> bool:
 def build_rank_env(base: Dict[str, str], rank: int, size: int,
                    local_rank: int, local_size: int, cross_rank: int,
                    cross_size: int, controller_addr: str, secret: str,
-                   bind_chips: bool) -> Dict[str, str]:
+                   bind_chips: bool, spmd: bool = False) -> Dict[str, str]:
     env = dict(base)
     env.update({
         "HOROVOD_RANK": str(rank),
@@ -77,9 +77,18 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
         "HOROVOD_LOCAL_SIZE": str(local_size),
         "HOROVOD_CROSS_RANK": str(cross_rank),
         "HOROVOD_CROSS_SIZE": str(cross_size),
-        "HOROVOD_CONTROLLER_ADDR": controller_addr,
         "HOROVOD_SECRET_KEY": secret,
     })
+    if spmd:
+        # SPMD multi-host mode: ranks join the JAX distributed runtime and
+        # every process sees the global device set; no eager controller.
+        # Scrub any eager-tier endpoints inherited from the launcher's own
+        # environment or the worker would also try to join a stale TCP ring.
+        env.pop("HOROVOD_CONTROLLER_ADDR", None)
+        env.pop("HOROVOD_RING_ADDRS", None)
+        env["HOROVOD_SPMD_COORDINATOR"] = controller_addr
+    else:
+        env["HOROVOD_CONTROLLER_ADDR"] = controller_addr
     if bind_chips:
         env["TPU_VISIBLE_DEVICES"] = str(local_rank)
         env["TPU_PROCESS_BOUNDS"] = f"1,1,1"
@@ -98,9 +107,12 @@ def run(args: argparse.Namespace) -> int:
     size = args.np
     secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
     coord_host = hosts[0][0]
-    coord_addr = (args.controller_addr
-                  or f"{'127.0.0.1' if _is_local(coord_host) else coord_host}"
-                     f":{_free_port()}")
+    any_remote_host = any(not _is_local(h) for h, _ in hosts)
+    if _is_local(coord_host):
+        # With remote hosts in play the coordinator must be reachable from
+        # them — loopback only works for all-local jobs.
+        coord_host = socket.gethostname() if any_remote_host else "127.0.0.1"
+    coord_addr = args.controller_addr or f"{coord_host}:{_free_port()}"
 
     assignments = []  # (rank, host, local_rank, local_size, cross_rank)
     rank = 0
@@ -117,12 +129,11 @@ def run(args: argparse.Namespace) -> int:
     # local entries must be reachable, so use the hostname and a common base
     # port on remote machines (override via HOROVOD_RING_ADDRS if the
     # heuristic clashes).
-    any_remote = any(not _is_local(h) for _, h, _, _, _ in assignments)
     ring_base = _free_port()
     ring_addrs = []
     for r, host, _, _, _ in assignments:
         if _is_local(host):
-            addr_host = socket.gethostname() if any_remote else "127.0.0.1"
+            addr_host = socket.gethostname() if any_remote_host else "127.0.0.1"
             ring_addrs.append(f"{addr_host}:{_free_port()}")
         else:
             ring_addrs.append(f"{host}:{ring_base + r}")
@@ -136,8 +147,10 @@ def run(args: argparse.Namespace) -> int:
     def spawn(rank, host, local_rank, local_size, cross_rank):
         env = build_rank_env(
             dict(os.environ), rank, size, local_rank, local_size,
-            cross_rank, len(hosts), coord_addr, secret, args.bind_chips)
-        env["HOROVOD_RING_ADDRS"] = ring_addrs_env
+            cross_rank, len(hosts), coord_addr, secret, args.bind_chips,
+            spmd=args.spmd)
+        if not args.spmd:
+            env["HOROVOD_RING_ADDRS"] = ring_addrs_env
         if _is_local(host):
             cmd = args.command
         else:
@@ -212,12 +225,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--bind-chips", action="store_true",
                         help="partition local TPU chips among local ranks via "
                              "TPU_VISIBLE_DEVICES (one-chip-per-rank model)")
+    parser.add_argument("--spmd", action="store_true",
+                        help="SPMD multi-host mode: ranks join the JAX "
+                             "distributed runtime (one process per host, "
+                             "global mesh over all chips); collectives run "
+                             "inside jit over ICI/DCN instead of the eager "
+                             "controller")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
     args = parser.parse_args(argv)
     if not args.command:
         parser.error("no command given")
+    if args.spmd and args.bind_chips:
+        parser.error("--spmd and --bind-chips conflict: SPMD mode needs "
+                     "every process to see all its host's chips")
     if args.command[0] == "--":
         args.command = args.command[1:]
     return run(args)
