@@ -86,11 +86,11 @@ def _maybe_init_jax_distributed() -> None:
     import jax
 
     try:
+        already = jax.distributed.is_initialized()
+    except AttributeError:  # older jax without the public probe
         from jax._src import distributed as _dist
 
         already = _dist.global_state.client is not None
-    except Exception:
-        already = False
     if already:
         return
     jax.distributed.initialize(

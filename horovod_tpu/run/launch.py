@@ -86,6 +86,7 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
         # environment or the worker would also try to join a stale TCP ring.
         env.pop("HOROVOD_CONTROLLER_ADDR", None)
         env.pop("HOROVOD_RING_ADDRS", None)
+        env.pop("HOROVOD_ENGINE", None)
         env["HOROVOD_SPMD_COORDINATOR"] = controller_addr
     else:
         env["HOROVOD_CONTROLLER_ADDR"] = controller_addr
@@ -124,21 +125,24 @@ def run(args: argparse.Namespace) -> int:
         if rank >= size:
             break
 
-    # Per-rank addresses for the native C++ ring data plane. Local-only jobs
-    # bind loopback with verified-free ports; with remote hosts in play the
-    # local entries must be reachable, so use the hostname and a common base
-    # port on remote machines (override via HOROVOD_RING_ADDRS if the
-    # heuristic clashes).
-    ring_base = _free_port()
-    ring_addrs = []
-    for r, host, _, _, _ in assignments:
-        if _is_local(host):
-            addr_host = socket.gethostname() if any_remote_host else "127.0.0.1"
-            ring_addrs.append(f"{addr_host}:{_free_port()}")
-        else:
-            ring_addrs.append(f"{host}:{ring_base + r}")
-    ring_addrs_env = os.environ.get("HOROVOD_RING_ADDRS",
-                                    ",".join(ring_addrs))
+    # Per-rank addresses for the native C++ ring data plane (eager tier only;
+    # SPMD workers have no ring). Local-only jobs bind loopback with
+    # verified-free ports; with remote hosts in play the local entries must
+    # be reachable, so use the hostname and a common base port on remote
+    # machines (override via HOROVOD_RING_ADDRS if the heuristic clashes).
+    ring_addrs_env = None
+    if not args.spmd:
+        ring_base = _free_port()
+        ring_addrs = []
+        for r, host, _, _, _ in assignments:
+            if _is_local(host):
+                addr_host = (socket.gethostname() if any_remote_host
+                             else "127.0.0.1")
+                ring_addrs.append(f"{addr_host}:{_free_port()}")
+            else:
+                ring_addrs.append(f"{host}:{ring_base + r}")
+        ring_addrs_env = os.environ.get("HOROVOD_RING_ADDRS",
+                                        ",".join(ring_addrs))
 
     procs: List[subprocess.Popen] = []
     threads = []
