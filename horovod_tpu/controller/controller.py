@@ -122,6 +122,33 @@ class Controller:
             self._ring = RingBackend(topology.rank, topology.size,
                                      ring_addrs, job_secret())
 
+        # Two-level (hierarchical) data plane: a ring inside each node plus a
+        # ring of local roots across nodes — the analogue of the reference's
+        # NCCLHierarchicalAllreduce (intra-node NCCL + inter-node MPI,
+        # common/ops/nccl_operations.cc:167-363) and MPIHierarchicalAllgather
+        # (common/ops/mpi_operations.cc:179-329). Enabled by the reference's
+        # HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER env vars when the launcher
+        # exported per-group ring addresses.
+        self._local_ring = None
+        self._cross_ring = None
+        if ((config.hierarchical_allreduce or config.hierarchical_allgather)
+                and topology.local_size > 1 and topology.cross_size > 1):
+            local_addrs = os.environ.get("HOROVOD_LOCAL_RING_ADDRS")
+            cross_addrs = os.environ.get("HOROVOD_CROSS_RING_ADDRS")
+            if local_addrs and cross_addrs:  # both or neither: the path
+                # choice must be identical on every rank or the data phases
+                # deadlock.
+                from ..common.wire import job_secret
+                from ..core.bindings import RingBackend
+
+                self._local_ring = RingBackend(
+                    topology.local_rank, topology.local_size, local_addrs,
+                    job_secret())
+                if topology.local_rank == 0:
+                    self._cross_ring = RingBackend(
+                        topology.cross_rank, topology.cross_size, cross_addrs,
+                        job_secret())
+
         addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
         if topology.rank == 0:
             self._service = CoordinatorService(addr, topology.size)
@@ -271,8 +298,9 @@ class Controller:
             self._fail_all(exc)
         finally:
             self._closed.set()
-            if self._ring is not None:
-                self._ring.shutdown()
+            for ring in (self._ring, self._local_ring, self._cross_ring):
+                if ring is not None:
+                    ring.shutdown()
             if self._service:
                 self._service.close()
             if self._client:
@@ -549,7 +577,16 @@ class Controller:
         if self.timeline:
             self.timeline.activity_end(tname)
             self.timeline.activity_start(tname, tl.TCP_COLLECTIVE)
-        if self._use_ring(dtype):
+        if self._use_hierarchical(dtype, self.cfg.hierarchical_allreduce):
+            # Two-level: sum inside the node, exchange node sums via the
+            # local roots' cross ring, fan the result back out locally
+            # (NCCLHierarchicalAllreduce shape, nccl_operations.cc:167-363).
+            result = np.array(buf, copy=True)
+            self._local_ring.allreduce_(result, average=False)
+            if self.topo.local_rank == 0:
+                self._cross_ring.allreduce_(result, average=False)
+            self._local_ring.broadcast_(result, 0)
+        elif self._use_ring(dtype):
             # Native C++ ring (bandwidth-optimal; reduce-scatter + allgather).
             result = np.array(buf, copy=True)
             self._ring.allreduce_(result, average=False)
@@ -587,9 +624,41 @@ class Controller:
         return (self._ring is not None
                 and RingBackend.dtype_code(dtype) is not None)
 
+    def _use_hierarchical(self, dtype, enabled: bool) -> bool:
+        """Deterministic like _use_ring: config flags and group rings are
+        identical on every rank (launcher-exported env)."""
+        from ..core.bindings import RingBackend
+
+        return (enabled and self._local_ring is not None
+                and RingBackend.dtype_code(dtype) is not None)
+
     def _execute_allgather(self, entry: _Pending, response: Response) -> None:
         dtype = entry.array.dtype
         rest = entry.array.shape[1:]
+        if self._use_hierarchical(dtype, self.cfg.hierarchical_allgather):
+            # Two-level: gather inside the node, local roots exchange node
+            # blobs over the cross ring, fan the full result back out
+            # (MPIHierarchicalAllgather shape, mpi_operations.cc:179-329;
+            # contiguous rank grouping makes node order == rank order).
+            rest_elems = int(np.prod(rest, dtype=np.int64)) if rest else 1
+            ls, cr = self.topo.local_size, self.topo.cross_rank
+            sizes = response.tensor_sizes
+            local_counts = [s * rest_elems
+                            for s in sizes[cr * ls:(cr + 1) * ls]]
+            local_flat = self._local_ring.allgather(
+                entry.array.ravel(), local_counts)
+            total = sum(sizes) * rest_elems
+            if self.topo.local_rank == 0:
+                group_counts = [
+                    sum(s * rest_elems for s in sizes[g * ls:(g + 1) * ls])
+                    for g in range(self.topo.cross_size)]
+                flat = self._cross_ring.allgather(local_flat, group_counts)
+            else:
+                flat = np.empty(total, dtype=dtype)
+            self._local_ring.broadcast_(flat, 0)
+            full = flat.reshape((sum(sizes),) + rest)
+            self._finish(entry, np.array(full, copy=True))
+            return
         if self._use_ring(dtype):
             rest_elems = int(np.prod(rest, dtype=np.int64)) if rest else 1
             counts = [s * rest_elems for s in response.tensor_sizes]

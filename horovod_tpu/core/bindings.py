@@ -113,6 +113,26 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_ring_broadcast.restype = ctypes.c_int
         lib.hvd_ring_last_error.restype = ctypes.c_char_p
         lib.hvd_ring_shutdown.restype = None
+        # Handle-based ring ABI: several rings per process (flat + the
+        # hierarchical local/cross pair).
+        lib.hvd_ringh_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int]
+        lib.hvd_ringh_create.restype = ctypes.c_void_p
+        lib.hvd_ringh_allreduce.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int]
+        lib.hvd_ringh_allreduce.restype = ctypes.c_int
+        lib.hvd_ringh_allgather.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.c_void_p, ctypes.c_int]
+        lib.hvd_ringh_allgather.restype = ctypes.c_int
+        lib.hvd_ringh_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int]
+        lib.hvd_ringh_broadcast.restype = ctypes.c_int
+        lib.hvd_ringh_destroy.argtypes = [ctypes.c_void_p]
+        lib.hvd_ringh_destroy.restype = None
         # Native eager-tier engine (engine.cc; reference C ABI shape at
         # horovod/common/operations.cc:1595-1650).
         lib.hvd_eng_init.argtypes = [
@@ -161,9 +181,11 @@ def load() -> Optional[ctypes.CDLL]:
 
 
 class RingBackend:
-    """Thin numpy-facing wrapper over the C ABI. One instance per process,
-    owned by the controller's background thread (single-threaded by
-    contract, like the reference's background-thread-owns-MPI design)."""
+    """Thin numpy-facing wrapper over the handle-based C ABI. A process can
+    hold several rings at once (the flat ring plus the hierarchical
+    local/cross pair); each is owned by the controller's background thread
+    (single-threaded by contract, like the reference's
+    background-thread-owns-MPI design)."""
 
     def __init__(self, rank: int, size: int, addrs: str, secret: bytes):
         lib = load()
@@ -171,11 +193,11 @@ class RingBackend:
             raise RuntimeError(f"native core unavailable: {_build_failed}")
         self._lib = lib
         key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
-        rc = lib.hvd_ring_init(rank, size, addrs.encode(), key, len(secret))
-        if rc != 0:
+        self._handle = lib.hvd_ringh_create(
+            rank, size, addrs.encode(), key, len(secret))
+        if not self._handle:
             raise RuntimeError(
-                f"hvd_ring_init failed: {self._last_error()}")
-        self._open = True
+                f"ring init failed: {self._last_error()}")
 
     def _last_error(self) -> str:
         return self._lib.hvd_ring_last_error().decode(errors="replace")
@@ -189,9 +211,9 @@ class RingBackend:
         code = self.dtype_code(array.dtype)
         assert code is not None, f"unsupported dtype {array.dtype}"
         assert array.flags.c_contiguous
-        rc = self._lib.hvd_ring_allreduce(
-            array.ctypes.data_as(ctypes.c_void_p), array.size, code,
-            1 if average else 0)
+        rc = self._lib.hvd_ringh_allreduce(
+            self._handle, array.ctypes.data_as(ctypes.c_void_p), array.size,
+            code, 1 if average else 0)
         if rc != 0:
             raise RuntimeError(f"ring allreduce failed: {self._last_error()}")
         return array
@@ -204,8 +226,8 @@ class RingBackend:
         assert array.flags.c_contiguous
         counts_arr = (ctypes.c_long * len(counts))(*counts)
         out = np.empty(int(sum(counts)), dtype=array.dtype)
-        rc = self._lib.hvd_ring_allgather(
-            array.ctypes.data_as(ctypes.c_void_p), counts_arr,
+        rc = self._lib.hvd_ringh_allgather(
+            self._handle, array.ctypes.data_as(ctypes.c_void_p), counts_arr,
             out.ctypes.data_as(ctypes.c_void_p), code)
         if rc != 0:
             raise RuntimeError(f"ring allgather failed: {self._last_error()}")
@@ -215,13 +237,14 @@ class RingBackend:
         code = self.dtype_code(array.dtype)
         assert code is not None, f"unsupported dtype {array.dtype}"
         assert array.flags.c_contiguous
-        rc = self._lib.hvd_ring_broadcast(
-            array.ctypes.data_as(ctypes.c_void_p), array.size, code, root)
+        rc = self._lib.hvd_ringh_broadcast(
+            self._handle, array.ctypes.data_as(ctypes.c_void_p), array.size,
+            code, root)
         if rc != 0:
             raise RuntimeError(f"ring broadcast failed: {self._last_error()}")
         return array
 
     def shutdown(self) -> None:
-        if getattr(self, "_open", False):
-            self._lib.hvd_ring_shutdown()
-            self._open = False
+        if getattr(self, "_handle", None):
+            self._lib.hvd_ringh_destroy(self._handle)
+            self._handle = None

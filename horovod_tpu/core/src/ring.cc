@@ -7,6 +7,12 @@
 //
 // Exposed as a C ABI consumed over ctypes (the reference exposes its C ABI
 // the same way, horovod/common/operations.cc:1595-1650 + common/basics.py).
+// Two surfaces: the legacy global-ring functions (hvd_ring_*) used by the
+// native engine (engine.cc), and handle-based functions (hvd_ringh_*) so one
+// process can hold several rings at once — the two-level hierarchical data
+// plane needs a local ring, a cross ring and the flat ring side by side (the
+// reference likewise holds one NCCL comm per device set,
+// nccl_operations.cc:114).
 // Single-threaded by contract: only the controller background thread calls
 // in, mirroring the reference's one-background-thread-owns-MPI design
 // (SURVEY.md §5 "Race detection").
@@ -38,12 +44,17 @@
 namespace {
 
 std::string g_error;
-int g_rank = -1, g_size = 0;
-int g_left_fd = -1;   // recv from left neighbor
-int g_right_fd = -1;  // send to right neighbor
-int g_listen_fd = -1;
 
 void set_error(const std::string& msg) { g_error = msg; }
+
+struct Ring {
+  int rank = -1;
+  int size = 0;
+  int left_fd = -1;   // recv from left neighbor
+  int right_fd = -1;  // send to right neighbor
+  int listen_fd = -1;
+  std::vector<uint8_t> secret;
+};
 
 enum DType {
   DT_F32 = 0,
@@ -263,19 +274,19 @@ bool recv_all(int fd, void* buf, size_t n) {
 // Full-duplex exchange: send `sn` bytes right while receiving `rn` bytes from
 // left. Poll-driven so large segments can't deadlock on filled socket
 // buffers (both neighbors send simultaneously each ring step).
-bool exchange(const void* sbuf, size_t sn, void* rbuf, size_t rn) {
+bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn) {
   size_t soff = 0, roff = 0;
   while (soff < sn || roff < rn) {
     struct pollfd fds[2];
     int nf = 0;
     int si = -1, ri = -1;
     if (soff < sn) {
-      fds[nf].fd = g_right_fd;
+      fds[nf].fd = ring.right_fd;
       fds[nf].events = POLLOUT;
       si = nf++;
     }
     if (roff < rn) {
-      fds[nf].fd = g_left_fd;
+      fds[nf].fd = ring.left_fd;
       fds[nf].events = POLLIN;
       ri = nf++;
     }
@@ -290,7 +301,7 @@ bool exchange(const void* sbuf, size_t sn, void* rbuf, size_t rn) {
       return false;
     }
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t k = send(g_right_fd, (const char*)sbuf + soff, sn - soff,
+      ssize_t k = send(ring.right_fd, (const char*)sbuf + soff, sn - soff,
                        MSG_NOSIGNAL);
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         set_error(std::string("send: ") + strerror(errno));
@@ -299,7 +310,7 @@ bool exchange(const void* sbuf, size_t sn, void* rbuf, size_t rn) {
       if (k > 0) soff += (size_t)k;
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
-      ssize_t k = recv(g_left_fd, (char*)rbuf + roff, rn - roff, 0);
+      ssize_t k = recv(ring.left_fd, (char*)rbuf + roff, rn - roff, 0);
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         set_error(std::string("recv: ") + strerror(errno));
         return false;
@@ -322,28 +333,30 @@ bool parse_addr(const std::string& addr, std::string* host, int* port) {
   return true;
 }
 
-std::vector<uint8_t> g_secret;
-
-void auth_token(int sender_rank, uint8_t out[32]) {
+void auth_token(const Ring& ring, int sender_rank, uint8_t out[32]) {
   char msg[64];
   int n = snprintf(msg, sizeof(msg), "hvd-ring-hello:%d", sender_rank);
-  hvd::hmac_sha256(g_secret.data(), g_secret.size(), (const uint8_t*)msg,
+  hvd::hmac_sha256(ring.secret.data(), ring.secret.size(), (const uint8_t*)msg,
                    (size_t)n, out);
 }
 
-}  // namespace
-
-extern "C" {
-
-const char* hvd_ring_last_error() { return g_error.c_str(); }
+void ring_close(Ring& ring) {
+  for (int* fd : {&ring.left_fd, &ring.right_fd, &ring.listen_fd}) {
+    if (*fd >= 0) {
+      close(*fd);
+      *fd = -1;
+    }
+  }
+  ring.rank = -1;
+  ring.size = 0;
+}
 
 // addrs: comma-separated "host:port" per rank, in rank order.
-// secret: raw bytes (hex-decoded on the Python side), length secret_len.
-int hvd_ring_init(int rank, int size, const char* addrs_cstr,
-                  const uint8_t* secret, int secret_len) {
-  g_rank = rank;
-  g_size = size;
-  g_secret.assign(secret, secret + secret_len);
+int ring_init(Ring& ring, int rank, int size, const char* addrs_cstr,
+              const uint8_t* secret, int secret_len) {
+  ring.rank = rank;
+  ring.size = size;
+  ring.secret.assign(secret, secret + secret_len);
   if (size == 1) return 0;
 
   std::vector<std::string> addrs;
@@ -358,31 +371,31 @@ int hvd_ring_init(int rank, int size, const char* addrs_cstr,
   }
   if (!cur.empty()) addrs.push_back(cur);
   if ((int)addrs.size() != size) {
-    set_error("hvd_ring_init: addrs count != size");
+    set_error("ring_init: addrs count != size");
     return -1;
   }
 
   std::string my_host;
   int my_port = 0;
   if (!parse_addr(addrs[rank], &my_host, &my_port)) {
-    set_error("hvd_ring_init: bad own address " + addrs[rank]);
+    set_error("ring_init: bad own address " + addrs[rank]);
     return -1;
   }
 
   // Listen for the left neighbor.
-  g_listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  ring.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
-  setsockopt(g_listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  setsockopt(ring.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   struct sockaddr_in sa;
   std::memset(&sa, 0, sizeof(sa));
   sa.sin_family = AF_INET;
   sa.sin_addr.s_addr = INADDR_ANY;
   sa.sin_port = htons((uint16_t)my_port);
-  if (bind(g_listen_fd, (struct sockaddr*)&sa, sizeof(sa)) < 0) {
+  if (bind(ring.listen_fd, (struct sockaddr*)&sa, sizeof(sa)) < 0) {
     set_error(std::string("bind ") + addrs[rank] + ": " + strerror(errno));
     return -1;
   }
-  if (listen(g_listen_fd, 4) < 0) {
+  if (listen(ring.listen_fd, 4) < 0) {
     set_error(std::string("listen: ") + strerror(errno));
     return -1;
   }
@@ -393,7 +406,7 @@ int hvd_ring_init(int rank, int size, const char* addrs_cstr,
   std::string rhost;
   int rport;
   if (!parse_addr(addrs[right], &rhost, &rport)) {
-    set_error("hvd_ring_init: bad right address " + addrs[right]);
+    set_error("ring_init: bad right address " + addrs[right]);
     return -1;
   }
   struct addrinfo hints, *res = nullptr;
@@ -408,10 +421,10 @@ int hvd_ring_init(int rank, int size, const char* addrs_cstr,
   }
   auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(120);
   while (true) {
-    g_right_fd = socket(AF_INET, SOCK_STREAM, 0);
-    if (connect(g_right_fd, res->ai_addr, res->ai_addrlen) == 0) break;
-    close(g_right_fd);
-    g_right_fd = -1;
+    ring.right_fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(ring.right_fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    close(ring.right_fd);
+    ring.right_fd = -1;
     if (std::chrono::steady_clock::now() > deadline) {
       freeaddrinfo(res);
       set_error("connect to right neighbor timed out: " + addrs[right]);
@@ -420,30 +433,30 @@ int hvd_ring_init(int rank, int size, const char* addrs_cstr,
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
   }
   freeaddrinfo(res);
-  setsockopt(g_right_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(ring.right_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 
   // Authenticate to the right neighbor.
   uint8_t token[36];
   uint32_t rank_be = htonl((uint32_t)rank);
   std::memcpy(token, &rank_be, 4);
-  auth_token(rank, token + 4);
-  if (!send_all(g_right_fd, token, sizeof(token))) return -1;
+  auth_token(ring, rank, token + 4);
+  if (!send_all(ring.right_fd, token, sizeof(token))) return -1;
 
   // Accept + verify the left neighbor.
   int left = (rank - 1 + size) % size;
-  g_left_fd = accept(g_listen_fd, nullptr, nullptr);
-  if (g_left_fd < 0) {
+  ring.left_fd = accept(ring.listen_fd, nullptr, nullptr);
+  if (ring.left_fd < 0) {
     set_error(std::string("accept: ") + strerror(errno));
     return -1;
   }
-  setsockopt(g_left_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(ring.left_fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   uint8_t peer[36];
-  if (!recv_all(g_left_fd, peer, sizeof(peer))) return -1;
+  if (!recv_all(ring.left_fd, peer, sizeof(peer))) return -1;
   uint32_t peer_rank_be;
   std::memcpy(&peer_rank_be, peer, 4);
   int peer_rank = (int)ntohl(peer_rank_be);
   uint8_t expect[32];
-  auth_token(peer_rank, expect);
+  auth_token(ring, peer_rank, expect);
   if (peer_rank != left || std::memcmp(peer + 4, expect, 32) != 0) {
     set_error("left-neighbor authentication failed");
     return -1;
@@ -452,7 +465,7 @@ int hvd_ring_init(int rank, int size, const char* addrs_cstr,
   // Non-blocking from here on: exchange() interleaves duplex progress via
   // poll, and a blocking send of a large segment against a neighbor doing
   // the same would deadlock once both socket buffers fill.
-  for (int fd : {g_left_fd, g_right_fd}) {
+  for (int fd : {ring.left_fd, ring.right_fd}) {
     int flags = fcntl(fd, F_GETFL, 0);
     fcntl(fd, F_SETFL, flags | O_NONBLOCK);
   }
@@ -460,15 +473,15 @@ int hvd_ring_init(int rank, int size, const char* addrs_cstr,
 }
 
 // In-place ring allreduce (sum; average divides afterwards for float types).
-int hvd_ring_allreduce(void* buf, long count, int dtype, int average) {
-  if (g_size <= 1) return 0;
+int ring_allreduce(Ring& ring, void* buf, long count, int dtype, int average) {
+  if (ring.size <= 1) return 0;
   size_t esz = dtype_size(dtype);
   if (esz == 0) {
     set_error("unsupported dtype");
     return -1;
   }
   char* base = (char*)buf;
-  long nseg = g_size;
+  long nseg = ring.size;
   long base_len = count / nseg, rem = count % nseg;
   auto seg_off = [&](long s) { return s * base_len + (s < rem ? s : rem); };
   auto seg_len = [&](long s) { return base_len + (s < rem ? 1 : 0); };
@@ -477,90 +490,148 @@ int hvd_ring_allreduce(void* buf, long count, int dtype, int average) {
 
   // Phase 1: reduce-scatter. After size-1 steps, rank r owns the fully
   // reduced segment (r+1)%size.
-  for (int step = 0; step < g_size - 1; step++) {
-    long s_send = (g_rank - step + g_size) % g_size;
-    long s_recv = (g_rank - step - 1 + g_size) % g_size;
-    if (!exchange(base + seg_off(s_send) * esz, (size_t)seg_len(s_send) * esz,
-                  tmp.data(), (size_t)seg_len(s_recv) * esz))
+  for (int step = 0; step < ring.size - 1; step++) {
+    long s_send = (ring.rank - step + ring.size) % ring.size;
+    long s_recv = (ring.rank - step - 1 + ring.size) % ring.size;
+    if (!exchange(ring, base + seg_off(s_send) * esz,
+                  (size_t)seg_len(s_send) * esz, tmp.data(),
+                  (size_t)seg_len(s_recv) * esz))
       return -1;
     accumulate(base + seg_off(s_recv) * esz, tmp.data(), seg_len(s_recv),
                dtype);
   }
   // Phase 2: allgather of reduced segments.
-  for (int step = 0; step < g_size - 1; step++) {
-    long s_send = (g_rank + 1 - step + g_size) % g_size;
-    long s_recv = (g_rank - step + g_size) % g_size;
-    if (!exchange(base + seg_off(s_send) * esz, (size_t)seg_len(s_send) * esz,
-                  base + seg_off(s_recv) * esz, (size_t)seg_len(s_recv) * esz))
+  for (int step = 0; step < ring.size - 1; step++) {
+    long s_send = (ring.rank + 1 - step + ring.size) % ring.size;
+    long s_recv = (ring.rank - step + ring.size) % ring.size;
+    if (!exchange(ring, base + seg_off(s_send) * esz,
+                  (size_t)seg_len(s_send) * esz, base + seg_off(s_recv) * esz,
+                  (size_t)seg_len(s_recv) * esz))
       return -1;
   }
-  if (average) scale(buf, count, dtype, 1.0 / g_size);
+  if (average) scale(buf, count, dtype, 1.0 / ring.size);
   return 0;
 }
 
 // Ring allgather with per-rank element counts (MPI_Allgatherv equivalent).
 // out must hold sum(counts); own block is copied internally.
-int hvd_ring_allgather(const void* in, const long* counts, void* out,
-                       int dtype) {
+int ring_allgather(Ring& ring, const void* in, const long* counts, void* out,
+                   int dtype) {
   size_t esz = dtype_size(dtype);
   if (esz == 0) {
     set_error("unsupported dtype");
     return -1;
   }
-  std::vector<long> offs(g_size + 1, 0);
-  for (int r = 0; r < g_size; r++) offs[r + 1] = offs[r] + counts[r];
+  std::vector<long> offs(ring.size + 1, 0);
+  for (int r = 0; r < ring.size; r++) offs[r + 1] = offs[r] + counts[r];
   char* base = (char*)out;
-  std::memcpy(base + offs[g_rank] * esz, in, (size_t)counts[g_rank] * esz);
-  for (int step = 0; step < (g_size > 1 ? g_size - 1 : 0); step++) {
-    long b_send = (g_rank - step + g_size) % g_size;
-    long b_recv = (g_rank - step - 1 + g_size) % g_size;
-    if (!exchange(base + offs[b_send] * esz, (size_t)counts[b_send] * esz,
-                  base + offs[b_recv] * esz, (size_t)counts[b_recv] * esz))
+  std::memcpy(base + offs[ring.rank] * esz, in,
+              (size_t)counts[ring.rank] * esz);
+  for (int step = 0; step < (ring.size > 1 ? ring.size - 1 : 0); step++) {
+    long b_send = (ring.rank - step + ring.size) % ring.size;
+    long b_recv = (ring.rank - step - 1 + ring.size) % ring.size;
+    if (!exchange(ring, base + offs[b_send] * esz,
+                  (size_t)counts[b_send] * esz, base + offs[b_recv] * esz,
+                  (size_t)counts[b_recv] * esz))
       return -1;
   }
   return 0;
 }
 
 // Ring (pipeline) broadcast from root, in place.
-int hvd_ring_broadcast(void* buf, long count, int dtype, int root) {
-  if (g_size <= 1) return 0;
+int ring_broadcast(Ring& ring, void* buf, long count, int dtype, int root) {
+  if (ring.size <= 1) return 0;
   size_t esz = dtype_size(dtype);
   if (esz == 0) {
     set_error("unsupported dtype");
     return -1;
   }
   size_t nbytes = (size_t)count * esz;
-  int right = (g_rank + 1) % g_size;
-  if (g_rank == root) {
-    return send_all(g_right_fd, buf, nbytes) ? 0 : -1;
+  int right = (ring.rank + 1) % ring.size;
+  if (ring.rank == root) {
+    return send_all(ring.right_fd, buf, nbytes) ? 0 : -1;
   }
-  if (!recv_all(g_left_fd, buf, nbytes)) return -1;
+  if (!recv_all(ring.left_fd, buf, nbytes)) return -1;
   if (right != root) {
-    if (!send_all(g_right_fd, buf, nbytes)) return -1;
+    if (!send_all(ring.right_fd, buf, nbytes)) return -1;
   }
   return 0;
+}
+
+// The default (global) ring used by the legacy hvd_ring_* ABI — the native
+// engine's single flat ring (engine.cc).
+Ring g_ring;
+
+}  // namespace
+
+extern "C" {
+
+const char* hvd_ring_last_error() { return g_error.c_str(); }
+
+// --- legacy global-ring ABI (native engine path) ---------------------------
+
+int hvd_ring_init(int rank, int size, const char* addrs_cstr,
+                  const uint8_t* secret, int secret_len) {
+  return ring_init(g_ring, rank, size, addrs_cstr, secret, secret_len);
+}
+
+int hvd_ring_allreduce(void* buf, long count, int dtype, int average) {
+  return ring_allreduce(g_ring, buf, count, dtype, average);
+}
+
+int hvd_ring_allgather(const void* in, const long* counts, void* out,
+                       int dtype) {
+  return ring_allgather(g_ring, in, counts, out, dtype);
+}
+
+int hvd_ring_broadcast(void* buf, long count, int dtype, int root) {
+  return ring_broadcast(g_ring, buf, count, dtype, root);
 }
 
 // Raw neighbor I/O for the native engine's control token (engine.cc): the
 // token and the fused ResponseList ride the same authenticated connections
 // as the data phases, in strict alternation from the single engine thread.
 int hvd_ring_send_right(const void* buf, long n) {
-  return send_all(g_right_fd, buf, (size_t)n) ? 0 : -1;
+  return send_all(g_ring.right_fd, buf, (size_t)n) ? 0 : -1;
 }
 
 int hvd_ring_recv_left(void* buf, long n) {
-  return recv_all(g_left_fd, buf, (size_t)n) ? 0 : -1;
+  return recv_all(g_ring.left_fd, buf, (size_t)n) ? 0 : -1;
 }
 
-void hvd_ring_shutdown() {
-  for (int* fd : {&g_left_fd, &g_right_fd, &g_listen_fd}) {
-    if (*fd >= 0) {
-      close(*fd);
-      *fd = -1;
-    }
+void hvd_ring_shutdown() { ring_close(g_ring); }
+
+// --- handle-based ABI (Python controller; several rings per process) -------
+
+void* hvd_ringh_create(int rank, int size, const char* addrs_cstr,
+                       const uint8_t* secret, int secret_len) {
+  Ring* ring = new Ring();
+  if (ring_init(*ring, rank, size, addrs_cstr, secret, secret_len) != 0) {
+    ring_close(*ring);
+    delete ring;
+    return nullptr;
   }
-  g_rank = -1;
-  g_size = 0;
+  return ring;
+}
+
+int hvd_ringh_allreduce(void* h, void* buf, long count, int dtype,
+                        int average) {
+  return ring_allreduce(*(Ring*)h, buf, count, dtype, average);
+}
+
+int hvd_ringh_allgather(void* h, const void* in, const long* counts, void* out,
+                        int dtype) {
+  return ring_allgather(*(Ring*)h, in, counts, out, dtype);
+}
+
+int hvd_ringh_broadcast(void* h, void* buf, long count, int dtype, int root) {
+  return ring_broadcast(*(Ring*)h, buf, count, dtype, root);
+}
+
+void hvd_ringh_destroy(void* h) {
+  if (!h) return;
+  ring_close(*(Ring*)h);
+  delete (Ring*)h;
 }
 
 }  // extern "C"

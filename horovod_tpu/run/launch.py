@@ -61,6 +61,12 @@ def _free_port() -> int:
     return port
 
 
+def _derived_port(base: int, offset: int) -> int:
+    """Map a base+offset onto a valid port regardless of where the ephemeral
+    base landed (remote-host heuristic; override env vars if it clashes)."""
+    return 20000 + (base + offset) % 40000
+
+
 def _is_local(host: str) -> bool:
     return host in ("localhost", "127.0.0.1", socket.gethostname())
 
@@ -140,21 +146,61 @@ def run(args: argparse.Namespace) -> int:
                              else "127.0.0.1")
                 ring_addrs.append(f"{addr_host}:{_free_port()}")
             else:
-                ring_addrs.append(f"{host}:{ring_base + r}")
+                ring_addrs.append(f"{host}:{_derived_port(ring_base, r)}")
         ring_addrs_env = os.environ.get("HOROVOD_RING_ADDRS",
                                         ",".join(ring_addrs))
+
+    # Per-group ring addresses for the two-level (hierarchical) data plane
+    # (HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER): one ring inside each host
+    # entry plus a ring of the entries' first ranks, so the flags can simply
+    # be flipped on the training command. Exported only for homogeneous
+    # layouts (every populated group the same size, >1): with mixed group
+    # sizes the per-rank gate and count math would diverge across ranks and
+    # the lockstep data phases would deadlock — those layouts stay on the
+    # flat ring (the reference's homogeneity check serves the same purpose,
+    # operations.cc:936-952).
+    local_ring_by_rank: Dict[int, str] = {}
+    cross_ring_env = None
+    groups: Dict[int, list] = {}
+    for a in assignments:
+        groups.setdefault(a[4], []).append(a)
+    group_sizes = {len(m) for m in groups.values()}
+    if not args.spmd and len(groups) > 1 and group_sizes.issubset({
+            max(group_sizes)}) and max(group_sizes) > 1:
+        hier_base = _free_port()
+
+        def _group_addr(host, r):
+            if _is_local(host):
+                h = socket.gethostname() if any_remote_host else "127.0.0.1"
+                return f"{h}:{_free_port()}"
+            return f"{host}:{_derived_port(hier_base, 1000 + r)}"
+
+        cross_addrs = []
+        for cr in sorted(groups):
+            members = groups[cr]
+            addrs = [_group_addr(host, r) for r, host, _, _, _ in members]
+            for r, _, _, _, _ in members:
+                local_ring_by_rank[r] = ",".join(addrs)
+            root_r, root_host = members[0][0], members[0][1]
+            cross_addrs.append(_group_addr(root_host, root_r + size))
+        cross_ring_env = ",".join(cross_addrs)
 
     procs: List[subprocess.Popen] = []
     threads = []
     failed = threading.Event()
 
     def spawn(rank, host, local_rank, local_size, cross_rank):
+        # cross_size counts POPULATED groups: with -np smaller than the total
+        # slots, trailing -H entries receive no ranks and must not count.
         env = build_rank_env(
             dict(os.environ), rank, size, local_rank, local_size,
-            cross_rank, len(hosts), coord_addr, secret, args.bind_chips,
+            cross_rank, len(groups), coord_addr, secret, args.bind_chips,
             spmd=args.spmd)
         if not args.spmd:
             env["HOROVOD_RING_ADDRS"] = ring_addrs_env
+            if rank in local_ring_by_rank and cross_ring_env:
+                env["HOROVOD_LOCAL_RING_ADDRS"] = local_ring_by_rank[rank]
+                env["HOROVOD_CROSS_RING_ADDRS"] = cross_ring_env
         if _is_local(host):
             cmd = args.command
         else:

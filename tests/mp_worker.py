@@ -387,7 +387,45 @@ def scenario_mxnet(rank, size):
         expect(m.num_updates == 0, "non-root rank must not update")
 
 
+def scenario_hierarchical(rank, size):
+    """Two-level data plane (local ring x cross ring of local roots), the
+    NCCLHierarchicalAllreduce / MPIHierarchicalAllgather analogue. Launched
+    with -H localhost:2,localhost:2 so 4 ranks form 2 simulated nodes."""
+    from horovod_tpu.common import basics
+
+    ctrl = basics.state().controller
+    expect(ctrl is not None and ctrl._local_ring is not None,
+           "hierarchical rings not active")
+    expect((ctrl._cross_ring is not None) == (hvd.local_rank() == 0),
+           "cross ring must live on local roots only")
+
+    x = np.arange(8, dtype=np.float32) + rank
+    avg = np.asarray(hvd.allreduce(x, average=True, name="h.avg"))
+    np.testing.assert_allclose(
+        avg, np.arange(8) + (size - 1) / 2.0, rtol=1e-6)
+    tot = np.asarray(hvd.allreduce(x, average=False, name="h.sum"))
+    np.testing.assert_allclose(
+        tot, size * np.arange(8) + sum(range(size)), rtol=1e-6)
+
+    # Variable-dim allgather through the two-level path.
+    g = np.full((rank + 1, 3), rank, dtype=np.float32)
+    out = np.asarray(hvd.allgather(g, name="h.gather"))
+    want = np.concatenate(
+        [np.full((r + 1, 3), r, dtype=np.float32) for r in range(size)])
+    np.testing.assert_array_equal(out, want)
+
+    # Fusion still applies above the hierarchical data plane.
+    handles = [hvd.allreduce_async(np.full(4, float(i + rank)),
+                                   average=False, name=f"h.fuse.{i}")
+               for i in range(4)]
+    for i, h in enumerate(handles):
+        got = np.asarray(hvd.synchronize(h))
+        np.testing.assert_allclose(
+            got, np.full(4, size * i + sum(range(size))), rtol=1e-6)
+
+
 SCENARIOS = {
+    "hierarchical": scenario_hierarchical,
     "mxnet": scenario_mxnet,
     "autotune": scenario_autotune,
     "tensorflow": scenario_tensorflow,

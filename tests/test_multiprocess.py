@@ -144,6 +144,48 @@ def test_python_engine(scenario):
     run_ranks(scenario, size=2, extra_env={"HOROVOD_ENGINE": "python"})
 
 
+def test_hierarchical_two_level():
+    # 4 ranks as 2 simulated nodes x 2 ranks via the launcher's -H grouping;
+    # the reference's HOROVOD_HIERARCHICAL_* env vars flip on the two-level
+    # data plane (local ring + cross ring of local roots).
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+         "-H", "localhost:2,localhost:2",
+         sys.executable, WORKER, "hierarchical"],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(4):
+        assert f"worker rank={r} scenario=hierarchical: OK" in res.stdout
+
+
+def test_hierarchical_flags_heterogeneous_layout_falls_back():
+    # 3 ranks over localhost:2,localhost:2 gives groups of 2 and 1: the
+    # launcher must NOT export group rings (mixed sizes would diverge the
+    # per-rank path choice) and the job must still produce correct results
+    # on the flat data plane.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
+         "-H", "localhost:2,localhost:2",
+         sys.executable, WORKER, "allreduce"],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(3):
+        assert f"worker rank={r} scenario=allreduce: OK" in res.stdout
+
+
 def test_native_engine_timeline_stall_parity(tmp_path):
     # The native engine's C++ timeline writes the same vocabulary the Python
     # timeline test asserts (reference test/test_timeline.py markers).
