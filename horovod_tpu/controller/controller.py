@@ -132,7 +132,10 @@ class Controller:
         self._local_ring = None
         self._cross_ring = None
         if ((config.hierarchical_allreduce or config.hierarchical_allgather)
-                and topology.local_size > 1 and topology.cross_size > 1):
+                and topology.local_size > 1 and topology.cross_size > 1
+                and os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"):
+            # HOROVOD_CPU_OPS=star is the operator's native-ring escape
+            # hatch; it must disable the hierarchical rings too.
             local_addrs = os.environ.get("HOROVOD_LOCAL_RING_ADDRS")
             cross_addrs = os.environ.get("HOROVOD_CROSS_RING_ADDRS")
             if local_addrs and cross_addrs:  # both or neither: the path

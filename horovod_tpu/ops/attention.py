@@ -16,6 +16,7 @@ backward is the standard TPU trade (HBM bandwidth for FLOPs).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -46,8 +47,9 @@ def reference_attention(q, k, v, key_mask=None, causal=False,
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
-                  sm_scale: float, causal: bool, seq_k: int, block_q: int):
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
+                  block_k: int, sm_scale: float, causal: bool, seq_k: int,
+                  block_q: int):
     # Block shapes: q (1, block_q, d), k/v (1, seq_k, d), mask (1, seq_k).
     q = q_ref[0].astype(jnp.float32) * sm_scale
     d = q.shape[-1]
@@ -67,16 +69,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (block_q, block_k)
         kmask = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
-        s = jnp.where((kmask != 0)[None, :], s, NEG_INF)
+        allowed = jnp.broadcast_to((kmask != 0)[None, :],
+                                   (block_q, block_k))
         if causal:
             q_pos = qi_block * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+            allowed = allowed & (k_pos <= q_pos)
+        s = jnp.where(allowed, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        # Explicit zeroing, not exp alone: in a fully-masked row m_new stays
+        # at the NEG_INF init, where exp(s - m_new) would be exp(0) = 1 per
+        # masked key and the row would silently emit mean(v).
+        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
@@ -87,6 +94,28 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, block_k: int,
     # Fully-masked rows (l == 0) produce zeros, not NaNs.
     out = acc / jnp.maximum(l, 1e-30)
     o_ref[0] = out.astype(o_ref.dtype)
+    # Log-sum-exp per row, saved for the backward pass (FlashAttention-2):
+    # exp(s - lse) reconstitutes the softmax without storing the S x S probs.
+    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+
+
+def _fold_heads(q, k, v, key_mask):
+    """Fold heads into batch: (B, S, H, D) -> (B*H, S, D) contiguous MXU
+    tiles, plus the mask as (B*H, 1, Sk) int32 (TPU block shapes must tile
+    (8,128) or equal the array dims; the singleton row dim satisfies the
+    equality escape). Shared by the forward and backward pallas_calls so
+    their layouts cannot drift apart."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    if key_mask is None:
+        maskf = jnp.ones((b * h, 1, sk), dtype=jnp.int32)
+    else:
+        maskf = jnp.repeat(key_mask.astype(jnp.int32), h,
+                           axis=0).reshape(b * h, 1, sk)
+    return qf, kf, vf, maskf
 
 
 def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
@@ -101,20 +130,9 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
             f"flash_attention: seq lengths ({sq},{sk}) must be divisible by "
             f"blocks ({block_q},{block_k}); pad to a block multiple")
 
-    # Layout: fold heads into batch, (B*H, S, D) — contiguous MXU tiles.
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    # (B*H, 1, Sk) int32: TPU block shapes must tile (8,128) or equal the
-    # array dims; the singleton row dim satisfies the equality escape.
-    if key_mask is None:
-        maskf = jnp.ones((b * h, 1, sk), dtype=jnp.int32)
-    else:
-        maskf = jnp.repeat(key_mask.astype(jnp.int32), h,
-                           axis=0).reshape(b * h, 1, sk)
-
+    qf, kf, vf, maskf = _fold_heads(q, k, v, key_mask)
     grid = (b * h, sq // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, sm_scale=scale,
                           causal=causal, seq_k=sk, block_q=block_q),
         grid=grid,
@@ -124,11 +142,179 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, 1, sk), lambda bh, i: (bh, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, maskf)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                         delta_ref, dq_ref, *, block_k: int, sm_scale: float,
+                         causal: bool, seq_k: int, block_q: int):
+    # Recompute p block-by-block from q, k and the saved lse; no S x S
+    # materialization (FlashAttention-2 backward, dq pass).
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]          # (block_q, 1)
+    delta = delta_ref[0, 0][:, None]      # (block_q, 1)
+    d = q.shape[-1]
+    qi_block = pl.program_id(1)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    num_kb = seq_k // block_k
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        allowed = jnp.broadcast_to(
+            (mask_ref[0, 0, pl.ds(kb * block_k, block_k)] != 0)[None, :],
+            (block_q, block_k))
+        if causal:
+            q_pos = qi_block * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            allowed = allowed & (k_pos <= q_pos)
+        # Explicit zeroing (not exp of -inf): fully-masked rows keep p = 0,
+        # so their gradients vanish as they must (out is identically 0).
+        p = jnp.where(allowed, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    acc = jax.lax.fori_loop(0, num_kb, body, acc0)
+    dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                           delta_ref, dk_ref, dv_ref, *, block_q: int,
+                           sm_scale: float, causal: bool, seq_q: int,
+                           block_k: int):
+    # dk/dv pass: one K/V block per program, streaming Q/do blocks.
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    d = k_blk.shape[-1]
+    kb = pl.program_id(1)
+    kmask = (mask_ref[0, 0] != 0)  # (block_k,)
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    num_qb = seq_q // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32) * sm_scale
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (block_q, block_k)
+        allowed = jnp.broadcast_to(kmask[None, :], (block_q, block_k))
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            allowed = allowed & (k_pos <= q_pos)
+        p = jnp.where(allowed, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q_blk carries sm_scale already, so dk = (ds^T @ q) * scale falls
+        # out directly.
+        dk = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
+                    block_q, block_k, interpret):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    qf, kf, vf, maskf = _fold_heads(q, k, v, key_mask)
+    dof = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    outf = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta_i = sum_d dO_i O_i — the softmax-normalizer correction term;
+    # cheap elementwise XLA, fused into the surrounding graph.
+    delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
+                    axis=-1).reshape(b * h, 1, sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          sm_scale=scale, causal=causal, seq_k=sk,
+                          block_q=block_q),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sk), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
+        ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, maskf)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    )(qf, kf, vf, maskf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
+                          sm_scale=scale, causal=causal, seq_q=sq,
+                          block_k=block_k),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, j: (bh, 0, j)),
+            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, maskf, dof, lse, delta)
+
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
 # The mask rides as a *differentiable* float32 argument with a zero
@@ -136,26 +322,34 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
 # pass traced masks), so only the static config lives there.
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, maskf != 0, causal, sm_scale, block_q,
-                          block_k, interpret)
+    out, _ = _flash_forward(q, k, v, maskf != 0, causal, sm_scale, block_q,
+                            block_k, interpret)
+    return out
 
 
 def _flash_fwd_rule(q, k, v, maskf, causal, sm_scale, block_q, block_k,
                     interpret):
-    out = _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k,
-                 interpret)
-    return out, (q, k, v, maskf)
+    out, lse = _flash_forward(q, k, v, maskf != 0, causal, sm_scale, block_q,
+                              block_k, interpret)
+    return out, (q, k, v, maskf, out, lse)
 
 
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v, maskf = res
-    # Rematerialized backward through the XLA reference path.
-    def f(q, k, v):
-        return reference_attention(q, k, v, key_mask=maskf != 0,
-                                   causal=causal, sm_scale=sm_scale)
+    q, k, v, maskf, out, lse = res
+    if os.environ.get("HOROVOD_FLASH_XLA_BWD"):
+        # Escape hatch: rematerialized backward through the XLA reference
+        # path (materializes the S x S probs; O(S^2) memory). Read at trace
+        # time — set it before the train step is first compiled; already-
+        # compiled executables keep the backward they were traced with.
+        def f(q, k, v):
+            return reference_attention(q, k, v, key_mask=maskf != 0,
+                                       causal=causal, sm_scale=sm_scale)
 
-    _, vjp = jax.vjp(f, q, k, v)
-    dq, dk, dv = vjp(g)
+        _, vjp = jax.vjp(f, q, k, v)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, jnp.zeros_like(maskf)
+    dq, dk, dv = _flash_backward(q, k, v, maskf != 0, out, lse, g, causal,
+                                 sm_scale, block_q, block_k, interpret)
     return dq, dk, dv, jnp.zeros_like(maskf)
 
 

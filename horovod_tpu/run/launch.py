@@ -167,22 +167,26 @@ def run(args: argparse.Namespace) -> int:
     group_sizes = {len(m) for m in groups.values()}
     if not args.spmd and len(groups) > 1 and group_sizes.issubset({
             max(group_sizes)}) and max(group_sizes) > 1:
-        hier_base = _free_port()
+        # Remote ports share ring_base with the flat ring, in disjoint
+        # offset bands — flat [0, size), local [size, 2*size), cross
+        # [2*size, 3*size) — so two rings can never be told to bind the
+        # same port on one host.
 
-        def _group_addr(host, r):
+        def _group_addr(host, offset):
             if _is_local(host):
                 h = socket.gethostname() if any_remote_host else "127.0.0.1"
                 return f"{h}:{_free_port()}"
-            return f"{host}:{_derived_port(hier_base, 1000 + r)}"
+            return f"{host}:{_derived_port(ring_base, offset)}"
 
         cross_addrs = []
         for cr in sorted(groups):
             members = groups[cr]
-            addrs = [_group_addr(host, r) for r, host, _, _, _ in members]
+            addrs = [_group_addr(host, size + r)
+                     for r, host, _, _, _ in members]
             for r, _, _, _, _ in members:
                 local_ring_by_rank[r] = ",".join(addrs)
             root_r, root_host = members[0][0], members[0][1]
-            cross_addrs.append(_group_addr(root_host, root_r + size))
+            cross_addrs.append(_group_addr(root_host, 2 * size + root_r))
         cross_ring_env = ",".join(cross_addrs)
 
     procs: List[subprocess.Popen] = []

@@ -55,6 +55,66 @@ def test_flash_gradient():
                                    atol=1e-3, rtol=1e-3)
 
 
+def test_flash_fully_masked_row_outputs_zero():
+    # A fully-padded sequence must emit zeros, not mean(v): in the online
+    # softmax a row whose every score is NEG_INF would otherwise see
+    # exp(s - m) = exp(0) = 1 per key.
+    q, k, v = _qkv(7)
+    mask_np = np.ones((B, S), dtype=bool)
+    mask_np[0, :] = False
+    out = flash_attention(q, k, v, key_mask=jnp.asarray(mask_np),
+                          block_q=16, block_k=16)
+    np.testing.assert_array_equal(np.asarray(out)[0], 0.0)
+    ref = reference_attention(q, k, v, key_mask=jnp.asarray(mask_np))
+    np.testing.assert_allclose(np.asarray(out)[1], np.asarray(ref)[1],
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_flash_gradient_with_mask():
+    # Pallas backward with a key mask. Batch 0 is fully masked: flash
+    # defines its output as zero, so all its gradients must be zero and
+    # finite (the p = where(allowed, ...) zeroing, not exp(-inf) NaNs) —
+    # the XLA reference instead softmaxes the all -inf row to uniform, so
+    # equality is only checked on the partially-masked batch.
+    q, k, v = _qkv(4)
+    mask_np = np.random.RandomState(5).rand(B, S) > 0.3
+    mask_np[0, :] = False
+    mask = jnp.asarray(mask_np)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, key_mask=mask,
+                                block_q=16, block_k=16) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, key_mask=mask) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        a, b = np.asarray(a), np.asarray(b)
+        assert np.isfinite(a).all()
+        np.testing.assert_array_equal(a[0], 0.0)
+        np.testing.assert_allclose(a[1], b[1], atol=1e-3, rtol=1e-3)
+
+
+def test_flash_gradient_xla_escape_hatch(monkeypatch):
+    monkeypatch.setenv("HOROVOD_FLASH_XLA_BWD", "1")
+    q, k, v = _qkv(6)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True,
+                                block_q=16, block_k=16) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
 def test_flash_block_divisibility_error():
     q, k, v = _qkv()
     with pytest.raises(ValueError, match="divisible"):
