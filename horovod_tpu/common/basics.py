@@ -129,18 +129,6 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         engine = os.environ.get("HOROVOD_ENGINE")
         if engine is None:
             engine = "native" if ring_data_plane_enabled() else "python"
-        if (engine == "native"
-                and (config.hierarchical_allreduce
-                     or config.hierarchical_allgather)
-                and os.environ.get("HOROVOD_LOCAL_RING_ADDRS")
-                and os.environ.get("HOROVOD_CROSS_RING_ADDRS")):
-            # The two-level data plane (local ring x cross ring) lives in the
-            # Python controller; the choice is env-derived so it is identical
-            # on every rank. Without launcher-exported group addresses the
-            # hierarchy can never engage, so the native engine stays.
-            logging.debug("hierarchical collectives requested: using the "
-                          "python engine (native engine is single-ring)")
-            engine = "python"
         use_native = topology.size > 1 and engine == "native"
         if config.timeline_filename and topology.rank == 0 and not use_native:
             # Native engine writes the timeline itself (C++ writer thread).

@@ -284,6 +284,13 @@ class NativeController:
                 logging.debug("native autotune: threshold=%d cycle=%.2fms",
                               int(threshold), float(cycle_ms))
 
+    @property
+    def hierarchical_active(self) -> bool:
+        """True when the engine's two-level (local x cross ring) data plane
+        is live — introspection seam matching the Python controller's
+        ``_local_ring``."""
+        return bool(self._lib.hvd_eng_hier_active())
+
     def shutdown(self) -> None:
         if self._shut:
             return

@@ -152,6 +152,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_eng_wait.restype = ctypes.c_int
         lib.hvd_eng_wait_for.argtypes = [ctypes.c_longlong, ctypes.c_double]
         lib.hvd_eng_wait_for.restype = ctypes.c_int
+        lib.hvd_eng_hier_active.restype = ctypes.c_int
         lib.hvd_eng_result_nbytes.argtypes = [ctypes.c_longlong]
         lib.hvd_eng_result_nbytes.restype = ctypes.c_longlong
         lib.hvd_eng_result_ndim.argtypes = [ctypes.c_longlong]

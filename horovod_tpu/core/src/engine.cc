@@ -22,6 +22,8 @@
 // Python half: horovod_tpu/controller/native.py over the C ABI below (the
 // reference exposes its C ABI the same way, operations.cc:1595-1650).
 
+#include <strings.h>
+
 #include <algorithm>
 #include <atomic>
 #include <chrono>
@@ -54,6 +56,16 @@ int hvd_ring_send_right(const void* buf, long n);
 int hvd_ring_recv_left(void* buf, long n);
 void hvd_ring_shutdown();
 const char* hvd_ring_last_error();
+// Handle-based rings (several per process) for the two-level hierarchical
+// data plane.
+void* hvd_ringh_create(int rank, int size, const char* addrs,
+                       const uint8_t* secret, int secret_len);
+int hvd_ringh_allreduce(void* h, void* buf, long count, int dtype,
+                        int average);
+int hvd_ringh_allgather(void* h, const void* in, const long* counts,
+                        void* out, int dtype);
+int hvd_ringh_broadcast(void* h, void* buf, long count, int dtype, int root);
+void hvd_ringh_destroy(void* h);
 }
 
 namespace hvd {
@@ -178,6 +190,18 @@ class EngineError : public std::runtime_error {
   using std::runtime_error::runtime_error;
 };
 
+// Two-level (hierarchical) data-plane state, populated by hvd_eng_init
+// BEFORE the Engine is constructed (the engine thread starts in the ctor,
+// so the rings must exist first). Analogue of the reference's
+// NCCLHierarchicalAllreduce comm pair (nccl_operations.cc:167-363).
+struct HierState {
+  void* local_ring = nullptr;  // ring inside this node
+  void* cross_ring = nullptr;  // ring of local roots (local_rank 0 only)
+  int local_rank = 0, local_size = 1, cross_rank = 0, cross_size = 1;
+  bool allreduce = false, allgather = false;
+};
+HierState g_hier;
+
 // The engine singleton (reference HorovodGlobalState, global_state.h:44).
 class Engine {
  public:
@@ -192,7 +216,8 @@ class Engine {
         stall_disable_(stall_disable),
         stall_warn_s_(stall_warn_s),
         stall_shutdown_s_(stall_shutdown_s),
-        cache_(cache_capacity) {
+        cache_(cache_capacity),
+        hier_(g_hier) {
     if (!timeline_path.empty() && rank == 0)
       timeline_ = std::make_unique<Timeline>(timeline_path,
                                              timeline_mark_cycles);
@@ -318,6 +343,13 @@ class Engine {
     return finished_;
   }
 
+  // True when the two-level data plane is active (test/introspection seam;
+  // the Python controller exposes its rings the same way).
+  bool hier_active() const {
+    return hier_.local_ring != nullptr &&
+           (hier_.allreduce || hier_.allgather);
+  }
+
  private:
   // ------------------------------------------------------------- cycle loop
 
@@ -347,6 +379,9 @@ class Engine {
       fail_all_and_close(exc.what());
     }
     if (size_ > 1) hvd_ring_shutdown();
+    if (hier_.local_ring) hvd_ringh_destroy(hier_.local_ring);
+    if (hier_.cross_ring) hvd_ringh_destroy(hier_.cross_ring);
+    hier_.local_ring = hier_.cross_ring = nullptr;
     if (timeline_) timeline_->close();
   }
 
@@ -745,10 +780,15 @@ class Engine {
       Entry* e = entries[0];
       if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
       if (size_ > 1) {
-        if (hvd_ring_allreduce(e->data.data(), (long)(total_bytes / esz),
-                               dtype, 0) != 0)
+        if (hier_.allreduce && hier_.local_ring) {
+          hier_ring_allreduce(e->data.data(), (long)(total_bytes / esz),
+                              dtype);
+        } else if (hvd_ring_allreduce(e->data.data(),
+                                      (long)(total_bytes / esz), dtype,
+                                      0) != 0) {
           throw EngineError(std::string("ring allreduce failed: ") +
                             hvd_ring_last_error());
+        }
       }
       if (timeline_) timeline_->activity_end(tname);
       complete(e, e->request.shape, std::move(e->data));
@@ -777,10 +817,15 @@ class Engine {
       timeline_->activity_start(tname, "TCP_COLLECTIVE");
     }
     if (size_ > 1) {
-      if (hvd_ring_allreduce(fusion_buffer_.data(),
-                             (long)(total_bytes / esz), dtype, 0) != 0)
+      if (hier_.allreduce && hier_.local_ring) {
+        hier_ring_allreduce(fusion_buffer_.data(),
+                            (long)(total_bytes / esz), dtype);
+      } else if (hvd_ring_allreduce(fusion_buffer_.data(),
+                                    (long)(total_bytes / esz), dtype,
+                                    0) != 0) {
         throw EngineError(std::string("ring allreduce failed: ") +
                           hvd_ring_last_error());
+      }
     }
     if (timeline_) {
       timeline_->activity_end(tname);
@@ -795,6 +840,21 @@ class Engine {
     }
     if (timeline_) timeline_->activity_end(tname);
     return (long long)total_bytes;
+  }
+
+  // Two-level allreduce: sum inside the node, exchange node sums across the
+  // local roots' cross ring, fan back out locally.
+  void hier_ring_allreduce(void* buf, long count, uint8_t dtype) {
+    if (hvd_ringh_allreduce(hier_.local_ring, buf, count, dtype, 0) != 0)
+      throw EngineError(std::string("local ring allreduce failed: ") +
+                        hvd_ring_last_error());
+    if (hier_.local_rank == 0 &&
+        hvd_ringh_allreduce(hier_.cross_ring, buf, count, dtype, 0) != 0)
+      throw EngineError(std::string("cross ring allreduce failed: ") +
+                        hvd_ring_last_error());
+    if (hvd_ringh_broadcast(hier_.local_ring, buf, count, dtype, 0) != 0)
+      throw EngineError(std::string("local ring broadcast failed: ") +
+                        hvd_ring_last_error());
   }
 
   long long execute_allgather(Entry& e, const Response& response,
@@ -813,10 +873,42 @@ class Engine {
     std::vector<uint8_t> out((size_t)total_elems * esz);
     if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
     if (size_ > 1) {
-      if (hvd_ring_allgather(e.data.data(), counts.data(), out.data(),
-                             dtype) != 0)
+      if (hier_.allgather && hier_.local_ring) {
+        // Two-level: gather inside the node, local roots exchange node
+        // blobs, fan the full result back out (MPIHierarchicalAllgather
+        // shape, mpi_operations.cc:179-329; contiguous rank grouping makes
+        // node order == rank order).
+        int ls = hier_.local_size, cr = hier_.cross_rank;
+        std::vector<long> local_counts(counts.begin() + (size_t)cr * ls,
+                                       counts.begin() + (size_t)(cr + 1) * ls);
+        long long local_elems = 0;
+        for (long c : local_counts) local_elems += c;
+        std::vector<uint8_t> local_out((size_t)local_elems * esz);
+        if (hvd_ringh_allgather(hier_.local_ring, e.data.data(),
+                                local_counts.data(), local_out.data(),
+                                dtype) != 0)
+          throw EngineError(std::string("local ring allgather failed: ") +
+                            hvd_ring_last_error());
+        if (hier_.local_rank == 0) {
+          std::vector<long> group_counts(hier_.cross_size, 0);
+          for (int g = 0; g < hier_.cross_size; g++)
+            for (int i = 0; i < ls; i++)
+              group_counts[g] += counts[(size_t)g * ls + i];
+          if (hvd_ringh_allgather(hier_.cross_ring, local_out.data(),
+                                  group_counts.data(), out.data(),
+                                  dtype) != 0)
+            throw EngineError(std::string("cross ring allgather failed: ") +
+                              hvd_ring_last_error());
+        }
+        if (hvd_ringh_broadcast(hier_.local_ring, out.data(),
+                                (long)total_elems, dtype, 0) != 0)
+          throw EngineError(std::string("local ring broadcast failed: ") +
+                            hvd_ring_last_error());
+      } else if (hvd_ring_allgather(e.data.data(), counts.data(), out.data(),
+                                    dtype) != 0) {
         throw EngineError(std::string("ring allgather failed: ") +
                           hvd_ring_last_error());
+      }
     } else {
       std::memcpy(out.data(), e.data.data(), e.data.size());
     }
@@ -861,6 +953,7 @@ class Engine {
   std::map<long long, HandleSlot> handles_;
   std::map<int, std::string> bit_pending_;
   ResponseCache cache_;
+  HierState hier_;  // copied from g_hier at construction
   long long next_handle_ = 0;
   bool closed_ = false;
   bool finished_ = false;
@@ -919,6 +1012,64 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
       return -1;
     }
   }
+  // Two-level hierarchical rings (reference HOROVOD_HIERARCHICAL_* flags).
+  // Gated exactly like the Python controller: flags on, launcher-exported
+  // group addresses present, real two-level topology — the predicate is
+  // env-derived so it is identical on every rank.
+  hvd::g_hier = hvd::HierState{};
+  auto env_true = [](const char* name) {
+    // Mirrors the Python config._env_bool exactly: strip, lowercase, and
+    // "", "0", "false", "no", "off" are false — both engines must read a
+    // documented flag identically.
+    const char* v = getenv(name);
+    if (!v) return false;
+    std::string s(v);
+    size_t a = s.find_first_not_of(" \t\r\n");
+    if (a == std::string::npos) return false;
+    size_t b = s.find_last_not_of(" \t\r\n");
+    s = s.substr(a, b - a + 1);
+    for (char& c : s) c = (char)tolower((unsigned char)c);
+    return s != "" && s != "0" && s != "false" && s != "no" && s != "off";
+  };
+  auto env_int = [](const char* name, int dflt) {
+    const char* v = getenv(name);
+    return v && *v ? atoi(v) : dflt;
+  };
+  const char* local_addrs = getenv("HOROVOD_LOCAL_RING_ADDRS");
+  const char* cross_addrs = getenv("HOROVOD_CROSS_RING_ADDRS");
+  const char* cpu_ops = getenv("HOROVOD_CPU_OPS");
+  hvd::g_hier.allreduce = env_true("HOROVOD_HIERARCHICAL_ALLREDUCE");
+  hvd::g_hier.allgather = env_true("HOROVOD_HIERARCHICAL_ALLGATHER");
+  hvd::g_hier.local_rank = env_int("HOROVOD_LOCAL_RANK", 0);
+  hvd::g_hier.local_size = env_int("HOROVOD_LOCAL_SIZE", 1);
+  hvd::g_hier.cross_rank = env_int("HOROVOD_CROSS_RANK", 0);
+  hvd::g_hier.cross_size = env_int("HOROVOD_CROSS_SIZE", 1);
+  if ((hvd::g_hier.allreduce || hvd::g_hier.allgather) && local_addrs &&
+      cross_addrs && hvd::g_hier.local_size > 1 &&
+      hvd::g_hier.cross_size > 1 && !(cpu_ops && strcmp(cpu_ops, "star") == 0)) {
+    hvd::g_hier.local_ring = hvd_ringh_create(
+        hvd::g_hier.local_rank, hvd::g_hier.local_size, local_addrs, secret,
+        secret_len);
+    if (!hvd::g_hier.local_ring) {
+      hvd::g_last_error = hvd_ring_last_error();
+      return -1;
+    }
+    if (hvd::g_hier.local_rank == 0) {
+      hvd::g_hier.cross_ring = hvd_ringh_create(
+          hvd::g_hier.cross_rank, hvd::g_hier.cross_size, cross_addrs, secret,
+          secret_len);
+      if (!hvd::g_hier.cross_ring) {
+        hvd::g_last_error = hvd_ring_last_error();
+        // Don't leak the half-built pair (its bound listener would make a
+        // retry fail with EADDRINUSE forever).
+        hvd_ringh_destroy(hvd::g_hier.local_ring);
+        hvd::g_hier = hvd::HierState{};
+        return -1;
+      }
+    }
+  } else {
+    hvd::g_hier.allreduce = hvd::g_hier.allgather = false;
+  }
   // A previous finished engine is leaked deliberately (see g_engine note).
   hvd::g_engine = new hvd::Engine(
       rank, size, cycle_ms, fusion_threshold, cache_capacity,
@@ -949,6 +1100,10 @@ int hvd_eng_wait(long long h) {
 
 int hvd_eng_wait_for(long long h, double timeout_s) {
   return hvd::g_engine ? hvd::g_engine->wait_for(h, timeout_s) : -1;
+}
+
+int hvd_eng_hier_active() {
+  return hvd::g_engine && hvd::g_engine->hier_active() ? 1 : 0;
 }
 
 long long hvd_eng_result_nbytes(long long h) {

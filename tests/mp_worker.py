@@ -394,10 +394,14 @@ def scenario_hierarchical(rank, size):
     from horovod_tpu.common import basics
 
     ctrl = basics.state().controller
-    expect(ctrl is not None and ctrl._local_ring is not None,
-           "hierarchical rings not active")
-    expect((ctrl._cross_ring is not None) == (hvd.local_rank() == 0),
-           "cross ring must live on local roots only")
+    expect(ctrl is not None, "controller not active")
+    if hasattr(ctrl, "_local_ring"):  # python engine exposes its rings
+        expect(ctrl._local_ring is not None, "hierarchical rings not active")
+        expect((ctrl._cross_ring is not None) == (hvd.local_rank() == 0),
+               "cross ring must live on local roots only")
+    else:  # native engine: C ABI introspection
+        expect(ctrl.hierarchical_active,
+               "native engine hierarchy not active")
 
     x = np.arange(8, dtype=np.float32) + rank
     avg = np.asarray(hvd.allreduce(x, average=True, name="h.avg"))

@@ -144,16 +144,18 @@ def test_python_engine(scenario):
     run_ranks(scenario, size=2, extra_env={"HOROVOD_ENGINE": "python"})
 
 
-def test_hierarchical_two_level():
+@pytest.mark.parametrize("engine", ["native", "python"])
+def test_hierarchical_two_level(engine):
     # 4 ranks as 2 simulated nodes x 2 ranks via the launcher's -H grouping;
     # the reference's HOROVOD_HIERARCHICAL_* env vars flip on the two-level
-    # data plane (local ring + cross ring of local roots).
+    # data plane (local ring + cross ring of local roots) in both engines.
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
     env["HOROVOD_CYCLE_TIME"] = "1"
     env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
     env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    env["HOROVOD_ENGINE"] = engine
     env.pop("PALLAS_AXON_POOL_IPS", None)
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
