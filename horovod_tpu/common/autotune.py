@@ -116,17 +116,28 @@ class BayesianOptimizer:
 
 class ParameterManager:
     """Scores the live configuration by observed throughput and proposes the
-    next one (reference ``parameter_manager.cc:155-222`` Update/Tune)."""
+    next one (reference ``parameter_manager.cc:155-222`` Update/Tune).
+
+    Besides the joint-Bayesian continuous pair, optionally tunes
+    hierarchical allreduce on/off — the reference's categorical dimension
+    (``parameter_manager.h:35-43`` CategoricalParameterChain): each category
+    is explored for a few BO steps over two sweeps, then the better one is
+    locked in while the continuous search continues."""
 
     WARMUP_SAMPLES = 3      # discarded after every parameter change
     SAMPLES_PER_STEP = 10   # scored cycles per configuration
+    CATEGORY_STEPS = 3      # BO steps per category visit
+    CATEGORY_SWEEPS = 2     # full passes over both categories
 
     def __init__(self, fusion_threshold: int, cycle_time_ms: float,
-                 log_path: Optional[str] = None, seed: int = 0):
+                 log_path: Optional[str] = None, seed: int = 0,
+                 tune_hierarchical: bool = False,
+                 hierarchical: bool = False):
         # (log2 fusion bytes, cycle ms)
         self._bo = BayesianOptimizer([(20.0, 28.0), (1.0, 25.0)], seed=seed)
         self.fusion_threshold = int(fusion_threshold)
         self.cycle_time_ms = float(cycle_time_ms)
+        self.hierarchical = bool(hierarchical)
         self._warmup_left = self.WARMUP_SAMPLES
         self._bytes = 0
         self._seconds = 0.0
@@ -135,10 +146,16 @@ class ParameterManager:
         self._best_score = -np.inf
         self.best_fusion_threshold = self.fusion_threshold
         self.best_cycle_time_ms = self.cycle_time_ms
+        self._cat_fixed = not tune_hierarchical
+        self._cat_scores = {False: -np.inf, True: -np.inf}
+        self._cat_steps = 0
+        self._cat_visits = 0
 
-    def record(self, nbytes: int, seconds: float) -> Optional[Tuple[int, float]]:
-        """Feed one cycle's totals; returns new (fusion_threshold, cycle_ms)
-        when the manager moves to a new configuration, else None."""
+    def record(self, nbytes: int,
+               seconds: float) -> Optional[Tuple[int, float, bool]]:
+        """Feed one cycle's totals; returns new (fusion_threshold, cycle_ms,
+        hierarchical) when the manager moves to a new configuration, else
+        None."""
         if nbytes <= 0 or seconds <= 0:
             return None
         if self._warmup_left > 0:
@@ -157,10 +174,25 @@ class ParameterManager:
             self._best_score = score
             self.best_fusion_threshold = self.fusion_threshold
             self.best_cycle_time_ms = self.cycle_time_ms
+        self._cat_scores[self.hierarchical] = max(
+            self._cat_scores[self.hierarchical], score)
         if self._log_path:
             with open(self._log_path, "a") as f:
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
-                        f"{self.cycle_time_ms:.3f},{score:.1f}\n")
+                        f"{self.cycle_time_ms:.3f},"
+                        f"{int(self.hierarchical)},{score:.1f}\n")
+
+        if not self._cat_fixed:
+            self._cat_steps += 1
+            if self._cat_steps >= self.CATEGORY_STEPS:
+                self._cat_steps = 0
+                self._cat_visits += 1
+                if self._cat_visits >= 2 * self.CATEGORY_SWEEPS:
+                    self._cat_fixed = True
+                    self.hierarchical = bool(
+                        self._cat_scores[True] > self._cat_scores[False])
+                else:
+                    self.hierarchical = not self.hierarchical
 
         nxt = self._bo.suggest()
         self.fusion_threshold = int(2 ** nxt[0])
@@ -169,4 +201,4 @@ class ParameterManager:
         self._seconds = 0.0
         self._samples = 0
         self._warmup_left = self.WARMUP_SAMPLES
-        return self.fusion_threshold, self.cycle_time_ms
+        return self.fusion_threshold, self.cycle_time_ms, self.hierarchical

@@ -7,9 +7,12 @@ from ..common.autotune import ParameterManager
 from ..common.config import Config
 
 
-def make_parameter_manager(config: Config) -> ParameterManager:
+def make_parameter_manager(config: Config,
+                           tune_hierarchical: bool = False) -> ParameterManager:
     return ParameterManager(
         fusion_threshold=config.fusion_threshold_bytes,
         cycle_time_ms=config.cycle_time_ms,
         log_path=config.autotune_log,
+        tune_hierarchical=tune_hierarchical,
+        hierarchical=config.hierarchical_allreduce,
     )

@@ -104,10 +104,6 @@ class Controller:
         self._cycle_time_ms = config.cycle_time_ms
         self._param_manager = None
         self._pending_tune = None
-        if config.autotune and topology.rank == 0:
-            from .autotune_glue import make_parameter_manager
-
-            self._param_manager = make_parameter_manager(config)
 
         # Native ring data plane (C++ core): enabled when the launcher
         # exported per-rank ring addresses and HOROVOD_CPU_OPS != "star".
@@ -131,11 +127,19 @@ class Controller:
         # exported per-group ring addresses.
         self._local_ring = None
         self._cross_ring = None
-        if ((config.hierarchical_allreduce or config.hierarchical_allgather)
+        # Live copy of the hierarchical-allreduce knob: the autotuner may
+        # flip it at runtime (reference categorical tuning); the change is
+        # applied on every rank via the synced cycle reply only, so the
+        # per-response path choice never diverges.
+        self._hier_allreduce = config.hierarchical_allreduce
+        if ((config.hierarchical_allreduce or config.hierarchical_allgather
+             or config.autotune)
                 and topology.local_size > 1 and topology.cross_size > 1
                 and os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"):
             # HOROVOD_CPU_OPS=star is the operator's native-ring escape
-            # hatch; it must disable the hierarchical rings too.
+            # hatch; it must disable the hierarchical rings too. Autotune
+            # builds the rings even when the flag starts off so the
+            # categorical search can explore the two-level path.
             local_addrs = os.environ.get("HOROVOD_LOCAL_RING_ADDRS")
             cross_addrs = os.environ.get("HOROVOD_CROSS_RING_ADDRS")
             if local_addrs and cross_addrs:  # both or neither: the path
@@ -151,6 +155,11 @@ class Controller:
                     self._cross_ring = RingBackend(
                         topology.cross_rank, topology.cross_size, cross_addrs,
                         job_secret())
+        if config.autotune and topology.rank == 0:
+            from .autotune_glue import make_parameter_manager
+
+            self._param_manager = make_parameter_manager(
+                config, tune_hierarchical=self._local_ring is not None)
 
         addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
         if topology.rank == 0:
@@ -346,7 +355,12 @@ class Controller:
                 tuned = self._param_manager.record(
                     nbytes, time.monotonic() - t0)
                 if tuned is not None:
-                    self._fusion_threshold, self._cycle_time_ms = tuned
+                    # Continuous knobs apply immediately (coordinator-only
+                    # effects); the hierarchical flag is applied ONLY via
+                    # next cycle's synced reply — it changes the data-plane
+                    # path, which must switch on every rank at the same
+                    # cycle boundary.
+                    self._fusion_threshold, self._cycle_time_ms = tuned[:2]
                     self._pending_tune = tuned
         else:
             self._client.send(tick)
@@ -484,7 +498,9 @@ class Controller:
     def _process_reply(self, reply: dict) -> int:
         tune = reply.get("tune")
         if tune is not None:
-            self._fusion_threshold, self._cycle_time_ms = tune
+            self._fusion_threshold, self._cycle_time_ms = tune[:2]
+            if len(tune) > 2:
+                self._hier_allreduce = bool(tune[2])
         executed_bytes = 0
         for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
             name = None
@@ -580,7 +596,7 @@ class Controller:
         if self.timeline:
             self.timeline.activity_end(tname)
             self.timeline.activity_start(tname, tl.TCP_COLLECTIVE)
-        if self._use_hierarchical(dtype, self.cfg.hierarchical_allreduce):
+        if self._use_hierarchical(dtype, self._hier_allreduce):
             # Two-level: sum inside the node, exchange node sums via the
             # local roots' cross ring, fan the result back out locally
             # (NCCLHierarchicalAllreduce shape, nccl_operations.cc:167-363).

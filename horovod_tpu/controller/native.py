@@ -279,7 +279,7 @@ class NativeController:
                 continue
             tuned = self._param_manager.record(delta_bytes, delta_busy)
             if tuned is not None:
-                threshold, cycle_ms = tuned
+                threshold, cycle_ms = tuned[:2]
                 self._lib.hvd_eng_set_params(int(threshold), float(cycle_ms))
                 logging.debug("native autotune: threshold=%d cycle=%.2fms",
                               int(threshold), float(cycle_ms))

@@ -49,8 +49,43 @@ def dtype_from_code(code: int) -> np.dtype:
     return np.dtype(name)
 
 
+# Vectorize the reduction loops for the build host (the reference uses
+# AVX/F16C intrinsics with a scalar fallback, half.cc:28). The build is
+# cached per (flags, host CPU signature) — see _build_stamp — so a binary
+# built on one machine is never loaded on a different-ISA host (shared
+# filesystem / copied checkout), where -march=native code could SIGILL.
+_CXX_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+              "-march=native"]
+_STAMP_PATH = os.path.join(_BUILD_DIR, "build_stamp.txt")
+
+
+def _cpu_signature() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    import hashlib
+
+                    return hashlib.sha256(line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+
+    return platform.machine()
+
+
+def _build_stamp() -> str:
+    return " ".join(_CXX_FLAGS) + " cpu:" + _cpu_signature()
+
+
 def _needs_build() -> bool:
     if not os.path.exists(_LIB_PATH):
+        return True
+    try:
+        with open(_STAMP_PATH) as f:
+            if f.read().strip() != _build_stamp():
+                return True
+    except OSError:
         return True
     lib_mtime = os.path.getmtime(_LIB_PATH)
     for fname in os.listdir(_SRC_DIR):
@@ -60,22 +95,21 @@ def _needs_build() -> bool:
 
 
 def build() -> str:
-    """Compile the native core (idempotent, mtime-cached)."""
+    """Compile the native core (idempotent; cached by source mtimes plus the
+    flags/CPU build stamp)."""
     os.makedirs(_BUILD_DIR, exist_ok=True)
     if _needs_build():
         sources = sorted(
             os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
             if f.endswith(".cc"))
-        cmd = [
-            "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-            *sources,
-            "-o", _LIB_PATH,
-        ]
+        cmd = ["g++", *_CXX_FLAGS, *sources, "-o", _LIB_PATH]
         logging.debug("building native core: %s", " ".join(cmd))
         result = subprocess.run(cmd, capture_output=True, text=True)
         if result.returncode != 0:
             raise RuntimeError(
                 f"native core build failed:\n{result.stderr}")
+        with open(_STAMP_PATH, "w") as f:
+            f.write(_build_stamp())
     return _LIB_PATH
 
 

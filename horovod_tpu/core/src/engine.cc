@@ -22,8 +22,6 @@
 // Python half: horovod_tpu/controller/native.py over the C ABI below (the
 // reference exposes its C ABI the same way, operations.cc:1595-1650).
 
-#include <strings.h>
-
 #include <algorithm>
 #include <atomic>
 #include <chrono>

@@ -43,11 +43,27 @@ def test_parameter_manager_cycles():
         out = pm.record(nbytes=1 << 20, seconds=0.005)
         if out is not None:
             changed += 1
-            thr, cyc = out
+            thr, cyc, hier = out
+            assert hier is False  # tune_hierarchical off by default
             assert (1 << 20) <= thr <= (1 << 28)
             assert 1.0 <= cyc <= 25.0
     assert changed >= 5  # warmup 3 + 10 samples per step
     assert pm.best_fusion_threshold >= 1 << 20
+
+
+def test_parameter_manager_categorical_hierarchical():
+    # With tune_hierarchical on, the manager explores both categories over
+    # two sweeps, then locks in one (reference CategoricalParameter
+    # semantics, parameter_manager.h:35-43).
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          seed=4, tune_hierarchical=True, hierarchical=False)
+    seen = set()
+    for _ in range(400):
+        out = pm.record(nbytes=1 << 20, seconds=0.005)
+        if out is not None:
+            seen.add(out[2])
+    assert seen == {False, True}  # both categories explored
+    assert pm._cat_fixed  # and a winner locked in
 
 
 def test_parameter_manager_log(tmp_path):
@@ -58,4 +74,4 @@ def test_parameter_manager_log(tmp_path):
         pm.record(nbytes=1 << 20, seconds=0.004)
     content = log.read_text().strip().splitlines()
     assert len(content) >= 1
-    assert len(content[0].split(",")) == 4
+    assert len(content[0].split(",")) == 5

@@ -167,6 +167,27 @@ def test_hierarchical_two_level(engine):
         assert f"worker rank={r} scenario=hierarchical: OK" in res.stdout
 
 
+def test_autotune_categorical_hierarchical_stays_correct():
+    # Autotune on a 2x2-node layout (rings available, hierarchical flag OFF)
+    # may flip the two-level path mid-run via the synced reply; results must
+    # stay correct throughout.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["HOROVOD_AUTOTUNE"] = "1"
+    env["HOROVOD_ENGINE"] = "python"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+         "-H", "localhost:2,localhost:2",
+         sys.executable, WORKER, "autotune"],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(4):
+        assert f"worker rank={r} scenario=autotune: OK" in res.stdout
+
+
 def test_hierarchical_flags_heterogeneous_layout_falls_back():
     # 3 ranks over localhost:2,localhost:2 gives groups of 2 and 1: the
     # launcher must NOT export group rings (mixed sizes would diverge the
