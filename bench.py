@@ -19,7 +19,20 @@ number; ResNet-101 is ~1.7x the FLOPs of ResNet-50 — noted, not hidden).
 """
 
 import json
+import os
+import signal
+import sys
 import time
+
+# Watchdog: the tunneled TPU backend can wedge at init when the chip is held
+# by a stale claim; die after 10 minutes instead of hanging the harness
+# forever. The DEFAULT SIGALRM action (kernel-level kill) is used on purpose:
+# a Python handler cannot run while the hang holds the GIL inside native
+# backend-init code. Overridable via BENCH_TIMEOUT_S.
+signal.signal(signal.SIGALRM, signal.SIG_DFL)
+signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
+sys.stderr.write("bench.py: watchdog armed (SIGALRM, "
+                 f"{os.environ.get('BENCH_TIMEOUT_S', '600')}s)\n")
 
 import jax
 import jax.numpy as jnp
@@ -107,6 +120,7 @@ def main():
 
     total_img_sec = batch * ITERS / best_elapsed
     per_chip = total_img_sec / n
+    signal.alarm(0)
     print(json.dumps({
         "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
         "value": round(per_chip, 2),

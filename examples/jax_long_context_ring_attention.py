@@ -18,7 +18,11 @@ from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.parallel import make_mesh
-from horovod_tpu.parallel.sequence import ring_attention
+from horovod_tpu.parallel.sequence import (
+    ring_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
 
 
 def main():
@@ -28,6 +32,10 @@ def main():
     parser.add_argument("--head-dim", type=int, default=64)
     parser.add_argument("--batch", type=int, default=1)
     parser.add_argument("--causal", action="store_true")
+    parser.add_argument("--layout", choices=["contiguous", "zigzag"],
+                        default="contiguous",
+                        help="zigzag balances causal work across chips "
+                             "(see parallel.sequence.zigzag_shard)")
     args = parser.parse_args()
 
     hvd.init()
@@ -42,9 +50,13 @@ def main():
     k = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
     v = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
 
+    if args.layout == "zigzag":
+        q, k, v = (zigzag_shard(x, n) for x in (q, k, v))
+
     f = jax.jit(jax.shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
-                                       causal=args.causal),
+                                       causal=args.causal,
+                                       layout=args.layout),
         mesh=mesh,
         in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
         check_vma=False))
@@ -57,11 +69,14 @@ def main():
         out = f(q, k, v)
     _ = np.asarray(out[0, 0, 0])
     dt = (time.perf_counter() - t0) / iters
+    if args.layout == "zigzag":
+        out = zigzag_unshard(out, n)  # back to natural token order
     if hvd.rank() == 0:
         s = args.seq_len
         flops = 4 * args.batch * args.heads * s * s * args.head_dim
         print(f"ring attention S={s} on {n} chip(s): {dt * 1e3:.1f} ms/iter, "
-              f"{flops / dt / 1e12:.2f} TFLOP/s, out shape {out.shape}")
+              f"{flops / dt / 1e12:.2f} TFLOP/s, out shape {out.shape}, "
+              f"layout={args.layout}")
 
 
 if __name__ == "__main__":

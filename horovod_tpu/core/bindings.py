@@ -21,7 +21,6 @@ from ..common import hvd_logging as logging
 
 _SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libhvdcore.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -50,13 +49,13 @@ def dtype_from_code(code: int) -> np.dtype:
 
 
 # Vectorize the reduction loops for the build host (the reference uses
-# AVX/F16C intrinsics with a scalar fallback, half.cc:28). The build is
-# cached per (flags, host CPU signature) — see _build_stamp — so a binary
-# built on one machine is never loaded on a different-ISA host (shared
-# filesystem / copied checkout), where -march=native code could SIGILL.
+# AVX/F16C intrinsics with a scalar fallback, half.cc:28). The artifact name
+# embeds a hash of (flags, host CPU signature): each ISA/flag combination
+# gets its own immutable .so, so a different-ISA host on a shared filesystem
+# rebuilds its own file instead of loading (or truncating under) a
+# -march=native binary another host built and may have mmapped live.
 _CXX_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
               "-march=native"]
-_STAMP_PATH = os.path.join(_BUILD_DIR, "build_stamp.txt")
 
 
 def _cpu_signature() -> str:
@@ -74,20 +73,18 @@ def _cpu_signature() -> str:
     return platform.machine()
 
 
-def _build_stamp() -> str:
-    return " ".join(_CXX_FLAGS) + " cpu:" + _cpu_signature()
+def _lib_path() -> str:
+    import hashlib
+
+    stamp = " ".join(_CXX_FLAGS) + " cpu:" + _cpu_signature()
+    tag = hashlib.sha256(stamp.encode()).hexdigest()[:12]
+    return os.path.join(_BUILD_DIR, f"libhvdcore-{tag}.so")
 
 
-def _needs_build() -> bool:
-    if not os.path.exists(_LIB_PATH):
+def _needs_build(lib_path: str) -> bool:
+    if not os.path.exists(lib_path):
         return True
-    try:
-        with open(_STAMP_PATH) as f:
-            if f.read().strip() != _build_stamp():
-                return True
-    except OSError:
-        return True
-    lib_mtime = os.path.getmtime(_LIB_PATH)
+    lib_mtime = os.path.getmtime(lib_path)
     for fname in os.listdir(_SRC_DIR):
         if os.path.getmtime(os.path.join(_SRC_DIR, fname)) > lib_mtime:
             return True
@@ -95,22 +92,26 @@ def _needs_build() -> bool:
 
 
 def build() -> str:
-    """Compile the native core (idempotent; cached by source mtimes plus the
-    flags/CPU build stamp)."""
+    """Compile the native core (idempotent; cached by source mtimes, with
+    the flags/CPU signature baked into the artifact name). Concurrent
+    builders (N ranks starting at once) each compile to a private temp file
+    and atomically rename it into place, so a loader can never dlopen a
+    half-written binary."""
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    if _needs_build():
+    lib_path = _lib_path()
+    if _needs_build(lib_path):
         sources = sorted(
             os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
             if f.endswith(".cc"))
-        cmd = ["g++", *_CXX_FLAGS, *sources, "-o", _LIB_PATH]
+        tmp_path = f"{lib_path}.tmp.{os.getpid()}"
+        cmd = ["g++", *_CXX_FLAGS, *sources, "-o", tmp_path]
         logging.debug("building native core: %s", " ".join(cmd))
         result = subprocess.run(cmd, capture_output=True, text=True)
         if result.returncode != 0:
             raise RuntimeError(
                 f"native core build failed:\n{result.stderr}")
-        with open(_STAMP_PATH, "w") as f:
-            f.write(_build_stamp())
-    return _LIB_PATH
+        os.replace(tmp_path, lib_path)
+    return lib_path
 
 
 def load() -> Optional[ctypes.CDLL]:

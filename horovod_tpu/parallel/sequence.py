@@ -29,16 +29,17 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, sm_scale, q_off, k_off, causal, key_mask):
+def _block_attend(q, k, v, sm_scale, q_pos, k_pos, causal, key_mask):
     """One (Sq_local x Sk_block) attention block in f32: returns
-    (unnormalized acc, running max, running sum) contributions."""
+    (unnormalized acc, running max, running sum) contributions. ``q_pos`` /
+    ``k_pos`` are the GLOBAL positions of the local rows/keys (vectors), so
+    any sequence layout — contiguous or zigzag — uses the same math."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if key_mask is not None:
         s = jnp.where(key_mask[:, None, None, :], s, NEG_INF)
     if causal:
-        qi = q_off + jnp.arange(q.shape[1])[:, None]
-        ki = k_off + jnp.arange(k.shape[1])[None, :]
-        s = jnp.where((ki <= qi)[None, None, :, :], s, NEG_INF)
+        s = jnp.where((k_pos[None, :] <= q_pos[:, None])[None, None, :, :],
+                      s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)  # (b,h,q,1)
     # Guard fully-masked blocks: exp(NEG_INF - NEG_INF) would be 1.
     m_safe = jnp.maximum(m, NEG_INF / 2)
@@ -48,30 +49,184 @@ def _block_attend(q, k, v, sm_scale, q_off, k_off, causal, key_mask):
     return acc, m_safe, l
 
 
+def zigzag_positions(idx, s_local, axis_size):
+    """Global positions held by shard ``idx`` in the zigzag layout: the
+    sequence is cut into 2N blocks and shard i holds blocks (i, 2N-1-i), so
+    every shard owns an equal share of early AND late positions and causal
+    ring steps do balanced work on every device."""
+    half = s_local // 2
+    lo = idx * half + jnp.arange(half)
+    hi = (2 * axis_size - 1 - idx) * half + jnp.arange(half)
+    return jnp.concatenate([lo, hi])
+
+
+def _zigzag_order(axis_size):
+    """Block order of the zigzag layout: shard i holds blocks
+    (i, 2N-1-i)."""
+    order = []
+    for i in range(axis_size):
+        order += [i, 2 * axis_size - 1 - i]
+    return order
+
+
+def _zigzag_split(x, axis_size, axis):
+    n2 = 2 * axis_size
+    s = x.shape[axis]
+    if s % n2:
+        raise ValueError(
+            f"zigzag layout needs the sequence ({s}) divisible by "
+            f"2*axis_size ({n2})")
+    return jnp.split(x, n2, axis=axis)
+
+
+def zigzag_shard(x, axis_size, axis: int = 1):
+    """Reorder a GLOBAL sequence axis into zigzag shard order: after this,
+    splitting the axis into ``axis_size`` equal chunks gives each shard its
+    (i, 2N-1-i) block pair. Inverse: ``zigzag_unshard``."""
+    blocks = _zigzag_split(x, axis_size, axis)
+    return jnp.concatenate([blocks[i] for i in _zigzag_order(axis_size)],
+                           axis=axis)
+
+
+def zigzag_unshard(x, axis_size, axis: int = 1):
+    """Inverse of ``zigzag_shard``."""
+    blocks = _zigzag_split(x, axis_size, axis)
+    order = _zigzag_order(axis_size)
+    inverse = [0] * len(order)
+    for pos, blk in enumerate(order):
+        inverse[blk] = pos
+    return jnp.concatenate([blocks[inverse[i]] for i in range(len(order))],
+                           axis=axis)
+
+
+def _half_attend(qh, kh, vh, sm_scale, mask, tri):
+    """Attention of q rows over one K/V half-block (``tri``: the two blocks
+    share a global offset, so causality is the plain within-block triangle).
+    Thin wrapper over ``_block_attend`` — one online-softmax kernel, one set
+    of fully-masked-row guards."""
+    return _block_attend(qh, kh, vh, sm_scale, jnp.arange(qh.shape[1]),
+                         jnp.arange(kh.shape[1]), tri, mask)
+
+
+def _merge_contrib(a, b):
+    """Merge two online-softmax contributions for the same q rows."""
+    acc_a, m_a, l_a = a
+    acc_b, m_b, l_b = b
+    m = jnp.maximum(m_a, m_b)
+    alpha = jnp.exp(m_a - m)
+    beta = jnp.exp(m_b - m)
+    return acc_a * alpha + acc_b * beta, m, l_a * alpha + l_b * beta
+
+
+def _zigzag_causal_block(q, k, v, sm_scale, my_idx, src, key_mask):
+    """Causal zigzag step computing ONLY the allowed half-block products —
+    each ring step costs half a dense block on every device (this is where
+    the layout's load balancing becomes real FLOPs savings, not masking).
+
+    With q halves (block i, block 2N-1-i) and the source's K/V halves
+    (block j, block 2N-1-j), causality reduces to three cases:
+      j == i: lo x lo triangular; hi x lo full; hi x hi triangular
+      j <  i: both q halves attend lo fully (hi keys are all in the future)
+      j >  i: only the hi queries attend, over both key halves fully
+    """
+    b, s_local, hn, d = q.shape
+    h = s_local // 2
+    qlo, qhi = q[:, :h], q[:, h:]
+    klo, khi = k[:, :h], k[:, h:]
+    vlo, vhi = v[:, :h], v[:, h:]
+    mlo = key_mask[:, :h] if key_mask is not None else None
+    mhi = key_mask[:, h:] if key_mask is not None else None
+
+    def none_rows(n):
+        return (jnp.zeros((b, hn, n, d), jnp.float32),
+                jnp.full((b, hn, n, 1), NEG_INF / 2, jnp.float32),
+                jnp.zeros((b, hn, n, 1), jnp.float32))
+
+    def cat(lo, hi):
+        return tuple(jnp.concatenate([x, y], axis=2)
+                     for x, y in zip(lo, hi))
+
+    def eq_case():
+        lo = _half_attend(qlo, klo, vlo, sm_scale, mlo, tri=True)
+        hi = _merge_contrib(
+            _half_attend(qhi, klo, vlo, sm_scale, mlo, tri=False),
+            _half_attend(qhi, khi, vhi, sm_scale, mhi, tri=True))
+        return cat(lo, hi)
+
+    def lt_case():  # src holds strictly earlier lo block
+        return _half_attend(q, klo, vlo, sm_scale, mlo, tri=False)
+
+    def gt_case():  # only hi queries are late enough to see src's keys
+        return cat(none_rows(h),
+                   _half_attend(qhi, k, v, sm_scale, key_mask, tri=False))
+
+    return lax.cond(src == my_idx, eq_case,
+                    lambda: lax.cond(src < my_idx, lt_case, gt_case))
+
+
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
-                   sm_scale: Optional[float] = None, key_mask=None):
+                   sm_scale: Optional[float] = None, key_mask=None,
+                   layout: str = "contiguous"):
     """Attention over a sequence sharded along ``axis_name``.
 
     Args (local shards, inside shard_map):
       q, k, v: (B, S_local, H, D); global sequence = concat over the axis in
         rank order. key_mask: optional (B, S_local) bool for local keys.
+      layout: "contiguous" (shard i holds positions [i*S_local, ...)) or
+        "zigzag" (shard i holds blocks (i, 2N-1-i) — see ``zigzag_shard``;
+        balances causal work across devices, since with contiguous layout
+        device N-1 computes every ring step while device 0 is fully masked
+        after the first).
     Returns: (B, S_local, H, D) — attention of local queries over the FULL
-      global sequence.
+      global sequence, in the same layout as the inputs.
     """
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, hn, d = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring_attention layout: {layout!r}")
+    if layout == "zigzag" and s_local % 2:
+        raise ValueError(
+            f"zigzag layout needs an even local sequence (got {s_local})")
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    q_off = my_idx * s_local
+    def positions(idx):
+        if layout == "zigzag":
+            return zigzag_positions(idx, s_local, axis_size)
+        return idx * s_local + jnp.arange(s_local)
+
+    q_pos = positions(my_idx)
+
+    def contributions(k_blk, v_blk, mask_blk, src):
+        if causal and layout == "zigzag":
+            # Only the allowed half-blocks are computed — balanced ~half a
+            # dense block per device per step.
+            return _zigzag_causal_block(q, k_blk, v_blk, scale, my_idx, src,
+                                        mask_blk)
+        if causal and layout == "contiguous":
+            # Blocks entirely in the future are skipped, not masked: device
+            # i computes i+1 of the N steps (zigzag balances this).
+            def empty():
+                return (jnp.zeros((b, hn, s_local, d), jnp.float32),
+                        jnp.full((b, hn, s_local, 1), NEG_INF / 2,
+                                 jnp.float32),
+                        jnp.zeros((b, hn, s_local, 1), jnp.float32))
+
+            def compute():
+                a, bm, bl = _block_attend(q, k_blk, v_blk, scale, q_pos,
+                                          positions(src), causal, mask_blk)
+                return a, bm, bl
+
+            return lax.cond(src <= my_idx, compute, empty)
+        a, bm, bl = _block_attend(q, k_blk, v_blk, scale, q_pos,
+                                  positions(src), causal, mask_blk)
+        return a, bm, bl
 
     def step(carry, _):
         k_blk, v_blk, mask_blk, src, m, l, acc = carry
-        k_off = src * s_local
-        a, bm, bl = _block_attend(q, k_blk, v_blk, scale, q_off, k_off,
-                                  causal, mask_blk)
+        a, bm, bl = contributions(k_blk, v_blk, mask_blk, src)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)
         beta = jnp.exp(bm - m_new)
