@@ -93,10 +93,20 @@ def _maybe_init_jax_distributed() -> None:
         already = _dist.global_state.client is not None
     if already:
         return
+    kwargs = {}
+    start_timeout = os.environ.get("HOROVOD_START_TIMEOUT")
+    if start_timeout:
+        try:
+            val = int(float(start_timeout))
+        except ValueError:
+            val = 0  # tolerate garbage like the other two parsers
+        if val > 0:
+            kwargs["initialization_timeout"] = val
     jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=int(size),
-        process_id=int(rank))
+        process_id=int(rank),
+        **kwargs)
 
 
 def init(ranks: Optional[Sequence[int]] = None) -> None:

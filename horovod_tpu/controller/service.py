@@ -24,6 +24,20 @@ from ..common import hvd_logging as logging
 from ..common.wire import Wire
 
 
+def _start_timeout() -> float:
+    """Rendezvous window, launcher-exported (reference horovodrun
+    --start-timeout; run/run.py:285-342)."""
+    import os
+
+    try:
+        val = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
+    except ValueError:
+        return 120.0
+    # Non-positive would mean an already-expired window (ring.cc applies the
+    # same v > 0 guard, so both planes fall back identically).
+    return val if val > 0 else 120.0
+
+
 def parse_addr(addr: str) -> Tuple[str, int]:
     host, _, port = addr.rpartition(":")
     return host or "127.0.0.1", int(port)
@@ -32,7 +46,10 @@ def parse_addr(addr: str) -> Tuple[str, int]:
 class CoordinatorService:
     """Rank 0's side: accept one connection per worker rank."""
 
-    def __init__(self, bind_addr: str, size: int, accept_timeout: float = 120.0):
+    def __init__(self, bind_addr: str, size: int,
+                 accept_timeout: Optional[float] = None):
+        if accept_timeout is None:
+            accept_timeout = _start_timeout()
         host, port = parse_addr(bind_addr)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -82,7 +99,10 @@ class WorkerClient:
     retries while the coordinator comes up (the reference's task services
     retry registration the same way, ``run/common/service/driver_service.py``)."""
 
-    def __init__(self, addr: str, rank: int, connect_timeout: float = 120.0):
+    def __init__(self, addr: str, rank: int,
+                 connect_timeout: Optional[float] = None):
+        if connect_timeout is None:
+            connect_timeout = _start_timeout()
         host, port = parse_addr(addr)
         deadline = time.monotonic() + connect_timeout
         last_err: Optional[Exception] = None

@@ -106,11 +106,15 @@ def build() -> str:
         tmp_path = f"{lib_path}.tmp.{os.getpid()}"
         cmd = ["g++", *_CXX_FLAGS, *sources, "-o", tmp_path]
         logging.debug("building native core: %s", " ".join(cmd))
-        result = subprocess.run(cmd, capture_output=True, text=True)
-        if result.returncode != 0:
-            raise RuntimeError(
-                f"native core build failed:\n{result.stderr}")
-        os.replace(tmp_path, lib_path)
+        try:
+            result = subprocess.run(cmd, capture_output=True, text=True)
+            if result.returncode != 0:
+                raise RuntimeError(
+                    f"native core build failed:\n{result.stderr}")
+            os.replace(tmp_path, lib_path)
+        finally:
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
     return lib_path
 
 

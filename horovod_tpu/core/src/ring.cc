@@ -419,7 +419,15 @@ int ring_init(Ring& ring, int rank, int size, const char* addrs_cstr,
     set_error("getaddrinfo failed for " + rhost);
     return -1;
   }
-  auto deadline = std::chrono::steady_clock::now() + std::chrono::seconds(120);
+  // Rendezvous window (reference horovodrun --start-timeout), exported by
+  // the launcher as HOROVOD_START_TIMEOUT.
+  int start_timeout_s = 120;
+  if (const char* st = getenv("HOROVOD_START_TIMEOUT")) {
+    int v = atoi(st);
+    if (v > 0) start_timeout_s = v;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(start_timeout_s);
   while (true) {
     ring.right_fd = socket(AF_INET, SOCK_STREAM, 0);
     if (connect(ring.right_fd, res->ai_addr, res->ai_addrlen) == 0) break;

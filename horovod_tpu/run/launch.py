@@ -200,6 +200,7 @@ def run(args: argparse.Namespace) -> int:
             dict(os.environ), rank, size, local_rank, local_size,
             cross_rank, len(groups), coord_addr, secret, args.bind_chips,
             spmd=args.spmd)
+        env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
         if not args.spmd:
             env["HOROVOD_RING_ADDRS"] = ring_addrs_env
             if rank in local_ring_by_rank and cross_ring_env:
@@ -216,7 +217,8 @@ def run(args: argparse.Namespace) -> int:
                 if k.startswith(("HOROVOD_", "TPU_", "JAX_", "PYTHONPATH")))
             remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
                 " ".join(shlex.quote(c) for c in args.command)
-            cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+            cmd = ["ssh", "-o", "StrictHostKeyChecking=no",
+                   "-p", str(args.ssh_port), host, remote]
             env = dict(os.environ)
         proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -285,6 +287,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "global mesh over all chips); collectives run "
                              "inside jit over ICI/DCN instead of the eager "
                              "controller")
+    parser.add_argument("-p", "--ssh-port", type=int, default=22,
+                        help="ssh port for remote hosts (reference "
+                             "horovodrun -p)")
+    parser.add_argument("--start-timeout", type=int, default=600,
+                        help="seconds to wait for all ranks to start and "
+                             "rendezvous before aborting (reference "
+                             "horovodrun --start-timeout)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")

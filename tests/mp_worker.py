@@ -144,6 +144,19 @@ def scenario_error_mismatch(rank, size):
     else:
         raise AssertionError("mismatched allgather dims did not raise")
 
+    # op-type mismatch: same name enqueued as different collectives
+    # (reference ConstructResponse "Mismatched MPI operations",
+    # operations.cc:209-240).
+    try:
+        if rank == 0:
+            hvd.allreduce(np.ones(3, np.float32), name="bad.op")
+        else:
+            hvd.allgather(np.ones(3, np.float32), name="bad.op")
+    except RuntimeError as exc:
+        expect("Mismatched" in str(exc), f"wrong error: {exc}")
+    else:
+        raise AssertionError("mismatched op types did not raise")
+
     # After errors, the controller must still work.
     ok = np.asarray(hvd.allreduce(np.ones(3, np.float32), average=False,
                                   name="good.after"))
