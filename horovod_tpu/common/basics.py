@@ -98,7 +98,7 @@ def _maybe_init_jax_distributed() -> None:
     if start_timeout:
         try:
             val = int(float(start_timeout))
-        except ValueError:
+        except (ValueError, OverflowError):
             val = 0  # tolerate garbage like the other two parsers
         if val > 0:
             kwargs["initialization_timeout"] = val

@@ -6,9 +6,10 @@ execs ``mpirun`` which fans out ranks via orted. On TPU none of the MPI
 machinery exists; the launcher's jobs reduce to:
 
   1. mint a per-job HMAC secret and pick the coordinator address,
-  2. start one process per rank with the topology exported in env
+  2. for remote hosts: cached ssh preflight (reference ``run/run.py:46-102``),
+  3. start one process per rank with the topology exported in env
      (``HOROVOD_RANK/SIZE/LOCAL_RANK/LOCAL_SIZE/CONTROLLER_ADDR/SECRET_KEY``),
-  3. stream rank-prefixed output, propagate failures, kill stragglers.
+  4. stream rank-prefixed output, propagate failures, kill stragglers.
 
 Local ranks are direct children; remote hosts (``-H host:slots``) fan out
 over ssh with the env inlined (the reference's ``-x VAR`` passthrough,
@@ -102,6 +103,73 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
     return env
 
 
+_SSH_CACHE = os.path.expanduser("~/.horovod_tpu/ssh_preflight.json")
+_SSH_CACHE_TTL_S = 300.0
+
+
+def ssh_preflight(hosts: List[str], ssh_port: int = 22,
+                  use_cache: bool = True, timeout: float = 10.0) -> None:
+    """Verify passwordless ssh to every remote host before fanning out
+    (reference ``run/run.py:46-102``: threaded check with an on-disk cache
+    so repeated launches skip it). Raises RuntimeError listing unreachable
+    hosts; successes are cached for five minutes."""
+    import json
+
+    cache: Dict[str, float] = {}
+    now = time.time()
+    if use_cache:
+        try:
+            with open(_SSH_CACHE) as f:
+                cache = {h: t for h, t in json.load(f).items()
+                         if now - t < _SSH_CACHE_TTL_S}
+        except (OSError, ValueError):
+            cache = {}
+
+    # Cache key includes the port: success on 22 says nothing about 2222.
+    def key(h):
+        return f"{h}:{ssh_port}"
+
+    to_check = [h for h in hosts if not _is_local(h) and key(h) not in cache]
+    failures: Dict[str, str] = {}
+    lock = threading.Lock()
+
+    def check(host):
+        try:
+            res = subprocess.run(
+                ["ssh", "-o", "StrictHostKeyChecking=no", "-o",
+                 "BatchMode=yes", "-o", f"ConnectTimeout={int(timeout)}",
+                 "-p", str(ssh_port), host, "true"],
+                capture_output=True, text=True, timeout=timeout + 5)
+            ok, msg = res.returncode == 0, (res.stderr or res.stdout).strip()
+        except Exception as exc:  # missing ssh binary, subprocess timeout
+            ok, msg = False, str(exc)
+        with lock:
+            if ok:
+                cache[key(host)] = now
+            else:
+                failures[host] = msg
+
+    threads = [threading.Thread(target=check, args=(h,)) for h in to_check]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    if use_cache and cache:
+        try:
+            os.makedirs(os.path.dirname(_SSH_CACHE), exist_ok=True)
+            with open(_SSH_CACHE, "w") as f:
+                json.dump(cache, f)
+        except OSError:
+            pass
+    if failures:
+        detail = "; ".join(f"{h}: {msg or 'ssh failed'}"
+                           for h, msg in sorted(failures.items()))
+        raise RuntimeError(
+            f"ssh preflight failed for {sorted(failures)} — passwordless "
+            f"ssh is required for remote hosts ({detail})")
+
+
 def _stream(prefix: str, pipe, out) -> None:
     for line in iter(pipe.readline, ""):
         out.write(f"{prefix}{line}")
@@ -115,6 +183,9 @@ def run(args: argparse.Namespace) -> int:
     secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
     coord_host = hosts[0][0]
     any_remote_host = any(not _is_local(h) for h, _ in hosts)
+    if any_remote_host:
+        ssh_preflight([h for h, _ in hosts], ssh_port=args.ssh_port,
+                      use_cache=not args.disable_cache)
     if _is_local(coord_host):
         # With remote hosts in play the coordinator must be reachable from
         # them — loopback only works for all-local jobs.
@@ -294,6 +365,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="seconds to wait for all ranks to start and "
                              "rendezvous before aborting (reference "
                              "horovodrun --start-timeout)")
+    parser.add_argument("--disable-cache", action="store_true",
+                        help="skip the ssh-preflight result cache "
+                             "(reference horovodrun --disable-cache)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")

@@ -46,6 +46,38 @@ def test_launch_failure_propagates():
     assert res.returncode == 3
 
 
+def test_ssh_preflight_unreachable_host_fails_fast():
+    from horovod_tpu.run.launch import ssh_preflight
+
+    with pytest.raises(RuntimeError, match="ssh preflight failed"):
+        ssh_preflight(["nonexistent-host-for-preflight-test.invalid"],
+                      use_cache=False, timeout=3.0)
+
+
+def test_ssh_preflight_cache(tmp_path, monkeypatch):
+    import subprocess as sp
+
+    from horovod_tpu.run import launch
+
+    monkeypatch.setattr(launch, "_SSH_CACHE",
+                        str(tmp_path / "ssh_cache.json"))
+    calls = []
+
+    def fake_run(cmd, **kwargs):
+        calls.append(cmd)
+        return sp.CompletedProcess(cmd, 0, stdout="", stderr="")
+
+    monkeypatch.setattr(launch.subprocess, "run", fake_run)
+    launch.ssh_preflight(["remote-a", "remote-b"])
+    assert len(calls) == 2
+    # Second launch within the TTL: cached, no ssh invocations.
+    launch.ssh_preflight(["remote-a", "remote-b"])
+    assert len(calls) == 2
+    # Local hosts are never checked.
+    launch.ssh_preflight(["localhost"])
+    assert len(calls) == 2
+
+
 def test_parse_hosts():
     from horovod_tpu.run import parse_hosts
 
