@@ -44,7 +44,13 @@ class _CastCompressor(Compressor):
 
     @classmethod
     def compress(cls, tensor):
-        tensor = jnp.asarray(tensor)
+        # Numpy inputs stay numpy: converting through jnp would truncate
+        # float64 under jax's default x64-disabled mode BEFORE ctx records
+        # the dtype, making the original unrecoverable.
+        import numpy as np
+
+        if not hasattr(tensor, "astype"):
+            tensor = np.asarray(tensor)
         ctx = tensor.dtype
         if jnp.issubdtype(ctx, jnp.floating) and ctx != cls.wire_dtype:
             return tensor.astype(cls.wire_dtype), ctx

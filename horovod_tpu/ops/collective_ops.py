@@ -31,6 +31,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..common import basics
@@ -96,6 +97,27 @@ def _controller():
     return basics.controller()
 
 
+def _wrap_for(tensor):
+    """Result wrapper preserving the caller's container: jax arrays come
+    back as jax arrays; anything else (numpy, lists, scalars) comes back as
+    numpy with its dtype intact. Wrapping numpy through ``jnp.asarray``
+    would silently truncate float64/int64 under jax's default x64-disabled
+    mode — the transport preserves dtypes, the wrapper must too."""
+    if isinstance(tensor, jax.Array):
+        return jnp.asarray
+    return np.asarray
+
+
+def _wrap_value(tensor):
+    """Size-1 identity result. Numpy inputs are COPIED: the result must not
+    alias the caller's buffer (at size > 1 the controller always returns a
+    fresh array, and training code that reuses its gradient buffers must
+    behave identically on one chip)."""
+    if isinstance(tensor, jax.Array):
+        return jnp.asarray(tensor)
+    return np.array(tensor)
+
+
 # ---------------------------------------------------------------------------
 # allreduce
 
@@ -116,9 +138,10 @@ def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None
             lambda t, ax: lax.pmean(t, ax) if avg else lax.psum(t, ax))
     st = basics.state()
     if st.topology.size == 1:
-        return jnp.asarray(tensor)
+        return _wrap_value(tensor)
     return _controller().allreduce(tensor, average=avg, name=name,
-                                   compression=compression, wrap=jnp.asarray)
+                                   compression=compression,
+                                   wrap=_wrap_for(tensor))
 
 
 def allreduce_async(tensor, average: Optional[bool] = None,
@@ -135,10 +158,10 @@ def allreduce_async(tensor, average: Optional[bool] = None,
             "(XLA already overlaps collectives with compute)")
     st = basics.state()
     if st.topology.size == 1:
-        return handle_manager.completed(jnp.asarray(tensor))
+        return handle_manager.completed(_wrap_value(tensor))
     return _controller().allreduce_async(tensor, average=avg, name=name,
                                          compression=compression,
-                                         wrap=jnp.asarray)
+                                         wrap=_wrap_for(tensor))
 
 
 # ---------------------------------------------------------------------------
@@ -159,8 +182,8 @@ def allgather(tensor, name: Optional[str] = None,
             tensor, axis_name, lambda t, ax: lax.all_gather(t, ax, tiled=True))
     st = basics.state()
     if st.topology.size == 1:
-        return jnp.asarray(tensor)
-    return _controller().allgather(tensor, name=name, wrap=jnp.asarray)
+        return _wrap_value(tensor)
+    return _controller().allgather(tensor, name=name, wrap=_wrap_for(tensor))
 
 
 def allgather_async(tensor, name: Optional[str] = None) -> Handle:
@@ -168,8 +191,9 @@ def allgather_async(tensor, name: Optional[str] = None) -> Handle:
         raise ValueError("allgather_async is an eager-tier API")
     st = basics.state()
     if st.topology.size == 1:
-        return handle_manager.completed(jnp.asarray(tensor))
-    return _controller().allgather_async(tensor, name=name, wrap=jnp.asarray)
+        return handle_manager.completed(_wrap_value(tensor))
+    return _controller().allgather_async(tensor, name=name,
+                                         wrap=_wrap_for(tensor))
 
 
 # ---------------------------------------------------------------------------
@@ -194,9 +218,9 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
     if st.topology.size == 1:
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
-        return jnp.asarray(tensor)
+        return _wrap_value(tensor)
     return _controller().broadcast(tensor, root_rank=root_rank, name=name,
-                                   wrap=jnp.asarray)
+                                   wrap=_wrap_for(tensor))
 
 
 def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handle:
@@ -206,9 +230,9 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handl
     if st.topology.size == 1:
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
-        return handle_manager.completed(jnp.asarray(tensor))
+        return handle_manager.completed(_wrap_value(tensor))
     return _controller().broadcast_async(tensor, root_rank=root_rank,
-                                         name=name, wrap=jnp.asarray)
+                                         name=name, wrap=_wrap_for(tensor))
 
 
 # ---------------------------------------------------------------------------
@@ -232,7 +256,7 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[str] = No
         return _traced_collective(tensor, axis_name, _rs)
     st = basics.state()
     if st.topology.size == 1:
-        return jnp.asarray(tensor)
+        return _wrap_value(tensor)
     return _controller().reducescatter(tensor, average=avg)
 
 
@@ -251,7 +275,7 @@ def alltoall(tensor, axis_name: Optional[str] = None):
         return _traced_collective(tensor, axis_name, _a2a)
     st = basics.state()
     if st.topology.size == 1:
-        return jnp.asarray(tensor)
+        return _wrap_value(tensor)
     return _controller().alltoall(tensor)
 
 

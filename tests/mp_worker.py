@@ -61,6 +61,22 @@ def scenario_fusion(rank, size):
         want = np.ones(32) * (size * i + sum(range(size)))
         np.testing.assert_allclose(out, want, rtol=1e-6)
 
+    # Mixed dtypes interleaved: fusion must look AHEAD past a mismatched
+    # dtype and still pack the same-dtype tensors (reference FuseResponses
+    # look-ahead, operations.cc:483-499) — and every tensor must come back
+    # with its own dtype and the right value.
+    mixed = []
+    for i in range(8):
+        dtype = [np.float32, np.float64, np.int32][i % 3]
+        mixed.append((dtype, hvd.allreduce_async(
+            (np.ones(16, dtype) * (i + 1)), average=False,
+            name=f"fuse.mixed.{i}")))
+    for i, (dtype, h) in enumerate(mixed):
+        out = np.asarray(hvd.synchronize(h))
+        expect(out.dtype == dtype, f"dtype changed: {out.dtype} != {dtype}")
+        np.testing.assert_allclose(out, np.ones(16) * (i + 1) * size,
+                                   rtol=1e-6)
+
 
 def scenario_allgather(rank, size):
     # Rank-dependent first dims (reference allgather variable-dim tests).

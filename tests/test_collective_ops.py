@@ -107,6 +107,33 @@ def test_eager_single_process_identity():
         hvd.broadcast(x, root_rank=1)
 
 
+def test_eager_numpy_dtype_preserved_and_no_alias():
+    # Numpy in -> numpy out with dtype intact (jnp wrapping would truncate
+    # float64/int64 under x64-disabled jax), and the result must be a COPY,
+    # never a view of the caller's buffer.
+    hvd.init()
+    for dtype in (np.float64, np.int64, np.float32):
+        x = np.arange(4, dtype=dtype)
+        out = hvd.allreduce(x, average=False)
+        assert isinstance(out, np.ndarray) and out.dtype == dtype
+        x.fill(0)
+        np.testing.assert_array_equal(out, np.arange(4, dtype=dtype))
+    # jax in -> jax out.
+    xj = jnp.arange(4, dtype=jnp.float32)
+    assert isinstance(hvd.allreduce(xj), jax.Array)
+
+
+def test_compression_preserves_float64():
+    from horovod_tpu.compression import Compression
+
+    x = np.linspace(-2, 2, 8, dtype=np.float64)
+    wire, ctx = Compression.fp16.compress(x)
+    assert wire.dtype == np.float16 and ctx == np.float64
+    back = Compression.fp16.decompress(wire, ctx)
+    assert back.dtype == np.float64
+    np.testing.assert_allclose(back, x, atol=1e-2)
+
+
 def test_eager_async_handles():
     hvd.init()
     x = jnp.ones(4)
