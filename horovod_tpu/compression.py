@@ -14,6 +14,7 @@ bytes on ICI/DCN).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 
 class Compressor:
@@ -47,8 +48,6 @@ class _CastCompressor(Compressor):
         # Numpy inputs stay numpy: converting through jnp would truncate
         # float64 under jax's default x64-disabled mode BEFORE ctx records
         # the dtype, making the original unrecoverable.
-        import numpy as np
-
         if not hasattr(tensor, "astype"):
             tensor = np.asarray(tensor)
         ctx = tensor.dtype

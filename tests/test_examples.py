@@ -82,6 +82,16 @@ def test_imagenet_resnet50_checkpoint_resume(tmp_path):
     assert "resumed" in out and "ckpt_2" in out
 
 
+def test_scaling_efficiency_smoke():
+    out = _run([sys.executable, os.path.join(EX, "scaling_efficiency.py"),
+                "--model", "mlp", "--steps", "3", "--warmup", "1",
+                "--batch-per-chip", "8"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=2"})
+    assert '"metric": "scaling_efficiency"' in out
+    assert '"efficiency":' in out
+
+
 def test_torch_synthetic_benchmark_two_ranks():
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable,
