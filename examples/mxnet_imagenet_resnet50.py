@@ -1,0 +1,129 @@
+"""ImageNet ResNet-50 with the MXNet adapter.
+
+Counterpart of the reference's ``examples/mxnet_imagenet_resnet50.py``:
+gluon model, ``DistributedTrainer``, ``broadcast_parameters`` after init,
+world-size-scaled learning rate with warmup + 30/60/80 step decay, and
+metrics averaged across ranks with ``DistributedEvalMetric``.
+
+MXNet is end-of-life and not installed in this image; with real mxnet the
+model comes from ``gluon.model_zoo.vision.resnet50_v1``, otherwise the
+in-tree fake (``tests/fake_mxnet.py``) supplies a Dense head over flattened
+synthetic images — the distributed mechanics (broadcast, gradient
+averaging, metric reduction, LR schedule) are identical either way:
+
+    bin/horovodrun -np 2 python examples/mxnet_imagenet_resnet50.py \
+        --epochs 1 --steps-per-epoch 2 --image-size 32 --batch-size 4
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+try:
+    import mxnet as mx
+    _REAL_MXNET = True
+except ImportError:  # pragma: no cover - fall back to the in-tree fake
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests"))
+    import fake_mxnet
+
+    mx = fake_mxnet.module()
+    sys.modules["mxnet"] = mx
+    _REAL_MXNET = False
+
+import horovod_tpu.mxnet as hvd
+
+
+def synthetic_imagenet(n, image_size, num_classes, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3 * image_size * image_size).astype(np.float32)
+    y = rng.randint(0, num_classes, size=n).astype(np.int32)
+    return x, y
+
+
+def lr_multiplier(epoch, batch, batches, warmup_epochs):
+    """Linear warmup over the first epochs, then 10x decay at 30/60/80
+    (the reference example's schedule)."""
+    if epoch < warmup_epochs:
+        progress = (batch + epoch * batches) / max(1, warmup_epochs * batches)
+        return 1.0 / hvd.size() * (progress * (hvd.size() - 1) + 1)
+    if epoch < 30:
+        return 1.0
+    if epoch < 60:
+        return 1e-1
+    if epoch < 80:
+        return 1e-2
+    return 1e-3
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=90)
+    parser.add_argument("--steps-per-epoch", type=int, default=16)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--base-lr", type=float, default=0.0125)
+    parser.add_argument("--warmup-epochs", type=int, default=5)
+    args = parser.parse_args()
+
+    hvd.init()
+
+    n = args.steps_per_epoch * args.batch_size
+    x, y = synthetic_imagenet(n, args.image_size, args.num_classes,
+                              seed=hvd.rank())
+
+    if _REAL_MXNET:
+        net = mx.gluon.model_zoo.vision.resnet50_v1(
+            classes=args.num_classes)
+        net.initialize()
+        reshape = (args.batch_size, 3, args.image_size, args.image_size)
+    else:
+        net = mx.gluon.nn.Dense(args.num_classes,
+                                in_units=3 * args.image_size ** 2)
+        net.initialize()
+        reshape = None
+
+    params = net.collect_params()
+    hvd.broadcast_parameters(params, root_rank=0)
+
+    base_lr = args.base_lr * hvd.size()
+    opt = mx.optimizer.SGD(learning_rate=base_lr)
+    trainer = hvd.DistributedTrainer(params, opt)
+
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    # Real mxnet's EvalMetric is abstract; Accuracy is its stock concrete
+    # subclass. The in-tree fake's EvalMetric is already concrete.
+    if _REAL_MXNET:
+        metric = hvd.DistributedEvalMetric(mx.metric.Accuracy)()
+    else:
+        metric = hvd.DistributedEvalMetric(mx.metric.EvalMetric)(name="acc")
+
+    batches = max(1, n // args.batch_size)
+    for epoch in range(args.epochs):
+        for b in range(batches):
+            opt.set_learning_rate(
+                base_lr * lr_multiplier(epoch, b, batches,
+                                        args.warmup_epochs))
+            sl = slice(b * args.batch_size, (b + 1) * args.batch_size)
+            xb = x[sl].reshape(reshape) if reshape else x[sl]
+            xb, yb = mx.nd.array(xb), mx.nd.array(y[sl])
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(args.batch_size)
+        metric.reset()
+        metric.update([mx.nd.array(y[:args.batch_size])],
+                      [net(mx.nd.array(
+                          x[:args.batch_size].reshape(reshape)
+                          if reshape else x[:args.batch_size]))])
+        name, val = metric.get()
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: {name}={val:.4f}")
+
+
+if __name__ == "__main__":
+    main()

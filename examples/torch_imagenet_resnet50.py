@@ -1,0 +1,221 @@
+"""ImageNet ResNet-50 with the torch adapter.
+
+Counterpart of the reference's ``examples/pytorch_imagenet_resnet50.py``,
+with the same training recipe:
+
+- world-size-scaled learning rate with 5-epoch gradual warmup and
+  30/60/80-epoch decay,
+- gradient accumulation over ``--batches-per-allreduce`` sub-batches,
+- rank-0 checkpointing with resume (``broadcast_parameters`` +
+  ``broadcast_optimizer_state`` make every rank consistent after restore),
+- metrics averaged across ranks with ``hvd.allreduce``.
+
+The reference pulls ResNet-50 from torchvision; this image has no
+torchvision, so an equivalent bottleneck ResNet-50 is defined in-file.
+Without ``--train-dir`` a synthetic ImageNet-shaped dataset is used, so the
+script runs anywhere:
+
+    bin/horovodrun -np 2 python examples/torch_imagenet_resnet50.py \
+        --epochs 1 --steps-per-epoch 4 --image-size 64 --batch-size 4
+"""
+
+import argparse
+import os
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_ch, ch, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(ch)
+        self.conv2 = nn.Conv2d(ch, ch, 3, stride=stride, padding=1,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(ch)
+        self.conv3 = nn.Conv2d(ch, ch * self.expansion, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(ch * self.expansion)
+        self.down = None
+        if stride != 1 or in_ch != ch * self.expansion:
+            self.down = nn.Sequential(
+                nn.Conv2d(in_ch, ch * self.expansion, 1, stride=stride,
+                          bias=False),
+                nn.BatchNorm2d(ch * self.expansion))
+
+    def forward(self, x):
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        skip = x if self.down is None else self.down(x)
+        return F.relu(out + skip)
+
+
+class ResNet50(nn.Module):
+    """Standard [3, 4, 6, 3] bottleneck ResNet-50 (hand-rolled: torchvision
+    is unavailable; same topology as the reference's
+    ``models.resnet50()``)."""
+
+    def __init__(self, num_classes=1000, width=64):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, width, 7, stride=2, padding=3, bias=False)
+        self.bn1 = nn.BatchNorm2d(width)
+        layers, in_ch = [], width
+        for ch, blocks, stride in ((width, 3, 1), (width * 2, 4, 2),
+                                   (width * 4, 6, 2), (width * 8, 3, 2)):
+            for b in range(blocks):
+                layers.append(Bottleneck(in_ch, ch, stride if b == 0 else 1))
+                in_ch = ch * Bottleneck.expansion
+        self.layers = nn.Sequential(*layers)
+        self.fc = nn.Linear(in_ch, num_classes)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.bn1(self.conv1(x))), 3, stride=2,
+                         padding=1)
+        x = self.layers(x)
+        x = torch.flatten(F.adaptive_avg_pool2d(x, 1), 1)
+        return self.fc(x)
+
+
+def synthetic_imagenet(n, image_size, num_classes, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 3, image_size, image_size).astype(np.float32)
+    y = rng.randint(0, num_classes, size=n)
+    return torch.from_numpy(x), torch.from_numpy(y)
+
+
+def adjust_learning_rate(optimizer, args, epoch, batch_idx, batches):
+    """Reference LR schedule: linear warmup from lr to lr*size over
+    ``--warmup-epochs``, then decay 10x at epochs 30/60/80."""
+    if epoch < args.warmup_epochs:
+        progress = (batch_idx + epoch * batches) / max(
+            1, args.warmup_epochs * batches)
+        lr_adj = 1.0 / hvd.size() * (progress * (hvd.size() - 1) + 1)
+    elif epoch < 30:
+        lr_adj = 1.0
+    elif epoch < 60:
+        lr_adj = 1e-1
+    elif epoch < 80:
+        lr_adj = 1e-2
+    else:
+        lr_adj = 1e-3
+    for group in optimizer.param_groups:
+        group["lr"] = (args.base_lr * hvd.size()
+                       * args.batches_per_allreduce * lr_adj)
+
+
+def accuracy(output, target):
+    pred = output.argmax(dim=1)
+    return (pred == target).float().mean()
+
+
+def save_checkpoint(model, optimizer, epoch, fmt):
+    if hvd.rank() == 0:
+        # Filenames are 1-based: checkpoint-{N} holds the state after
+        # completing epoch N-1, so resume starts at epoch N.
+        torch.save({"model": model.state_dict(),
+                    "optimizer": optimizer.state_dict()},
+                   fmt.format(epoch=epoch + 1))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--train-dir", default=None,
+                        help="real ImageNet dir (synthetic data if unset)")
+    parser.add_argument("--checkpoint-format",
+                        default="checkpoint-{epoch}.pth.tar")
+    parser.add_argument("--batches-per-allreduce", type=int, default=1,
+                        help="gradient accumulation sub-batches per step")
+    parser.add_argument("--epochs", type=int, default=90)
+    parser.add_argument("--steps-per-epoch", type=int, default=None)
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-sub-batch input size")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--base-lr", type=float, default=0.0125)
+    parser.add_argument("--warmup-epochs", type=float, default=5)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=5e-5)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+
+    hvd.init()
+    torch.manual_seed(args.seed)
+
+    # Resume from the latest checkpoint rank 0 can see; the subsequent
+    # broadcasts make every rank consistent with it.
+    resume_epoch = 0
+    for try_epoch in range(args.epochs, 0, -1):
+        if os.path.exists(args.checkpoint_format.format(epoch=try_epoch)):
+            resume_epoch = try_epoch
+            break
+    # Only rank 0's filesystem is authoritative (no shared-fs assumption):
+    # everyone adopts its answer so all ranks run the same epoch range.
+    resume_epoch = int(hvd.broadcast(torch.tensor(resume_epoch), root_rank=0,
+                                     name="resume_from_epoch"))
+
+    if args.train_dir:
+        raise SystemExit("real ImageNet loading not wired in this image; "
+                         "run without --train-dir for synthetic data")
+    n = 512 if args.steps_per_epoch is None else (
+        args.steps_per_epoch * args.batch_size * args.batches_per_allreduce)
+    x, y = synthetic_imagenet(n, args.image_size, args.num_classes,
+                              seed=args.seed + hvd.rank())
+
+    model = ResNet50(num_classes=args.num_classes)
+    optimizer = torch.optim.SGD(
+        model.parameters(),
+        lr=args.base_lr * hvd.size() * args.batches_per_allreduce,
+        momentum=args.momentum, weight_decay=args.wd)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        backward_passes_per_step=args.batches_per_allreduce)
+
+    if resume_epoch > 0 and hvd.rank() == 0:
+        ckpt = torch.load(args.checkpoint_format.format(epoch=resume_epoch))
+        model.load_state_dict(ckpt["model"])
+        optimizer.load_state_dict(ckpt["optimizer"])
+
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    macro = args.batch_size * args.batches_per_allreduce
+    for epoch in range(resume_epoch, args.epochs):
+        model.train()
+        perm = torch.randperm(
+            len(x), generator=torch.Generator().manual_seed(epoch))
+        batches = max(1, len(x) // macro)
+        train_loss, train_acc = 0.0, 0.0
+        for batch_idx in range(batches):
+            adjust_learning_rate(optimizer, args, epoch, batch_idx, batches)
+            optimizer.zero_grad()
+            idx = perm[batch_idx * macro:(batch_idx + 1) * macro]
+            for i in range(0, len(idx), args.batch_size):
+                sub = idx[i:i + args.batch_size]
+                output = model(x[sub])
+                loss = F.cross_entropy(output, y[sub])
+                train_loss += float(loss) / args.batches_per_allreduce
+                train_acc += float(accuracy(output, y[sub])) \
+                    / args.batches_per_allreduce
+                # Average over the accumulated sub-batches.
+                loss.div_(args.batches_per_allreduce)
+                loss.backward()
+            optimizer.step()
+        train_loss = float(hvd.allreduce(
+            torch.tensor(train_loss / batches), name="train_loss"))
+        train_acc = float(hvd.allreduce(
+            torch.tensor(train_acc / batches), name="train_acc"))
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={train_loss:.4f} "
+                  f"acc={train_acc:.4f}")
+        save_checkpoint(model, optimizer, epoch, args.checkpoint_format)
+
+
+if __name__ == "__main__":
+    main()

@@ -272,6 +272,13 @@ class EvalMetric:
         self.seen.append(([np.asarray(t.asnumpy()) for t in labels],
                           [np.asarray(t.asnumpy()) for t in preds]))
 
+    def reset(self):
+        self.num_updates = 0
+        self.seen = []
+
+    def get(self):
+        return self.name, float(self.num_updates)
+
 
 def module():
     """Assemble the fake as a module object exposing the ``mx.*`` attribute

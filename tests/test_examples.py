@@ -92,6 +92,73 @@ def test_scaling_efficiency_smoke():
     assert '"efficiency":' in out
 
 
+def test_tensorflow_mnist_two_ranks():
+    # The tf.function path: allreduce rides a py_function node inside the
+    # traced step.
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "tensorflow_mnist.py"),
+                "--epochs", "1", "--batch-size", "256"])
+    assert "epoch 0" in out
+
+
+def test_tensorflow_mnist_eager_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "tensorflow_mnist_eager.py"),
+                "--steps", "5", "--batch-size", "32"])
+    assert "step 0" in out
+
+
+def test_tensorflow_keras_mnist_two_ranks(tmp_path):
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "tensorflow_keras_mnist.py"),
+                "--epochs", "1", "--batch-size", "256",
+                "--model-dir", str(tmp_path)])
+    assert "final: acc=" in out
+
+
+def test_keras_mnist_advanced_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "keras_mnist_advanced.py"),
+                "--epochs", "2", "--batch-size", "256",
+                "--warmup-epochs", "1"])
+    assert "final: acc=" in out
+
+
+def test_torch_imagenet_resnet50_two_ranks_resume(tmp_path):
+    fmt = str(tmp_path / "checkpoint-{epoch}.pth.tar")
+    script = os.path.join(EX, "torch_imagenet_resnet50.py")
+    args = ["--steps-per-epoch", "2", "--batch-size", "2", "--image-size",
+            "32", "--num-classes", "10", "--checkpoint-format", fmt]
+    _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+          sys.executable, script, "--epochs", "1"] + args)
+    assert os.path.exists(fmt.format(epoch=1))
+    # Second run resumes past epoch 0 from the rank-0 checkpoint.
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, script, "--epochs", "2"] + args)
+    assert "epoch 1" in out and "epoch 0:" not in out
+
+
+def test_keras_imagenet_resnet50_two_ranks(tmp_path):
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable,
+                os.path.join(EX, "keras_imagenet_resnet50.py"),
+                "--epochs", "1", "--steps-per-epoch", "2",
+                "--batch-size", "2", "--image-size", "32",
+                "--num-classes", "10", "--checkpoint-format",
+                str(tmp_path / "ck-{epoch}.weights.h5")])
+    assert "final:" in out
+
+
+def test_mxnet_imagenet_resnet50_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable,
+                os.path.join(EX, "mxnet_imagenet_resnet50.py"),
+                "--epochs", "1", "--steps-per-epoch", "2",
+                "--batch-size", "4", "--image-size", "16",
+                "--num-classes", "10"])
+    assert "epoch 0" in out
+
+
 def test_torch_synthetic_benchmark_two_ranks():
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable,
