@@ -60,6 +60,25 @@ def test_word2vec_example_smoke():
     assert "pairs/sec" in out
 
 
+def test_tensorflow_word2vec_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable, os.path.join(EX, "tensorflow_word2vec.py"),
+                "--steps", "10", "--batch-size", "64",
+                "--vocab-size", "500", "--embedding-dim", "16"])
+    # The embedding gradient must ride the sparse IndexedSlices path while
+    # the dense projection gradient rides the dense allreduce path.
+    assert "embedding grad: IndexedSlices" in out
+    assert "proj grad: EagerTensor" in out
+
+
+def test_keras_spark_rossmann_fallback_path():
+    # pyspark is absent in this image; the example's in-process path still
+    # runs the full feature-engineering + entity-embedding pipeline.
+    out = _run([sys.executable, os.path.join(EX, "keras_spark_rossmann.py"),
+                "--epochs", "1", "--rows", "1024"])
+    assert "final exp_rmspe=" in out
+
+
 def test_mxnet_example_two_ranks():
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable, os.path.join(EX, "mxnet_mnist.py"),
