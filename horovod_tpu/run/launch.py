@@ -170,6 +170,75 @@ def ssh_preflight(hosts: List[str], ssh_port: int = 22,
             f"ssh is required for remote hosts ({detail})")
 
 
+def discover_routable_addrs(hosts: List[str], ssh_port: int, secret: str,
+                            timeout: float = 60.0) -> Optional[Dict[str, str]]:
+    """Ring-probe every host's interfaces and return {host: routable_ip}
+    (reference NIC discovery, ``run/run.py:105-256``): a probe task runs on
+    each host (ssh for remote, a thread locally), dials every advertised
+    interface of the next host, and the driver keeps, per host, an address
+    its predecessor proved reachable. Returns None if discovery can't
+    complete — callers fall back to the ``-H`` names."""
+    from .nic_discovery import NICDriverService, list_interfaces, \
+        run_probe_task
+
+    if len(hosts) < 2:
+        return None
+    driver = NICDriverService(len(hosts), timeout=timeout)
+    # Remote tasks try every local interface address until one answers.
+    driver_addrs = ",".join(f"{ip}:{driver.port}"
+                            for _, ip in list_interfaces())
+    procs: List[Tuple[str, subprocess.Popen]] = []
+    threads: List[threading.Thread] = []
+    try:
+        for i, host in enumerate(hosts):
+            if _is_local(host):
+                t = threading.Thread(
+                    target=lambda idx=i: run_probe_task(
+                        idx, f"127.0.0.1:{driver.port}"),
+                    daemon=True)
+                t.start()
+                threads.append(t)
+            else:
+                remote = (f"cd {shlex.quote(os.getcwd())} && env "
+                          f"HOROVOD_SECRET_KEY={shlex.quote(secret)} "
+                          f"{shlex.quote(sys.executable)} -m "
+                          f"horovod_tpu.run.task_fn {i} {driver_addrs}")
+                procs.append((host, subprocess.Popen(
+                    ["ssh", "-o", "StrictHostKeyChecking=no",
+                     "-p", str(ssh_port), host, remote],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                    text=True)))
+        # Poll instead of blocking: a probe that dies instantly (wrong
+        # remote python/cwd) should fail the discovery now, with its
+        # stderr, not after the full timeout.
+        deadline = time.monotonic() + timeout
+        while not driver.done():
+            for host, p in procs:
+                if p.poll() not in (None, 0):
+                    err = (p.stderr.read() or "").strip() if p.stderr else ""
+                    sys.stderr.write(
+                        f"horovodrun: NIC probe on {host} exited with code "
+                        f"{p.returncode}"
+                        + (f": {err}" if err else "")
+                        + "; falling back to -H host names\n")
+                    return None
+            if time.monotonic() > deadline:
+                sys.stderr.write(
+                    "horovodrun: NIC discovery timed out; falling back to "
+                    "-H host names (override with --controller-addr / "
+                    "HOROVOD_RING_ADDRS if unroutable)\n")
+                return None
+            time.sleep(0.1)
+        routable = driver.routable_addrs()
+        return {host: routable[i] for i, host in enumerate(hosts)
+                if i in routable}
+    finally:
+        driver.close()
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
 def _stream(prefix: str, pipe, out) -> None:
     for line in iter(pipe.readline, ""):
         out.write(f"{prefix}{line}")
@@ -183,13 +252,30 @@ def run(args: argparse.Namespace) -> int:
     secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
     coord_host = hosts[0][0]
     any_remote_host = any(not _is_local(h) for h, _ in hosts)
+    host_ip: Dict[str, str] = {}
     if any_remote_host:
         ssh_preflight([h for h, _ in hosts], ssh_port=args.ssh_port,
                       use_cache=not args.disable_cache)
-    if _is_local(coord_host):
-        # With remote hosts in play the coordinator must be reachable from
-        # them — loopback only works for all-local jobs.
-        coord_host = socket.gethostname() if any_remote_host else "127.0.0.1"
+        # Skip the ring-probe when every consumer of its result is already
+        # overridden: the coordinator address explicitly, and the ring
+        # addresses either explicitly or absent entirely (SPMD mode).
+        all_overridden = args.controller_addr and (
+            args.spmd or "HOROVOD_RING_ADDRS" in os.environ)
+        if not args.disable_nic_discovery and not all_overridden:
+            # Probe tasks and the driver authenticate with the job secret.
+            os.environ["HOROVOD_SECRET_KEY"] = secret
+            host_ip = discover_routable_addrs(
+                [h for h, _ in hosts], args.ssh_port, secret) or {}
+    def _public_host(host: str) -> str:
+        """Address other hosts should dial for `host`: the ring-probed
+        routable IP when discovery ran, else the -H name; local entries in
+        mixed jobs need a reachable name, not loopback."""
+        if _is_local(host):
+            return (host_ip.get(host) or socket.gethostname()
+                    if any_remote_host else "127.0.0.1")
+        return host_ip.get(host, host)
+
+    coord_host = _public_host(coord_host)
     coord_addr = args.controller_addr or f"{coord_host}:{_free_port()}"
 
     assignments = []  # (rank, host, local_rank, local_size, cross_rank)
@@ -213,11 +299,10 @@ def run(args: argparse.Namespace) -> int:
         ring_addrs = []
         for r, host, _, _, _ in assignments:
             if _is_local(host):
-                addr_host = (socket.gethostname() if any_remote_host
-                             else "127.0.0.1")
-                ring_addrs.append(f"{addr_host}:{_free_port()}")
+                ring_addrs.append(f"{_public_host(host)}:{_free_port()}")
             else:
-                ring_addrs.append(f"{host}:{_derived_port(ring_base, r)}")
+                ring_addrs.append(
+                    f"{_public_host(host)}:{_derived_port(ring_base, r)}")
         ring_addrs_env = os.environ.get("HOROVOD_RING_ADDRS",
                                         ",".join(ring_addrs))
 
@@ -245,9 +330,8 @@ def run(args: argparse.Namespace) -> int:
 
         def _group_addr(host, offset):
             if _is_local(host):
-                h = socket.gethostname() if any_remote_host else "127.0.0.1"
-                return f"{h}:{_free_port()}"
-            return f"{host}:{_derived_port(ring_base, offset)}"
+                return f"{_public_host(host)}:{_free_port()}"
+            return f"{_public_host(host)}:{_derived_port(ring_base, offset)}"
 
         cross_addrs = []
         for cr in sorted(groups):
@@ -368,6 +452,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--disable-cache", action="store_true",
                         help="skip the ssh-preflight result cache "
                              "(reference horovodrun --disable-cache)")
+    parser.add_argument("--disable-nic-discovery", action="store_true",
+                        help="skip the interface ring-probe on multi-host "
+                             "launches and dial the -H names directly")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")

@@ -1,0 +1,250 @@
+"""Routable-interface (NIC) discovery for multi-host launches.
+
+Reference shape (``run/run.py:105-256``): the launcher starts a TCP driver
+service, launches a small task server on every host (over ssh), and each
+task *ring-probes* the next host — it tries to connect to every advertised
+interface address of task ``(i+1) % N`` and reports which ones worked. The
+driver then knows, per host, an address its ring predecessor can actually
+route to, and the set of interface names that worked on every link
+(the reference intersects exactly this set to build
+``-mca btl_tcp_if_include``).
+
+Here the result feeds the launcher directly: the coordinator address and the
+per-rank ring addresses use the discovered routable IPs instead of whatever
+``-H`` happened to say, so multi-homed hosts (management NIC + DCN NIC) work
+without ``--controller-addr`` / ``HOROVOD_RING_ADDRS`` overrides.
+
+Pure stdlib: interfaces are enumerated with ``SIOCGIFADDR`` ioctls (Linux),
+falling back to a hostname lookup; transport is the job's authenticated
+``Wire`` framing.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..common.wire import Wire
+
+PROBE_TIMEOUT = 3.0
+
+
+def list_interfaces() -> List[Tuple[str, str]]:
+    """Enumerate (interface, IPv4 address) pairs of this host, loopback
+    last (a loopback route only helps same-host links)."""
+    pairs: List[Tuple[str, str]] = []
+    try:
+        import fcntl
+
+        SIOCGIFADDR = 0x8915
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            for _, name in socket.if_nameindex():
+                try:
+                    packed = fcntl.ioctl(
+                        s.fileno(), SIOCGIFADDR,
+                        struct.pack("256s", name.encode()[:255]))
+                    pairs.append((name, socket.inet_ntoa(packed[20:24])))
+                except OSError:
+                    continue  # interface without an IPv4 address
+    except (ImportError, OSError):
+        pass
+    if not pairs:
+        try:
+            pairs = [("host", socket.gethostbyname(socket.gethostname()))]
+        except OSError:
+            pairs = [("lo", "127.0.0.1")]
+    pairs.sort(key=lambda p: p[1].startswith("127."))
+    return pairs
+
+
+class NICDriverService:
+    """Rendezvous for the probe tasks. One instance per launch; threads
+    serve each task connection."""
+
+    def __init__(self, num_hosts: int, timeout: float = 60.0):
+        self._num = num_hosts
+        self._timeout = timeout
+        self._lock = threading.Condition()
+        self._registered: Dict[int, dict] = {}
+        self._reports: Dict[int, List[Tuple[str, str]]] = {}
+        self._srv = socket.create_server(("0.0.0.0", 0))
+        self.port = self._srv.getsockname()[1]
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        wire = Wire(conn)
+        try:
+            while True:
+                msg = wire.recv_obj()
+                op = msg.get("op")
+                if op == "register":
+                    with self._lock:
+                        self._registered[msg["index"]] = msg
+                        self._lock.notify_all()
+                        ok = self._wait(
+                            lambda: len(self._registered) == self._num)
+                    if not ok:
+                        wire.send_obj({"error": "registration timeout"})
+                        return
+                    nxt = self._registered[(msg["index"] + 1) % self._num]
+                    wire.send_obj({"next_addrs": nxt["addrs"],
+                                   "next_probe_port": nxt["probe_port"]})
+                elif op == "report":
+                    with self._lock:
+                        self._reports[msg["index"]] = msg["reachable"]
+                        self._lock.notify_all()
+                        ok = self._wait(
+                            lambda: len(self._reports) == self._num)
+                    if not ok:
+                        wire.send_obj({"error": "report timeout"})
+                        return
+                    wire.send_obj({"routable": self.routable_addrs(),
+                                   "common_interfaces":
+                                       sorted(self.common_interfaces())})
+                    return
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def _wait(self, pred) -> bool:
+        deadline = time.monotonic() + self._timeout
+        while not pred():
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            self._lock.wait(remaining)
+        return True
+
+    def routable_addrs(self) -> Dict[int, str]:
+        """Per host index: an IP of that host proven reachable from its ring
+        predecessor (first reported wins; interface enumeration order puts
+        real NICs before loopback)."""
+        out = {}
+        for i in range(self._num):
+            pred = (i - 1) % self._num
+            reached = self._reports.get(pred, [])
+            if reached:
+                out[i] = reached[0][1]
+        return out
+
+    def common_interfaces(self) -> set:
+        """Interface names that worked on every probed link (the
+        reference's intersection that feeds ``btl_tcp_if_include``)."""
+        sets = [set(name for name, _ in r) for r in self._reports.values()]
+        return set.intersection(*sets) if sets else set()
+
+    def done(self) -> bool:
+        with self._lock:
+            return len(self._reports) == self._num
+
+    def wait_done(self) -> bool:
+        with self._lock:
+            return self._wait(lambda: len(self._reports) == self._num)
+
+    def close(self) -> None:
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def run_probe_task(index: int, driver_addr: str,
+                   addrs: Optional[Sequence[Tuple[str, str]]] = None) -> dict:
+    """One host's probe task: advertise local interfaces, then try every
+    interface address of the next host in the ring and report the ones that
+    accepted a TCP connection. Returns the driver's final answer."""
+    addrs = list(addrs) if addrs is not None else list_interfaces()
+
+    # Probe listener the *previous* host will dial.
+    probe_srv = socket.create_server(("0.0.0.0", 0))
+    probe_port = probe_srv.getsockname()[1]
+    accepting = True
+
+    def _absorb():
+        while accepting:
+            try:
+                conn, _ = probe_srv.accept()
+                conn.close()
+            except OSError:
+                return
+
+    threading.Thread(target=_absorb, daemon=True).start()
+
+    # The driver advertises every candidate address it has (comma-separated)
+    # — the task dials them in order until one answers (the reference's task
+    # services do the same against the driver's address list).
+    sock = None
+    last_err: Optional[Exception] = None
+    for cand in driver_addr.split(","):
+        host, port = cand.rsplit(":", 1)
+        try:
+            sock = socket.create_connection((host, int(port)),
+                                            timeout=PROBE_TIMEOUT * 10)
+            break
+        except OSError as exc:
+            last_err = exc
+    if sock is None:
+        raise ConnectionError(
+            f"could not reach NIC driver at any of {driver_addr}: {last_err}")
+    # The register/report replies arrive only after EVERY host has checked
+    # in, which can take far longer than the dial timeout — the protocol's
+    # patience is the driver's, not the socket's.
+    sock.settimeout(None)
+    with sock:
+        wire = Wire(sock)
+        wire.send_obj({"op": "register", "index": index,
+                       "addrs": addrs, "probe_port": probe_port})
+        ans = wire.recv_obj()
+        if "error" in ans:
+            raise RuntimeError(f"NIC discovery failed: {ans['error']}")
+
+        # Probe every advertised address concurrently: a veth/docker-heavy
+        # peer can advertise dozens, and 3 s each sequentially would starve
+        # the other tasks' protocol waits.
+        reachable = []
+        lock = threading.Lock()
+
+        def _try(name, ip):
+            try:
+                with socket.create_connection(
+                        (ip, ans["next_probe_port"]),
+                        timeout=PROBE_TIMEOUT):
+                    with lock:
+                        reachable.append((name, ip))
+            except OSError:
+                pass
+
+        probes = [threading.Thread(target=_try, args=a)
+                  for a in ans["next_addrs"]]
+        for t in probes:
+            t.start()
+        for t in probes:
+            t.join()
+        # Restore the advertised order (real NICs before loopback) so
+        # "first reachable" stays meaningful.
+        order = {(n, i): k for k, (n, i) in enumerate(ans["next_addrs"])}
+        reachable.sort(key=lambda a: order[a])
+
+        wire.send_obj({"op": "report", "index": index,
+                       "reachable": reachable})
+        final = wire.recv_obj()
+    accepting = False
+    probe_srv.close()
+    if "error" in final:
+        raise RuntimeError(f"NIC discovery failed: {final['error']}")
+    return final

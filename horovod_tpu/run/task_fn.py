@@ -1,0 +1,24 @@
+"""Per-host NIC-probe task, launched over ssh by the launcher.
+
+Reference: ``run/task_fn.py`` (the per-host task server the driver starts to
+ring-probe interfaces). Usage (launcher-internal):
+
+    python -m horovod_tpu.run.task_fn <index> <driver_addr[,driver_addr...]>
+
+The job secret rides ``HOROVOD_SECRET_KEY`` in the environment, so probe
+traffic is authenticated with the same key as the control plane.
+"""
+
+import sys
+
+from .nic_discovery import run_probe_task
+
+
+def main() -> int:
+    index, driver_addr = int(sys.argv[1]), sys.argv[2]
+    run_probe_task(index, driver_addr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,3 +85,68 @@ def test_parse_hosts():
     assert parse_hosts(None, 3) == [("localhost", 3)]
     with pytest.raises(ValueError, match="exceeds total slots"):
         parse_hosts("a:1", 2)
+
+
+def test_nic_list_interfaces():
+    from horovod_tpu.run.nic_discovery import list_interfaces
+    pairs = list_interfaces()
+    assert pairs, "must enumerate at least one IPv4 interface"
+    for name, ip in pairs:
+        assert ip.count(".") == 3
+    # Loopback sorts last when a real NIC exists.
+    if len(pairs) > 1:
+        assert not pairs[0][1].startswith("127.")
+
+
+def test_nic_ring_probe_three_hosts():
+    """Three probe tasks stand in for three hosts (the reference test model:
+    N ranks on one box). One of them runs through the ssh entry point
+    (task_fn) as a real subprocess."""
+    import threading
+
+    from horovod_tpu.run.nic_discovery import (
+        NICDriverService,
+        run_probe_task,
+    )
+
+    driver = NICDriverService(3, timeout=60.0)
+    addr = f"127.0.0.1:{driver.port}"
+    results = {}
+
+    def worker(i):
+        results[i] = run_probe_task(i, addr)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
+    for t in threads:
+        t.start()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run.task_fn", "2", addr],
+        env=env, capture_output=True, text=True, timeout=120)
+    for t in threads:
+        t.join(timeout=60)
+    driver.close()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert set(results) == {0, 1}
+    routable = results[0]["routable"]
+    # Every "host" got an address its ring predecessor proved reachable.
+    assert set(routable) == {0, 1, 2}
+    # All tasks share one machine, so every interface worked on every link.
+    assert results[0]["common_interfaces"]
+    assert results[0] == results[1]
+
+
+def test_nic_discovery_timeout_returns_error():
+    from horovod_tpu.run.nic_discovery import NICDriverService, run_probe_task
+
+    driver = NICDriverService(2, timeout=1.0)
+    with pytest.raises(RuntimeError, match="registration timeout"):
+        run_probe_task(0, f"127.0.0.1:{driver.port}")
+    assert not driver.wait_done()
+    driver.close()
+
+
+def test_discover_routable_addrs_single_host_is_noop():
+    from horovod_tpu.run.launch import discover_routable_addrs
+    assert discover_routable_addrs(["localhost"], 22, "ab" * 32) is None
