@@ -178,44 +178,65 @@ def discover_routable_addrs(hosts: List[str], ssh_port: int, secret: str,
     interface of the next host, and the driver keeps, per host, an address
     its predecessor proved reachable. Returns None if discovery can't
     complete — callers fall back to the ``-H`` names."""
+    from . import task_fn as task_fn_module
     from .nic_discovery import NICDriverService, list_interfaces, \
         run_probe_task
 
     if len(hosts) < 2:
         return None
     driver = NICDriverService(len(hosts), timeout=timeout)
-    # Remote tasks try every local interface address until one answers.
-    driver_addrs = ",".join(f"{ip}:{driver.port}"
-                            for _, ip in list_interfaces())
-    procs: List[Tuple[str, subprocess.Popen]] = []
+    # Remote tasks dial every candidate concurrently; loopback is useless to
+    # them (and could even connect to the WRONG host's bound port).
+    candidates = [ip for _, ip in list_interfaces()
+                  if not ip.startswith("127.")] \
+        or [ip for _, ip in list_interfaces()]
+    driver_addrs = ",".join(f"{ip}:{driver.port}" for ip in candidates)
+    procs: List[Tuple[str, subprocess.Popen, List[str]]] = []
     threads: List[threading.Thread] = []
+    thread_errors: List[str] = []
     try:
         for i, host in enumerate(hosts):
             if _is_local(host):
-                t = threading.Thread(
-                    target=lambda idx=i: run_probe_task(
-                        idx, f"127.0.0.1:{driver.port}"),
-                    daemon=True)
+                def _local_probe(idx=i):
+                    try:
+                        run_probe_task(idx, f"127.0.0.1:{driver.port}")
+                    except Exception as exc:  # checked by the poll loop
+                        thread_errors.append(f"local probe {idx}: {exc}")
+
+                t = threading.Thread(target=_local_probe, daemon=True)
                 t.start()
                 threads.append(t)
             else:
-                remote = (f"cd {shlex.quote(os.getcwd())} && env "
-                          f"HOROVOD_SECRET_KEY={shlex.quote(secret)} "
-                          f"{shlex.quote(sys.executable)} -m "
-                          f"horovod_tpu.run.task_fn {i} {driver_addrs}")
-                procs.append((host, subprocess.Popen(
+                # The standalone probe script rides ssh stdin (python -):
+                # the remote host needs no horovod_tpu checkout and pays no
+                # package import to enumerate its NICs.
+                remote = (f"env HOROVOD_SECRET_KEY={shlex.quote(secret)} "
+                          f"python3 - {i} {driver_addrs}")
+                p = subprocess.Popen(
                     ["ssh", "-o", "StrictHostKeyChecking=no",
                      "-p", str(ssh_port), host, remote],
+                    stdin=open(task_fn_module.__file__),
                     stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-                    text=True)))
-        # Poll instead of blocking: a probe that dies instantly (wrong
-        # remote python/cwd) should fail the discovery now, with its
+                    text=True)
+                # Drain stderr continuously: a chatty remote interpreter
+                # must not wedge on a full pipe mid-protocol.
+                buf: List[str] = []
+                threading.Thread(target=lambda p=p, b=buf: b.extend(
+                    iter(p.stderr.readline, "")), daemon=True).start()
+                procs.append((host, p, buf))
+        # Poll instead of blocking: a probe that dies instantly (no remote
+        # python3, auth failure) should fail the discovery now, with its
         # stderr, not after the full timeout.
         deadline = time.monotonic() + timeout
         while not driver.done():
-            for host, p in procs:
+            if thread_errors:
+                sys.stderr.write(
+                    f"horovodrun: NIC {thread_errors[0]}; falling back to "
+                    "-H host names\n")
+                return None
+            for host, p, buf in procs:
                 if p.poll() not in (None, 0):
-                    err = (p.stderr.read() or "").strip() if p.stderr else ""
+                    err = "".join(buf).strip()
                     sys.stderr.write(
                         f"horovodrun: NIC probe on {host} exited with code "
                         f"{p.returncode}"
@@ -234,7 +255,7 @@ def discover_routable_addrs(hosts: List[str], ssh_port: int, secret: str,
                 if i in routable}
     finally:
         driver.close()
-        for _, p in procs:
+        for _, p, _ in procs:
             if p.poll() is None:
                 p.terminate()
 
@@ -256,11 +277,18 @@ def run(args: argparse.Namespace) -> int:
     if any_remote_host:
         ssh_preflight([h for h, _ in hosts], ssh_port=args.ssh_port,
                       use_cache=not args.disable_cache)
-        # Skip the ring-probe when every consumer of its result is already
-        # overridden: the coordinator address explicitly, and the ring
-        # addresses either explicitly or absent entirely (SPMD mode).
-        all_overridden = args.controller_addr and (
-            args.spmd or "HOROVOD_RING_ADDRS" in os.environ)
+        # Skip the ring-probe only when every consumer of its result is
+        # already overridden: the coordinator address explicitly, and the
+        # ring addresses either absent entirely (SPMD mode) or explicitly —
+        # including the hierarchical rings when those are requested.
+        hier_requested = any(os.environ.get(k) for k in (
+            "HOROVOD_HIERARCHICAL_ALLREDUCE",
+            "HOROVOD_HIERARCHICAL_ALLGATHER"))
+        hier_overridden = ("HOROVOD_LOCAL_RING_ADDRS" in os.environ
+                           and "HOROVOD_CROSS_RING_ADDRS" in os.environ)
+        all_overridden = bool(args.controller_addr) and (
+            args.spmd or ("HOROVOD_RING_ADDRS" in os.environ
+                          and (not hier_requested or hier_overridden)))
         if not args.disable_nic_discovery and not all_overridden:
             # Probe tasks and the driver authenticate with the job secret.
             os.environ["HOROVOD_SECRET_KEY"] = secret
@@ -358,7 +386,12 @@ def run(args: argparse.Namespace) -> int:
         env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
         if not args.spmd:
             env["HOROVOD_RING_ADDRS"] = ring_addrs_env
-            if rank in local_ring_by_rank and cross_ring_env:
+            # User-set hierarchical ring addresses win (the pair travels
+            # together; build_rank_env already inherited them from the
+            # launcher's environment).
+            if rank in local_ring_by_rank and cross_ring_env and \
+                    "HOROVOD_LOCAL_RING_ADDRS" not in os.environ and \
+                    "HOROVOD_CROSS_RING_ADDRS" not in os.environ:
                 env["HOROVOD_LOCAL_RING_ADDRS"] = local_ring_by_rank[rank]
                 env["HOROVOD_CROSS_RING_ADDRS"] = cross_ring_env
         if _is_local(host):

@@ -14,50 +14,26 @@ per-rank ring addresses use the discovered routable IPs instead of whatever
 ``-H`` happened to say, so multi-homed hosts (management NIC + DCN NIC) work
 without ``--controller-addr`` / ``HOROVOD_RING_ADDRS`` overrides.
 
-Pure stdlib: interfaces are enumerated with ``SIOCGIFADDR`` ioctls (Linux),
-falling back to a hostname lookup; transport is the job's authenticated
-``Wire`` framing.
+The probe task itself lives in ``task_fn.py`` — standalone and
+stdlib-only so the launcher can pipe it over ssh stdin (no horovod_tpu
+install or jax import on the remote side); this module re-exports it and
+hosts the driver, whose transport is the job's authenticated ``Wire``
+framing (byte-compatible with the standalone probe's).
 """
 
 from __future__ import annotations
 
 import socket
-import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Tuple
 
 from ..common.wire import Wire
-
-PROBE_TIMEOUT = 3.0
-
-
-def list_interfaces() -> List[Tuple[str, str]]:
-    """Enumerate (interface, IPv4 address) pairs of this host, loopback
-    last (a loopback route only helps same-host links)."""
-    pairs: List[Tuple[str, str]] = []
-    try:
-        import fcntl
-
-        SIOCGIFADDR = 0x8915
-        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
-            for _, name in socket.if_nameindex():
-                try:
-                    packed = fcntl.ioctl(
-                        s.fileno(), SIOCGIFADDR,
-                        struct.pack("256s", name.encode()[:255]))
-                    pairs.append((name, socket.inet_ntoa(packed[20:24])))
-                except OSError:
-                    continue  # interface without an IPv4 address
-    except (ImportError, OSError):
-        pass
-    if not pairs:
-        try:
-            pairs = [("host", socket.gethostbyname(socket.gethostname()))]
-        except OSError:
-            pairs = [("lo", "127.0.0.1")]
-    pairs.sort(key=lambda p: p[1].startswith("127."))
-    return pairs
+from .task_fn import (  # noqa: F401  (re-exported shared implementation)
+    PROBE_TIMEOUT,
+    list_interfaces,
+    run_probe_task,
+)
 
 
 class NICDriverService:
@@ -161,90 +137,3 @@ class NICDriverService:
             self._srv.close()
         except OSError:
             pass
-
-
-def run_probe_task(index: int, driver_addr: str,
-                   addrs: Optional[Sequence[Tuple[str, str]]] = None) -> dict:
-    """One host's probe task: advertise local interfaces, then try every
-    interface address of the next host in the ring and report the ones that
-    accepted a TCP connection. Returns the driver's final answer."""
-    addrs = list(addrs) if addrs is not None else list_interfaces()
-
-    # Probe listener the *previous* host will dial.
-    probe_srv = socket.create_server(("0.0.0.0", 0))
-    probe_port = probe_srv.getsockname()[1]
-    accepting = True
-
-    def _absorb():
-        while accepting:
-            try:
-                conn, _ = probe_srv.accept()
-                conn.close()
-            except OSError:
-                return
-
-    threading.Thread(target=_absorb, daemon=True).start()
-
-    # The driver advertises every candidate address it has (comma-separated)
-    # — the task dials them in order until one answers (the reference's task
-    # services do the same against the driver's address list).
-    sock = None
-    last_err: Optional[Exception] = None
-    for cand in driver_addr.split(","):
-        host, port = cand.rsplit(":", 1)
-        try:
-            sock = socket.create_connection((host, int(port)),
-                                            timeout=PROBE_TIMEOUT * 10)
-            break
-        except OSError as exc:
-            last_err = exc
-    if sock is None:
-        raise ConnectionError(
-            f"could not reach NIC driver at any of {driver_addr}: {last_err}")
-    # The register/report replies arrive only after EVERY host has checked
-    # in, which can take far longer than the dial timeout — the protocol's
-    # patience is the driver's, not the socket's.
-    sock.settimeout(None)
-    with sock:
-        wire = Wire(sock)
-        wire.send_obj({"op": "register", "index": index,
-                       "addrs": addrs, "probe_port": probe_port})
-        ans = wire.recv_obj()
-        if "error" in ans:
-            raise RuntimeError(f"NIC discovery failed: {ans['error']}")
-
-        # Probe every advertised address concurrently: a veth/docker-heavy
-        # peer can advertise dozens, and 3 s each sequentially would starve
-        # the other tasks' protocol waits.
-        reachable = []
-        lock = threading.Lock()
-
-        def _try(name, ip):
-            try:
-                with socket.create_connection(
-                        (ip, ans["next_probe_port"]),
-                        timeout=PROBE_TIMEOUT):
-                    with lock:
-                        reachable.append((name, ip))
-            except OSError:
-                pass
-
-        probes = [threading.Thread(target=_try, args=a)
-                  for a in ans["next_addrs"]]
-        for t in probes:
-            t.start()
-        for t in probes:
-            t.join()
-        # Restore the advertised order (real NICs before loopback) so
-        # "first reachable" stays meaningful.
-        order = {(n, i): k for k, (n, i) in enumerate(ans["next_addrs"])}
-        reachable.sort(key=lambda a: order[a])
-
-        wire.send_obj({"op": "report", "index": index,
-                       "reachable": reachable})
-        final = wire.recv_obj()
-    accepting = False
-    probe_srv.close()
-    if "error" in final:
-        raise RuntimeError(f"NIC discovery failed: {final['error']}")
-    return final

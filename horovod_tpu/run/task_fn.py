@@ -1,22 +1,214 @@
-"""Per-host NIC-probe task, launched over ssh by the launcher.
+"""Per-host NIC-probe task (reference ``run/task_fn.py``).
 
-Reference: ``run/task_fn.py`` (the per-host task server the driver starts to
-ring-probe interfaces). Usage (launcher-internal):
+DELIBERATELY STANDALONE: stdlib-only, imports nothing from horovod_tpu —
+the launcher pipes this file over ssh stdin (``python - <index> <addrs>``),
+so the remote host needs no horovod_tpu checkout and pays no package/jax
+import just to enumerate NICs. ``nic_discovery`` imports the shared pieces
+from here (single implementation); the wire framing below must stay
+byte-compatible with ``common/wire.py``:
 
-    python -m horovod_tpu.run.task_fn <index> <driver_addr[,driver_addr...]>
+    [4-byte big-endian length][32-byte HMAC-SHA256][pickled payload]
 
-The job secret rides ``HOROVOD_SECRET_KEY`` in the environment, so probe
-traffic is authenticated with the same key as the control plane.
+keyed by ``HOROVOD_SECRET_KEY`` (hex) from the environment.
 """
 
-import sys
+from __future__ import annotations
 
-from .nic_discovery import run_probe_task
+import hashlib
+import hmac
+import json
+import os
+import pickle
+import socket
+import struct
+import sys
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+PROBE_TIMEOUT = 3.0
+_LEN = struct.Struct(">I")
+_DIGEST_LEN = 32
+
+
+def _secret() -> bytes:
+    key = os.environ.get("HOROVOD_SECRET_KEY")
+    if key:
+        return bytes.fromhex(key)
+    return b"horovod-tpu-default-insecure-key"  # wire.job_secret default
+
+
+def _send_obj(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hmac.new(_secret(), payload, hashlib.sha256).digest()
+    sock.sendall(_LEN.pack(len(payload)) + digest + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_obj(sock: socket.socket):
+    header = _recv_exact(sock, _LEN.size + _DIGEST_LEN)
+    (length,) = _LEN.unpack(header[:_LEN.size])
+    payload = _recv_exact(sock, length)
+    if not hmac.compare_digest(header[_LEN.size:],
+                               hmac.new(_secret(), payload,
+                                        hashlib.sha256).digest()):
+        raise RuntimeError("HMAC digest mismatch on probe frame")
+    return pickle.loads(payload)
+
+
+def list_interfaces() -> List[Tuple[str, str]]:
+    """(interface, IPv4 address) pairs of this host, loopback last (a
+    loopback route only helps same-host links)."""
+    pairs: List[Tuple[str, str]] = []
+    try:
+        import fcntl
+
+        SIOCGIFADDR = 0x8915
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            for _, name in socket.if_nameindex():
+                try:
+                    packed = fcntl.ioctl(
+                        s.fileno(), SIOCGIFADDR,
+                        struct.pack("256s", name.encode()[:255]))
+                    pairs.append((name, socket.inet_ntoa(packed[20:24])))
+                except OSError:
+                    continue  # interface without an IPv4 address
+    except (ImportError, OSError):
+        pass
+    if not pairs:
+        try:
+            pairs = [("host", socket.gethostbyname(socket.gethostname()))]
+        except OSError:
+            pairs = [("lo", "127.0.0.1")]
+    pairs.sort(key=lambda p: p[1].startswith("127."))
+    return pairs
+
+
+def _dial_driver(driver_addr: str) -> socket.socket:
+    """Dial every candidate concurrently, first answer wins: a firewalled
+    candidate black-holes for PROBE_TIMEOUT instead of serialising 30 s
+    stalls."""
+    candidates = driver_addr.split(",")
+    winner: List[socket.socket] = []
+    errors: List[Exception] = []
+    lock = threading.Lock()
+    done = threading.Event()
+
+    def _try(cand):
+        host, port = cand.rsplit(":", 1)
+        try:
+            s = socket.create_connection((host, int(port)),
+                                         timeout=PROBE_TIMEOUT)
+        except OSError as exc:
+            with lock:
+                errors.append(exc)
+                if len(errors) == len(candidates):
+                    done.set()
+            return
+        with lock:
+            if winner:
+                s.close()
+                return
+            winner.append(s)
+            done.set()
+
+    for cand in candidates:
+        threading.Thread(target=_try, args=(cand,), daemon=True).start()
+    done.wait(PROBE_TIMEOUT + 2.0)
+    with lock:
+        if not winner:
+            raise ConnectionError(
+                f"could not reach NIC driver at any of {driver_addr}: "
+                f"{errors[-1] if errors else 'timeout'}")
+        return winner[0]
+
+
+def run_probe_task(index: int, driver_addr: str,
+                   addrs: Optional[Sequence[Tuple[str, str]]] = None) -> dict:
+    """One host's probe: advertise local interfaces, try every interface
+    address of the next host in the ring, report the reachable ones.
+    Returns the driver's final answer."""
+    addrs = list(addrs) if addrs is not None else list_interfaces()
+
+    # Probe listener the *previous* host will dial.
+    probe_srv = socket.create_server(("0.0.0.0", 0))
+    probe_port = probe_srv.getsockname()[1]
+    accepting = True
+
+    def _absorb():
+        while accepting:
+            try:
+                conn, _ = probe_srv.accept()
+                conn.close()
+            except OSError:
+                return
+
+    threading.Thread(target=_absorb, daemon=True).start()
+
+    sock = _dial_driver(driver_addr)
+    # Protocol waits are driver-paced (replies arrive only after every host
+    # checks in) — the dial timeout must not apply to them.
+    sock.settimeout(None)
+    with sock:
+        _send_obj(sock, {"op": "register", "index": index,
+                         "addrs": addrs, "probe_port": probe_port})
+        ans = _recv_obj(sock)
+        if "error" in ans:
+            raise RuntimeError(f"NIC discovery failed: {ans['error']}")
+
+        # Probe every advertised address concurrently: a veth/docker-heavy
+        # peer can advertise dozens, and 3 s each sequentially would starve
+        # the other tasks' protocol waits.
+        reachable: List[Tuple[str, str]] = []
+        lock = threading.Lock()
+
+        def _try(name, ip):
+            try:
+                with socket.create_connection(
+                        (ip, ans["next_probe_port"]),
+                        timeout=PROBE_TIMEOUT):
+                    with lock:
+                        reachable.append((name, ip))
+            except OSError:
+                pass
+
+        probes = [threading.Thread(target=_try, args=tuple(a))
+                  for a in ans["next_addrs"]]
+        for t in probes:
+            t.start()
+        for t in probes:
+            t.join()
+        # Restore the advertised order (real NICs before loopback) so
+        # "first reachable" stays meaningful.
+        order = {tuple(a): k for k, a in enumerate(ans["next_addrs"])}
+        reachable.sort(key=lambda a: order[tuple(a)])
+
+        _send_obj(sock, {"op": "report", "index": index,
+                         "reachable": reachable})
+        final = _recv_obj(sock)
+    accepting = False
+    probe_srv.close()
+    if "error" in final:
+        raise RuntimeError(f"NIC discovery failed: {final['error']}")
+    return final
 
 
 def main() -> int:
     index, driver_addr = int(sys.argv[1]), sys.argv[2]
-    run_probe_task(index, driver_addr)
+    final = run_probe_task(index, driver_addr)
+    # Machine-readable result on stdout (tests parse it; the launcher's
+    # driver already holds the same answer).
+    print(json.dumps({"routable": final["routable"],
+                      "common_interfaces": final["common_interfaces"]}))
     return 0
 
 

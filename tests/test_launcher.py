@@ -119,11 +119,17 @@ def test_nic_ring_probe_three_hosts():
     threads = [threading.Thread(target=worker, args=(i,)) for i in (0, 1)]
     for t in threads:
         t.start()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    proc = subprocess.run(
-        [sys.executable, "-m", "horovod_tpu.run.task_fn", "2", addr],
-        env=env, capture_output=True, text=True, timeout=120)
+    # Third task runs exactly as the launcher ships it to remote hosts:
+    # the standalone script over stdin (`python -`), with NO repo on
+    # PYTHONPATH — proving it needs no horovod_tpu install.
+    import json
+
+    import horovod_tpu.run.task_fn as task_fn_module
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+    with open(task_fn_module.__file__) as script:
+        proc = subprocess.run(
+            [sys.executable, "-", "2", addr], stdin=script,
+            env=env, capture_output=True, text=True, timeout=120)
     for t in threads:
         t.join(timeout=60)
     driver.close()
@@ -135,6 +141,10 @@ def test_nic_ring_probe_three_hosts():
     # All tasks share one machine, so every interface worked on every link.
     assert results[0]["common_interfaces"]
     assert results[0] == results[1]
+    # The standalone task prints the same answer as JSON on stdout.
+    stdout_answer = json.loads(proc.stdout)
+    assert stdout_answer["common_interfaces"] == \
+        results[0]["common_interfaces"]
 
 
 def test_nic_discovery_timeout_returns_error():
