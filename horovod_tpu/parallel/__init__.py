@@ -4,10 +4,17 @@ sequence/context parallelism.
 The reference implements data parallelism only (SURVEY.md §2.3); the mesh
 utilities here are its substrate plus the axes future strategies hang off."""
 
-from . import hierarchical, sequence  # noqa: F401
+from . import hierarchical, moe, pipeline, sequence  # noqa: F401
+from .moe import moe_apply, switch_aux_loss  # noqa: F401
 from .hierarchical import (  # noqa: F401
     hierarchical_allgather,
     hierarchical_allreduce,
+)
+from .pipeline import (  # noqa: F401
+    collect_from_last_stage,
+    pipeline_apply,
+    pipeline_loss,
+    stack_stage_params,
 )
 from .mesh import (  # noqa: F401
     DATA_AXIS,

@@ -1,0 +1,116 @@
+"""Expert parallelism: mixture-of-experts dispatch over a mesh axis.
+
+The reference has no MoE (2019 CNN-era, SURVEY.md §2.3); this is a TPU
+extension on the same substrate: experts live along an ``"expert"`` mesh
+axis, and token dispatch/return ride ``jax.lax.all_to_all`` over ICI — the
+canonical TPU MoE layout (GShard/Switch): tokens are dispatched into
+``[experts, capacity, d_model]`` buffers with einsums against a one-hot
+dispatch mask, exchanged all-to-all so each device holds its expert's
+tokens from every peer, transformed, and exchanged back.
+
+Routing is top-k with capacity dropping (Switch for ``k=1``, GShard for
+``k=2``): per expert at most ``capacity = ceil(T/E * capacity_factor)``
+tokens survive; overflow tokens pass through with zero expert output (the
+standard residual-passthrough convention). The Switch load-balancing
+auxiliary loss is returned alongside the output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.psum(1, axis_name)
+
+
+def switch_aux_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
+    """Load-balancing loss (Switch Transformer eq. 4): E * sum_e
+    fraction_of_tokens(e) * mean_router_prob(e). Minimised at uniform
+    routing, where it equals 1."""
+    num_experts = probs.shape[-1]
+    fraction = expert_mask.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    return num_experts * jnp.sum(fraction * mean_prob)
+
+
+def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
+              expert_params: Any,
+              x: jax.Array,
+              gate_logits: jax.Array,
+              axis_name: str = "expert",
+              capacity_factor: float = 1.25,
+              num_selected: int = 1,
+              normalize_gates: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Run an MoE layer. MUST be called inside ``shard_map`` with
+    ``expert_params`` sharded over ``axis_name`` (leading expert axis, one
+    expert per device) and ``x``/``gate_logits`` carrying this device's
+    tokens (``[T, D]`` / ``[T, E]``).
+
+    Returns ``(y, aux_loss)``: ``y[T, D]`` is the gate-weighted expert
+    output per token (zero for capacity-dropped tokens — add the residual
+    outside), ``aux_loss`` the local Switch balancing loss (pmean it with
+    the data loss).
+    """
+    num_experts = _axis_size(axis_name)
+    tokens, d_model = x.shape
+    capacity = int(-(-tokens * capacity_factor // num_experts))
+    capacity = max(capacity, num_selected)
+
+    probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, E]
+
+    # Top-k routing: k rounds of argmax with already-chosen experts masked
+    # out, accumulating one dispatch/combine mask pair.
+    dispatch = jnp.zeros((tokens, num_experts, capacity), x.dtype)
+    combine = jnp.zeros((tokens, num_experts, capacity), x.dtype)
+    avail = jnp.ones_like(probs)          # experts still choosable per token
+    # Tokens already assigned per expert (fills capacity slots in order).
+    fill = jnp.zeros((num_experts,), jnp.int32)
+    total_mask = jnp.zeros_like(probs)
+    gate_sum = jnp.zeros((tokens,), x.dtype)
+    for _ in range(num_selected):
+        masked = jnp.where(avail > 0, probs, -jnp.inf)
+        choice = jnp.argmax(masked, axis=-1)              # [T]
+        gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, num_experts, dtype=x.dtype)  # [T, E]
+        # Slot index of each token within its chosen expert, continuing
+        # after slots used by earlier rounds.
+        pos = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]  # [T, E]
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = pos_tok < capacity
+        slot = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
+                              capacity, dtype=x.dtype)      # [T, C]
+        d = onehot[:, :, None] * slot[:, None, :] \
+            * keep[:, None, None].astype(x.dtype)
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        fill = fill + jnp.sum(onehot * keep[:, None].astype(x.dtype),
+                              axis=0).astype(jnp.int32)
+        avail = avail * (1.0 - onehot)
+        total_mask = total_mask + onehot
+        gate_sum = gate_sum + gate
+
+    if normalize_gates and num_selected > 1:
+        # GShard convention: the selected gates are renormalised to sum to 1
+        # per token (dropped or not).
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+
+    aux = switch_aux_loss(probs, total_mask / num_selected)
+
+    # [T, E, C] x [T, D] -> [E, C, D]; all-to-all so each device receives
+    # its expert's buffer from every peer: [E_src, C, D].
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    expert_in = jax.lax.all_to_all(expert_in, axis_name,
+                                   split_axis=0, concat_axis=0)
+    local_params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0),
+                                expert_params)
+    expert_out = expert_fn(
+        local_params, expert_in.reshape(num_experts * capacity, d_model))
+    expert_out = expert_out.reshape(num_experts, capacity, -1)
+    expert_out = jax.lax.all_to_all(expert_out, axis_name,
+                                    split_axis=0, concat_axis=0)
+    y = jnp.einsum("ecd,tec->td", expert_out, combine)
+    return y, aux

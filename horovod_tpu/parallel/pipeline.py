@@ -1,0 +1,113 @@
+"""Pipeline parallelism over a mesh axis (TPU extension).
+
+The reference implements data parallelism only (SURVEY.md §2.3); pipeline
+parallelism is out of its scope but first-class here because the mesh
+substrate carries it naturally: stages live along a ``"pipe"`` mesh axis,
+activations hop stage→stage over ICI with ``jax.lax.ppermute``, and the
+whole schedule compiles into one XLA program — no per-microbatch host
+round-trips, no NCCL-style send/recv threads.
+
+Schedule: GPipe (Huang et al. 2019) — all microbatches flow forward through
+the stage ring inside one ``lax.scan``; XLA overlaps each tick's compute
+with the ppermute transfer. The bubble fraction is ``(S-1)/(M+S-1)`` for
+``S`` stages and ``M`` microbatches, so pick ``M >= 4*S`` in practice.
+Autodiff runs through the scan/ppermute, giving the mirrored backward
+schedule for free; wrap the stage body in ``jax.checkpoint`` (the
+``remat`` flag below) to keep live memory at one microbatch per stage.
+
+Usage sketch (see ``tests/test_pipeline.py``)::
+
+    mesh = hvd.parallel.make_mesh({"data": 2, "pipe": 4})
+    # stage_params: pytree whose leaves have leading axis = #stages
+    y = jax.jit(jax.shard_map(
+        lambda p, x: pipeline_apply(stage_fn, p, x, axis_name="pipe"),
+        mesh=mesh,
+        in_specs=(P("pipe"), P(None, "data")),
+        out_specs=P(None, "data")))(stage_params, microbatches)
+
+Constraints (the classic homogeneous-pipeline contract): every stage maps
+activations of one shape to the same shape, and the number of scan ticks is
+``M + S - 1``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _axis_size(axis_name: str) -> int:
+    return jax.lax.psum(1, axis_name)
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage parameter pytrees along a new leading axis (the axis
+    sharded over the ``pipe`` mesh axis)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any,
+                   microbatches: jax.Array,
+                   axis_name: str = "pipe",
+                   remat: bool = True) -> jax.Array:
+    """Run a GPipe forward pass. MUST be called inside ``shard_map`` with
+    ``stage_params`` sharded over ``axis_name`` (leading stage axis) and
+    ``microbatches`` of shape ``[M, mb, ...]`` replicated along it.
+
+    Returns ``[M, mb, ...]`` outputs that are VALID ON THE LAST STAGE ONLY
+    (other stages hold garbage); reduce with :func:`pipeline_loss` or mask
+    by ``lax.axis_index(axis_name) == S-1`` before use.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    n_stages = _axis_size(axis_name)
+    num_mb = microbatches.shape[0]
+
+    # shard_map hands each device its [1, ...] slice of the stacked params.
+    local_params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0),
+                                stage_params)
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def tick(carry, t):
+        recv = carry
+        # Stage 0 injects microbatch t (clamped: bubble ticks recompute the
+        # last microbatch; their outputs are dropped, so no cotangent flows
+        # through them).
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.minimum(t, num_mb - 1), keepdims=False)
+        x = jnp.where(idx == 0, inject, recv)
+        y = body(local_params, x)
+        # Hand activations to the next stage; the last stage's edge wraps to
+        # stage 0 but is ignored there (stage 0 always injects).
+        send = jax.lax.ppermute(
+            y, axis_name,
+            [(i, (i + 1) % n_stages) for i in range(n_stages)])
+        return send, y
+
+    init = jnp.zeros_like(microbatches[0])
+    _, ys = jax.lax.scan(tick, init, jnp.arange(num_mb + n_stages - 1))
+    # On the last stage, microbatch m completes at tick m + (S-1).
+    return jax.lax.dynamic_slice_in_dim(ys, n_stages - 1, num_mb)
+
+
+def collect_from_last_stage(y: jax.Array,
+                            axis_name: str = "pipe") -> jax.Array:
+    """Broadcast the last stage's (valid) outputs to every stage, replacing
+    the garbage elsewhere — handy when the pipeline output itself (not just
+    a loss) must leave the ``shard_map`` replicated over the pipe axis."""
+    idx = jax.lax.axis_index(axis_name)
+    n_stages = _axis_size(axis_name)
+    return jax.lax.psum(jnp.where(idx == n_stages - 1, y, 0), axis_name)
+
+
+def pipeline_loss(per_mb_loss: jax.Array, axis_name: str = "pipe") -> jax.Array:
+    """Reduce per-microbatch losses computed from :func:`pipeline_apply`
+    outputs: keep the last stage's value, zero the garbage elsewhere, and
+    share it with every stage (so the loss — and its gradients — are
+    consistent across the pipe axis)."""
+    idx = jax.lax.axis_index(axis_name)
+    n_stages = _axis_size(axis_name)
+    masked = jnp.where(idx == n_stages - 1, per_mb_loss.mean(), 0.0)
+    return jax.lax.psum(masked, axis_name)

@@ -281,9 +281,9 @@ def run(args: argparse.Namespace) -> int:
         # already overridden: the coordinator address explicitly, and the
         # ring addresses either absent entirely (SPMD mode) or explicitly —
         # including the hierarchical rings when those are requested.
-        hier_requested = any(os.environ.get(k) for k in (
-            "HOROVOD_HIERARCHICAL_ALLREDUCE",
-            "HOROVOD_HIERARCHICAL_ALLGATHER"))
+        from ..common.config import _env_bool
+        hier_requested = (_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE")
+                          or _env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"))
         hier_overridden = ("HOROVOD_LOCAL_RING_ADDRS" in os.environ
                            and "HOROVOD_CROSS_RING_ADDRS" in os.environ)
         all_overridden = bool(args.controller_addr) and (
@@ -371,6 +371,13 @@ def run(args: argparse.Namespace) -> int:
             root_r, root_host = members[0][0], members[0][1]
             cross_addrs.append(_group_addr(root_host, 2 * size + root_r))
         cross_ring_env = ",".join(cross_addrs)
+        if ("HOROVOD_LOCAL_RING_ADDRS" in os.environ) != \
+                ("HOROVOD_CROSS_RING_ADDRS" in os.environ):
+            sys.stderr.write(
+                "horovodrun: only one of HOROVOD_LOCAL_RING_ADDRS/"
+                "HOROVOD_CROSS_RING_ADDRS is set; ignoring it in favor of "
+                "the launcher-computed hierarchical rings (set both to "
+                "override)\n")
 
     procs: List[subprocess.Popen] = []
     threads = []
@@ -386,12 +393,13 @@ def run(args: argparse.Namespace) -> int:
         env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
         if not args.spmd:
             env["HOROVOD_RING_ADDRS"] = ring_addrs_env
-            # User-set hierarchical ring addresses win (the pair travels
-            # together; build_rank_env already inherited them from the
-            # launcher's environment).
+            # A complete user-set hierarchical pair wins (build_rank_env
+            # already inherited it); anything less gets the computed pair —
+            # the two consumers (controller and native engine) require both,
+            # so a half-set pair would silently fall back to the flat ring.
             if rank in local_ring_by_rank and cross_ring_env and \
-                    "HOROVOD_LOCAL_RING_ADDRS" not in os.environ and \
-                    "HOROVOD_CROSS_RING_ADDRS" not in os.environ:
+                    not ("HOROVOD_LOCAL_RING_ADDRS" in os.environ
+                         and "HOROVOD_CROSS_RING_ADDRS" in os.environ):
                 env["HOROVOD_LOCAL_RING_ADDRS"] = local_ring_by_rank[rank]
                 env["HOROVOD_CROSS_RING_ADDRS"] = cross_ring_env
         if _is_local(host):

@@ -28,6 +28,7 @@ from typing import List, Optional, Sequence, Tuple
 PROBE_TIMEOUT = 3.0
 _LEN = struct.Struct(">I")
 _DIGEST_LEN = 32
+_MAX_FRAME = 1 << 31  # wire.MAX_FRAME: bound BEFORE reading the payload
 
 
 def _secret() -> bytes:
@@ -57,6 +58,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_obj(sock: socket.socket):
     header = _recv_exact(sock, _LEN.size + _DIGEST_LEN)
     (length,) = _LEN.unpack(header[:_LEN.size])
+    if length > _MAX_FRAME:
+        raise RuntimeError(f"oversized probe frame ({length} bytes)")
     payload = _recv_exact(sock, length)
     if not hmac.compare_digest(header[_LEN.size:],
                                hmac.new(_secret(), payload,
