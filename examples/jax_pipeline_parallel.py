@@ -1,0 +1,113 @@
+"""Pipeline-parallel training over a data x pipe mesh (GPipe schedule).
+
+Demonstrates pipeline parallelism (``horovod_tpu.parallel.pipeline``, a TPU
+extension — the reference is DP-only, SURVEY.md §2.3): a deep stack of
+residual MLP blocks is split into stages along the ``pipe`` mesh axis,
+microbatches stream through the stage ring with ``ppermute`` hand-offs
+inside one compiled ``lax.scan``, and per-stage rematerialisation keeps
+live memory at one microbatch per stage.
+
+    python examples/jax_pipeline_parallel.py --steps 50 --microbatches 16
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_loss,
+    stack_stage_params,
+)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--microbatches", type=int, default=16)
+    parser.add_argument("--microbatch-size", type=int, default=32)
+    parser.add_argument("--features", type=int, default=256)
+    parser.add_argument("--layers-per-stage", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    args = parser.parse_args()
+
+    hvd.init()
+    n = jax.device_count()
+    pp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+    dp = n // pp
+    mesh = make_mesh({"data": dp, "pipe": pp})
+    if hvd.rank() == 0:
+        bubble = (pp - 1) / (args.microbatches + pp - 1)
+        print(f"mesh: data={dp} x pipe={pp}; {args.microbatches} "
+              f"microbatches -> {bubble:.0%} bubble")
+
+    rng = np.random.RandomState(0)
+    f = args.features
+
+    def make_stage():
+        return {
+            "w": jnp.asarray(
+                rng.randn(args.layers_per_stage, f, f) / np.sqrt(f),
+                jnp.float32),
+            "b": jnp.zeros((args.layers_per_stage, f), jnp.float32),
+        }
+
+    stacked = stack_stage_params([make_stage() for _ in range(pp)])
+
+    def stage_fn(p, x):
+        def layer(h, wb):
+            w, b = wb
+            return h + jax.nn.gelu(h @ w + b), None
+        out, _ = jax.lax.scan(layer, x, (p["w"], p["b"]))
+        return out
+
+    mb_total = args.microbatch_size * dp
+    data = jnp.asarray(
+        rng.randn(args.microbatches, mb_total, f), jnp.float32)
+    w_true = jnp.asarray(rng.randn(f, f) / np.sqrt(f), jnp.float32)
+    target = jnp.tanh(data @ w_true)
+
+    def body(p, x, y):
+        outs = pipeline_apply(stage_fn, p, x, axis_name="pipe")
+        per_mb = jnp.mean((outs - y) ** 2, axis=(1, 2))
+        return jax.lax.pmean(pipeline_loss(per_mb, "pipe"), "data")
+
+    def loss_fn(p, x, y):
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("pipe"), P(None, "data"), P(None, "data")),
+            out_specs=P(), check_vma=False)(p, x, y)
+
+    tx = optax.adam(args.lr)
+    opt_state = tx.init(stacked)
+
+    @jax.jit
+    def step(p, o, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    t0, loss = None, None
+    for i in range(args.steps):
+        stacked, opt_state, loss = step(stacked, opt_state, data, target)
+        if i == 0:
+            float(loss)
+            t0 = time.perf_counter()
+        if i % 10 == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+    elapsed = time.perf_counter() - t0
+    samples = args.microbatches * mb_total * (args.steps - 1)
+    if hvd.rank() == 0:
+        print(f"final loss {float(loss):.4f}; "
+              f"{samples / elapsed:,.0f} samples/sec through {pp} stages")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,11 @@ _lock = threading.Lock()
 _global_mesh: Optional[Mesh] = None
 
 
+def axis_size(axis_name: str) -> int:
+    """Size of a mesh axis, callable inside ``shard_map``/``pmap``."""
+    return jax.lax.psum(1, axis_name)
+
+
 def make_mesh(
     axes: Optional[Mapping[str, int]] = None,
     devices: Optional[Sequence[jax.Device]] = None,

@@ -22,18 +22,17 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 
-
-def _axis_size(axis_name: str) -> int:
-    return jax.lax.psum(1, axis_name)
+from .mesh import axis_size
 
 
 def switch_aux_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
     """Load-balancing loss (Switch Transformer eq. 4): E * sum_e
     fraction_of_tokens(e) * mean_router_prob(e). Minimised at uniform
-    routing, where it equals 1."""
+    routing, where it equals 1. Accumulated in float32: a bf16 mean over
+    many tokens would round the fractions."""
     num_experts = probs.shape[-1]
-    fraction = expert_mask.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
+    fraction = expert_mask.astype(jnp.float32).mean(axis=0)
+    mean_prob = probs.astype(jnp.float32).mean(axis=0)
     return num_experts * jnp.sum(fraction * mean_prob)
 
 
@@ -55,7 +54,7 @@ def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
     outside), ``aux_loss`` the local Switch balancing loss (pmean it with
     the data loss).
     """
-    num_experts = _axis_size(axis_name)
+    num_experts = axis_size(axis_name)
     tokens, d_model = x.shape
     capacity = int(-(-tokens * capacity_factor // num_experts))
     capacity = max(capacity, num_selected)
@@ -75,20 +74,24 @@ def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
         masked = jnp.where(avail > 0, probs, -jnp.inf)
         choice = jnp.argmax(masked, axis=-1)              # [T]
         gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
-        onehot = jax.nn.one_hot(choice, num_experts, dtype=x.dtype)  # [T, E]
+        # Slot index math stays in int32 regardless of x.dtype: a bf16
+        # cumsum cannot represent token counts past 256 and would silently
+        # collide slots. Only the finished 0/1 masks are cast to x.dtype.
+        onehot_i = jax.nn.one_hot(choice, num_experts,
+                                  dtype=jnp.int32)        # [T, E]
         # Slot index of each token within its chosen expert, continuing
         # after slots used by earlier rounds.
-        pos = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]  # [T, E]
-        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        pos = jnp.cumsum(onehot_i, axis=0) - 1 + fill[None, :]  # [T, E]
+        pos_tok = jnp.sum(pos * onehot_i, axis=-1)        # [T]
         keep = pos_tok < capacity
         slot = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
                               capacity, dtype=x.dtype)      # [T, C]
+        onehot = onehot_i.astype(x.dtype)
         d = onehot[:, :, None] * slot[:, None, :] \
             * keep[:, None, None].astype(x.dtype)
         dispatch = dispatch + d
         combine = combine + d * gate[:, None, None]
-        fill = fill + jnp.sum(onehot * keep[:, None].astype(x.dtype),
-                              axis=0).astype(jnp.int32)
+        fill = fill + jnp.sum(onehot_i * keep[:, None], axis=0)
         avail = avail * (1.0 - onehot)
         total_mask = total_mask + onehot
         gate_sum = gate_sum + gate

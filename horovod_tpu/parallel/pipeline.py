@@ -37,9 +37,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
-
-def _axis_size(axis_name: str) -> int:
-    return jax.lax.psum(1, axis_name)
+from .mesh import axis_size
 
 
 def stack_stage_params(params_list):
@@ -62,7 +60,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     by ``lax.axis_index(axis_name) == S-1`` before use.
     """
     idx = jax.lax.axis_index(axis_name)
-    n_stages = _axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     num_mb = microbatches.shape[0]
 
     # shard_map hands each device its [1, ...] slice of the stacked params.
@@ -98,7 +96,7 @@ def collect_from_last_stage(y: jax.Array,
     the garbage elsewhere — handy when the pipeline output itself (not just
     a loss) must leave the ``shard_map`` replicated over the pipe axis."""
     idx = jax.lax.axis_index(axis_name)
-    n_stages = _axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     return jax.lax.psum(jnp.where(idx == n_stages - 1, y, 0), axis_name)
 
 
@@ -108,6 +106,6 @@ def pipeline_loss(per_mb_loss: jax.Array, axis_name: str = "pipe") -> jax.Array:
     share it with every stage (so the loss — and its gradients — are
     consistent across the pipe axis)."""
     idx = jax.lax.axis_index(axis_name)
-    n_stages = _axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     masked = jnp.where(idx == n_stages - 1, per_mb_loss.mean(), 0.0)
     return jax.lax.psum(masked, axis_name)

@@ -101,6 +101,25 @@ def test_imagenet_resnet50_checkpoint_resume(tmp_path):
     assert "resumed" in out and "ckpt_2" in out
 
 
+def test_moe_example_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_moe_training.py"),
+                "--steps", "15", "--tokens-per-device", "128",
+                "--d-model", "16", "--d-hidden", "32"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=4"})
+    assert "tokens/sec through" in out
+
+
+def test_pipeline_example_smoke():
+    out = _run([sys.executable,
+                os.path.join(EX, "jax_pipeline_parallel.py"),
+                "--steps", "10", "--microbatches", "8",
+                "--microbatch-size", "4", "--features", "32"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=4"})
+    assert "samples/sec through" in out
+
+
 def test_scaling_efficiency_smoke():
     out = _run([sys.executable, os.path.join(EX, "scaling_efficiency.py"),
                 "--model", "mlp", "--steps", "3", "--warmup", "1",

@@ -106,6 +106,43 @@ def test_moe_capacity_drops_tokens():
         assert nonzero[0]  # slot-filling keeps the earliest token
 
 
+def test_moe_bf16_routing_matches_f32_many_tokens():
+    """Slot arithmetic must stay exact in bf16: with >256 tokens routed to
+    one expert a bf16 cumsum would collide slots and silently drop tokens."""
+    rng = np.random.RandomState(5)
+    T_big = 512
+    params = {
+        "w": jnp.asarray(rng.randn(E, D, D) * 0.5, jnp.float32),
+        "scale": jnp.asarray(1.0 + rng.rand(E, 1), jnp.float32),
+    }
+    x = rng.randn(E, T_big, D).astype(np.float32)
+    # Everything routed to expert 0; ample capacity -> zero drops expected.
+    logits = np.zeros((E, T_big, E), np.float32)
+    logits[:, :, 0] = 10.0
+    mesh = make_mesh({"expert": E}, devices=jax.devices()[:E])
+
+    def run(dtype):
+        def body(p, xx, gg):
+            y, _ = moe_apply(expert_fn, p, xx[0], gg[0],
+                             axis_name="expert", capacity_factor=float(E))
+            return y[None]
+
+        f = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("expert"), P("expert"), P("expert")),
+            out_specs=P("expert"), check_vma=False))
+        return np.asarray(f(params, jnp.asarray(x, dtype),
+                            jnp.asarray(logits, dtype)), np.float32)
+
+    y16, y32 = run(jnp.bfloat16), run(jnp.float32)
+    # No token may be zeroed (dropped) in bf16 when f32 keeps it.
+    dropped16 = np.abs(y16).sum(axis=-1) < 1e-9
+    dropped32 = np.abs(y32).sum(axis=-1) < 1e-9
+    assert not dropped32.any()
+    assert not dropped16.any(), f"{dropped16.sum()} tokens dropped in bf16"
+    np.testing.assert_allclose(y16, y32, atol=0.05)
+
+
 def test_moe_aux_loss_uniform_vs_skewed():
     params, x, logits = _setup(seed=3)
     _, aux_uniform = _run_moe(params, x, jnp.zeros_like(logits), k=1,

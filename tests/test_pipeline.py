@@ -116,8 +116,9 @@ def test_pipeline_loss_masks_non_last_stages():
 
 
 def test_pipeline_trains_end_to_end():
-    """A dp x pp training step with hvd.DistributedOptimizer converges on a
-    tiny regression — the integration the dryrun exercises."""
+    """A dp x pp training step (optax optimizer, grads via the shard_map
+    transpose) converges on a tiny regression — the integration the dryrun
+    exercises."""
     import optax
 
     hvd.init()
