@@ -18,6 +18,11 @@ from .llama import (  # noqa: F401
     causal_lm_loss,
 )
 from .inception import InceptionV3  # noqa: F401
+from .moe_lm import (  # noqa: F401
+    MOE_TINY,
+    MoeConfig,
+    MoeLM,
+)
 from .mlp import MnistMLP  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet,

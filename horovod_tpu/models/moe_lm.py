@@ -1,0 +1,175 @@
+"""Mixture-of-experts transformer LM — the expert-parallel model family.
+
+No reference counterpart (the reference is 2019 CNN-era data parallelism,
+SURVEY.md §2.3); this extends the Llama-style LM (``models/llama.py``) with
+a Switch/GShard MoE feed-forward on every other layer, wired to the
+expert-parallel substrate (``parallel/moe.py``):
+
+- ``expert_axis=None`` (default): every expert is resident and dispatch
+  runs densely under ``vmap`` (``moe_apply_dense``) — single-chip runs,
+  tests, eval.
+- ``expert_axis="expert"`` inside ``shard_map``: expert parameters are
+  sharded one-per-device along that mesh axis and token dispatch rides
+  ``all_to_all`` over ICI (``moe_apply``). The routing (and therefore the
+  numerics) is identical in both modes.
+
+The MLM/causal losses and non-MoE machinery are shared with the Llama
+family. Aux (load-balancing) losses from every MoE layer are summed into
+the ``"aux_loss"`` collection — fold ``sum(aux) * aux_weight`` into the
+objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.moe import moe_apply, moe_apply_dense
+from .llama import (  # noqa: F401
+    LlamaAttention,
+    LlamaBlock,
+    LlamaConfig,
+    RMSNorm,
+    causal_lm_loss,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    dim: int = 2048
+    num_layers: int = 16
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    ffn_hidden: int = 5632
+    num_experts: int = 8
+    expert_hidden: int = 5632
+    num_selected: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2           # every moe_every-th layer gets an MoE FFN
+    norm_eps: float = 1e-5
+    rope_theta: float = 1e4
+    dtype: Any = jnp.bfloat16
+
+    def llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, dim=self.dim,
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, ffn_hidden=self.ffn_hidden,
+            norm_eps=self.norm_eps, rope_theta=self.rope_theta,
+            dtype=self.dtype)
+
+
+MOE_TINY = MoeConfig(vocab_size=512, dim=64, num_layers=2, num_heads=4,
+                     num_kv_heads=2, ffn_hidden=128, num_experts=4,
+                     expert_hidden=128, moe_every=2)
+
+
+class MoeFFN(nn.Module):
+    """Top-k routed feed-forward: gate -> dispatch -> per-expert gated MLP
+    -> combine. Expert weights carry a leading expert axis: the GLOBAL
+    expert count in dense mode, the LOCAL count (one per device) under
+    ``shard_map`` — flax validates declared param shapes at apply time, so
+    the sharded mode must declare the slice it will actually receive."""
+
+    config: MoeConfig
+    expert_axis: Optional[str] = None
+    local_experts: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, d = x.shape
+        tokens = x.reshape(b * s, d)
+
+        gate_w = self.param("gate", nn.initializers.normal(0.02),
+                            (d, cfg.num_experts), jnp.float32)
+        # Router in float32 (Switch: routing is precision-sensitive).
+        logits = tokens.astype(jnp.float32) @ gate_w
+
+        n_param = (self.local_experts
+                   if self.expert_axis is not None and self.local_experts
+                   else cfg.num_experts)
+        experts = {
+            "wi": self.param(
+                "wi", nn.initializers.lecun_normal(),
+                (n_param, d, cfg.expert_hidden), jnp.float32),
+            "wo": self.param(
+                "wo", nn.initializers.lecun_normal(),
+                (n_param, cfg.expert_hidden, d), jnp.float32),
+        }
+
+        def expert_fn(p, t):
+            h = nn.silu(t @ p["wi"].astype(cfg.dtype))
+            return h @ p["wo"].astype(cfg.dtype)
+
+        kwargs = dict(capacity_factor=cfg.capacity_factor,
+                      num_selected=cfg.num_selected)
+        if self.expert_axis is None:
+            y, aux = moe_apply_dense(expert_fn, experts,
+                                     tokens.astype(cfg.dtype),
+                                     logits, **kwargs)
+        else:
+            y, aux = moe_apply(expert_fn, experts,
+                               tokens.astype(cfg.dtype), logits,
+                               axis_name=self.expert_axis, **kwargs)
+        self.sow("aux_loss", "moe", aux)
+        return y.reshape(b, s, d)
+
+
+class MoeBlock(nn.Module):
+    """Transformer block with a routed FFN (dense layers reuse
+    ``LlamaBlock`` directly — see ``MoeLM``)."""
+
+    config: MoeConfig
+    expert_axis: Optional[str] = None
+    local_experts: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x + LlamaAttention(cfg.llama(), name="attention")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x))
+        h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
+        return x + MoeFFN(cfg, expert_axis=self.expert_axis,
+                          local_experts=self.local_experts,
+                          name="moe_ffn")(h)
+
+
+class MoeLM(nn.Module):
+    """Causal MoE LM. Apply with ``{"params": params}`` (not the full init
+    variables — a stale ``aux_loss`` collection would double-count) and
+    ``mutable=["aux_loss"]`` to collect the per-layer balancing losses:
+
+        logits, col = model.apply({"params": p}, ids, mutable=["aux_loss"])
+        aux = sum(jax.tree.leaves(col["aux_loss"]))
+
+    For expert parallelism set ``expert_axis`` to the mesh axis and
+    ``local_experts=1`` (the one-expert-per-device contract), shard the
+    ``wi``/``wo`` leaves over that axis, and apply inside ``shard_map``.
+    """
+
+    config: MoeConfig
+    expert_axis: Optional[str] = None
+    local_experts: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
+                     name="tok_embeddings")(input_ids).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            # Every moe_every-th layer is routed (moe_every=1: all layers);
+            # the rest are plain LlamaBlocks (shared implementation).
+            if i % cfg.moe_every == cfg.moe_every - 1:
+                x = MoeBlock(cfg, expert_axis=self.expert_axis,
+                             local_experts=self.local_experts,
+                             name=f"layer_{i}")(x)
+            else:
+                x = LlamaBlock(cfg.llama(), name=f"layer_{i}")(x)
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="lm_head")(x)

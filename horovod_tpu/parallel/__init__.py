@@ -5,7 +5,7 @@ The reference implements data parallelism only (SURVEY.md §2.3); the mesh
 utilities here are its substrate plus the axes future strategies hang off."""
 
 from . import hierarchical, moe, pipeline, sequence  # noqa: F401
-from .moe import moe_apply, switch_aux_loss  # noqa: F401
+from .moe import moe_apply, moe_apply_dense, switch_aux_loss  # noqa: F401
 from .hierarchical import (  # noqa: F401
     hierarchical_allgather,
     hierarchical_allreduce,

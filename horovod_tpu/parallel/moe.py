@@ -36,6 +36,66 @@ def switch_aux_loss(probs: jax.Array, expert_mask: jax.Array) -> jax.Array:
     return num_experts * jnp.sum(fraction * mean_prob)
 
 
+def _dispatch_masks(probs: jax.Array, capacity: int, num_selected: int,
+                    normalize_gates: bool,
+                    dtype) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing with capacity dropping, shared by the distributed and
+    dense paths. Returns ``(dispatch, combine, aux)`` with masks of shape
+    ``[T, E, C]``."""
+    tokens, num_experts = probs.shape
+    # Top-k routing: k rounds of argmax with already-chosen experts masked
+    # out, accumulating one dispatch/combine mask pair.
+    dispatch = jnp.zeros((tokens, num_experts, capacity), dtype)
+    combine = jnp.zeros((tokens, num_experts, capacity), dtype)
+    avail = jnp.ones_like(probs)          # experts still choosable per token
+    # Tokens already assigned per expert (fills capacity slots in order).
+    fill = jnp.zeros((num_experts,), jnp.int32)
+    total_mask = jnp.zeros_like(probs)
+    gate_sum = jnp.zeros((tokens,), dtype)
+    for _ in range(num_selected):
+        masked = jnp.where(avail > 0, probs, -jnp.inf)
+        choice = jnp.argmax(masked, axis=-1)              # [T]
+        # Routing decisions come from f32 probs; the combine weights drop to
+        # the activation dtype so y doesn't silently promote bf16 streams.
+        gate = jnp.take_along_axis(
+            probs, choice[:, None], axis=-1)[:, 0].astype(dtype)
+        # Slot index math stays in int32 regardless of x.dtype: a bf16
+        # cumsum cannot represent token counts past 256 and would silently
+        # collide slots. Only the finished 0/1 masks are cast to x.dtype.
+        onehot_i = jax.nn.one_hot(choice, num_experts,
+                                  dtype=jnp.int32)        # [T, E]
+        # Slot index of each token within its chosen expert, continuing
+        # after slots used by earlier rounds.
+        pos = jnp.cumsum(onehot_i, axis=0) - 1 + fill[None, :]  # [T, E]
+        pos_tok = jnp.sum(pos * onehot_i, axis=-1)        # [T]
+        keep = pos_tok < capacity
+        slot = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
+                              capacity, dtype=dtype)        # [T, C]
+        onehot = onehot_i.astype(dtype)
+        d = onehot[:, :, None] * slot[:, None, :] \
+            * keep[:, None, None].astype(dtype)
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        fill = fill + jnp.sum(onehot_i * keep[:, None], axis=0)
+        avail = avail * (1.0 - onehot)
+        total_mask = total_mask + onehot
+        gate_sum = gate_sum + gate
+
+    if normalize_gates and num_selected > 1:
+        # GShard convention: the selected gates are renormalised to sum to 1
+        # per token (dropped or not).
+        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
+
+    aux = switch_aux_loss(probs, total_mask / num_selected)
+    return dispatch, combine, aux
+
+
+def _capacity(tokens: int, num_experts: int, capacity_factor: float,
+              num_selected: int) -> int:
+    return max(int(-(-tokens * capacity_factor // num_experts)),
+               num_selected)
+
+
 def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
               expert_params: Any,
               x: jax.Array,
@@ -56,52 +116,11 @@ def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
     """
     num_experts = axis_size(axis_name)
     tokens, d_model = x.shape
-    capacity = int(-(-tokens * capacity_factor // num_experts))
-    capacity = max(capacity, num_selected)
+    capacity = _capacity(tokens, num_experts, capacity_factor, num_selected)
 
     probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, E]
-
-    # Top-k routing: k rounds of argmax with already-chosen experts masked
-    # out, accumulating one dispatch/combine mask pair.
-    dispatch = jnp.zeros((tokens, num_experts, capacity), x.dtype)
-    combine = jnp.zeros((tokens, num_experts, capacity), x.dtype)
-    avail = jnp.ones_like(probs)          # experts still choosable per token
-    # Tokens already assigned per expert (fills capacity slots in order).
-    fill = jnp.zeros((num_experts,), jnp.int32)
-    total_mask = jnp.zeros_like(probs)
-    gate_sum = jnp.zeros((tokens,), x.dtype)
-    for _ in range(num_selected):
-        masked = jnp.where(avail > 0, probs, -jnp.inf)
-        choice = jnp.argmax(masked, axis=-1)              # [T]
-        gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
-        # Slot index math stays in int32 regardless of x.dtype: a bf16
-        # cumsum cannot represent token counts past 256 and would silently
-        # collide slots. Only the finished 0/1 masks are cast to x.dtype.
-        onehot_i = jax.nn.one_hot(choice, num_experts,
-                                  dtype=jnp.int32)        # [T, E]
-        # Slot index of each token within its chosen expert, continuing
-        # after slots used by earlier rounds.
-        pos = jnp.cumsum(onehot_i, axis=0) - 1 + fill[None, :]  # [T, E]
-        pos_tok = jnp.sum(pos * onehot_i, axis=-1)        # [T]
-        keep = pos_tok < capacity
-        slot = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
-                              capacity, dtype=x.dtype)      # [T, C]
-        onehot = onehot_i.astype(x.dtype)
-        d = onehot[:, :, None] * slot[:, None, :] \
-            * keep[:, None, None].astype(x.dtype)
-        dispatch = dispatch + d
-        combine = combine + d * gate[:, None, None]
-        fill = fill + jnp.sum(onehot_i * keep[:, None], axis=0)
-        avail = avail * (1.0 - onehot)
-        total_mask = total_mask + onehot
-        gate_sum = gate_sum + gate
-
-    if normalize_gates and num_selected > 1:
-        # GShard convention: the selected gates are renormalised to sum to 1
-        # per token (dropped or not).
-        combine = combine / jnp.maximum(gate_sum, 1e-9)[:, None, None]
-
-    aux = switch_aux_loss(probs, total_mask / num_selected)
+    dispatch, combine, aux = _dispatch_masks(
+        probs, capacity, num_selected, normalize_gates, x.dtype)
 
     # [T, E, C] x [T, D] -> [E, C, D]; all-to-all so each device receives
     # its expert's buffer from every peer: [E_src, C, D].
@@ -115,5 +134,32 @@ def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
     expert_out = expert_out.reshape(num_experts, capacity, -1)
     expert_out = jax.lax.all_to_all(expert_out, axis_name,
                                     split_axis=0, concat_axis=0)
+    y = jnp.einsum("ecd,tec->td", expert_out, combine)
+    return y, aux
+
+
+def moe_apply_dense(expert_fn: Callable[[Any, jax.Array], jax.Array],
+                    stacked_params: Any,
+                    x: jax.Array,
+                    gate_logits: jax.Array,
+                    capacity_factor: float = 1.25,
+                    num_selected: int = 1,
+                    normalize_gates: bool = True
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Single-device twin of :func:`moe_apply`: identical routing (same
+    masks, same capacity drops), but every expert is resident — the expert
+    dimension runs under ``vmap`` instead of ``all_to_all``. Use it outside
+    ``shard_map`` (tests, single-chip runs, reference numerics)."""
+    leaves = jax.tree.leaves(stacked_params)
+    num_experts = leaves[0].shape[0]
+    tokens, _ = x.shape
+    capacity = _capacity(tokens, num_experts, capacity_factor, num_selected)
+
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    dispatch, combine, aux = _dispatch_masks(
+        probs, capacity, num_selected, normalize_gates, x.dtype)
+
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)     # [E, C, D]
+    expert_out = jax.vmap(expert_fn)(stacked_params, expert_in)
     y = jnp.einsum("ecd,tec->td", expert_out, combine)
     return y, aux
