@@ -18,10 +18,12 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
-from horovod_tpu.models import LLAMA_1B, LLAMA_8B, LLAMA_TINY, LlamaLM, causal_lm_loss
+from horovod_tpu.models import (LLAMA_1B, LLAMA_8B, LLAMA_300M, LLAMA_TINY,
+                                LlamaLM, causal_lm_loss)
 from horovod_tpu.ops.attention import make_attention_fn
 
-CONFIGS = {"tiny": LLAMA_TINY, "1b": LLAMA_1B, "8b": LLAMA_8B}
+CONFIGS = {"tiny": LLAMA_TINY, "300m": LLAMA_300M,
+           "1b": LLAMA_1B, "8b": LLAMA_8B}
 
 
 def main():

@@ -11,6 +11,7 @@ from .bert import (  # noqa: F401
 )
 from .llama import (  # noqa: F401
     LLAMA_1B,
+    LLAMA_300M,
     LLAMA_8B,
     LLAMA_TINY,
     LlamaConfig,

@@ -36,6 +36,10 @@ class LlamaConfig:
 LLAMA_8B = LlamaConfig()
 LLAMA_1B = LlamaConfig(dim=2048, num_layers=16, num_heads=32, num_kv_heads=8,
                        ffn_hidden=8192)
+# ~320M params: fits one 16 GB chip WITH f32 Adam state — the single-chip
+# benchmark config (1B+ needs sharded optimizer state across chips).
+LLAMA_300M = LlamaConfig(vocab_size=32000, dim=1024, num_layers=16,
+                         num_heads=16, num_kv_heads=8, ffn_hidden=4096)
 LLAMA_TINY = LlamaConfig(vocab_size=512, dim=64, num_layers=2, num_heads=4,
                          num_kv_heads=2, ffn_hidden=128, max_seq_len=256)
 
