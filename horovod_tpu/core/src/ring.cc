@@ -386,6 +386,13 @@ int ring_init(Ring& ring, int rank, int size, const char* addrs_cstr,
   ring.listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(ring.listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  // Request large buffers BEFORE listen/connect: the TCP window-scale
+  // factor is fixed at the handshake, and accepted sockets inherit the
+  // listener's options. The kernel clamps to net.core.{r,w}mem_max —
+  // raise those sysctls for the full 8 MiB on high-BDP links.
+  int bufsz = 8 << 20;
+  setsockopt(ring.listen_fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+  setsockopt(ring.listen_fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
   struct sockaddr_in sa;
   std::memset(&sa, 0, sizeof(sa));
   sa.sin_family = AF_INET;
@@ -430,6 +437,8 @@ int ring_init(Ring& ring, int rank, int size, const char* addrs_cstr,
                   std::chrono::seconds(start_timeout_s);
   while (true) {
     ring.right_fd = socket(AF_INET, SOCK_STREAM, 0);
+    setsockopt(ring.right_fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof(bufsz));
+    setsockopt(ring.right_fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof(bufsz));
     if (connect(ring.right_fd, res->ai_addr, res->ai_addrlen) == 0) break;
     close(ring.right_fd);
     ring.right_fd = -1;
