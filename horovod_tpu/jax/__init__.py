@@ -24,11 +24,13 @@ import optax
 
 from ..common import basics
 from ..compression import Compression
+from .zero import zero_sharded_optimizer  # noqa: F401
 from ..ops import collective_ops as C
 
 __all__ = [
     "DistributedOptimizer",
     "distributed_value_and_grad",
+    "zero_sharded_optimizer",
     "broadcast_parameters",
     "broadcast_optimizer_state",
 ]

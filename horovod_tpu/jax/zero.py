@@ -1,0 +1,126 @@
+"""ZeRO-1 sharded optimizer state over a mesh axis (TPU extension).
+
+The reference replicates optimizer state on every worker (its
+``DistributedOptimizer`` only averages gradients). On TPU the optimizer
+state of a large model (f32 Adam moments = 8 bytes/param) often dominates
+HBM, so this wrapper shards it across the data axis, ZeRO stage-1 style
+(Rajbhandari et al. 2020), entirely inside the compiled step:
+
+1. gradients are ``psum_scatter``'d over ``axis_name`` — each device gets
+   the fully-reduced 1/N slice (same bytes on ICI as a ring allreduce's
+   reduce-scatter half),
+2. the wrapped optax optimizer updates only that slice (state lives
+   sliced: N x less HBM for moments),
+3. the parameter *updates* are ``all_gather``'d back so every device
+   applies identical full updates.
+
+Use inside ``shard_map``/``pmap`` with replicated params::
+
+    tx = zero_sharded_optimizer(optax.adamw(1e-4), axis_name="data")
+    # in the step fn (inside shard_map):
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+
+Numerics match the unsharded optimizer exactly for elementwise
+transformations (Adam/AdamW/SGD/momentum/...): every moment entry sees
+the same gradient sequence, just on one device instead of all. Global
+norms (clipping) would need a psum — compose those BEFORE this wrapper.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..parallel.mesh import axis_size as _axis_size
+
+
+def _pad_len(n: int, world: int) -> int:
+    return (world - n % world) % world
+
+
+def _shard_leaf(x: jax.Array, idx, world: int) -> jax.Array:
+    """This device's 1/N slice of a (replicated) leaf, zero-padded so every
+    slice is equal-sized."""
+    flat = x.reshape(-1)
+    flat = jnp.pad(flat, (0, _pad_len(flat.size, world)))
+    return jax.lax.dynamic_slice_in_dim(
+        flat, idx * (flat.size // world), flat.size // world)
+
+
+def _scatter_grad(g: jax.Array, axis_name: str, world: int,
+                  average: bool) -> jax.Array:
+    """Reduce+scatter a gradient leaf: returns the fully-reduced local
+    slice (flat)."""
+    flat = g.reshape(-1)
+    flat = jnp.pad(flat, (0, _pad_len(flat.size, world)))
+    out = jax.lax.psum_scatter(flat.reshape(world, -1), axis_name,
+                               scatter_dimension=0, tiled=False)
+    if average:
+        out = out / world
+    return out
+
+
+def _gather_updates(u: jax.Array, axis_name: str, shape, size: int
+                    ) -> jax.Array:
+    """All-gather update slices back to the full leaf shape."""
+    full = jax.lax.all_gather(u, axis_name, axis=0, tiled=False).reshape(-1)
+    return full[:size].reshape(shape)
+
+
+def zero_state_specs(optimizer: optax.GradientTransformation, params,
+                     axis_name: str, num_shards: int):
+    """``shard_map`` PartitionSpecs for the sharded state: leaves derived
+    from the (sliced) params are per-device slices sharded over
+    ``axis_name``; true scalar leaves (step counts, schedules) stay
+    replicated. ``optimizer`` is the INNER (not yet wrapped)
+    transformation; ``params`` the full replicated params; ``num_shards``
+    the size of ``axis_name``. The abstract state is evaluated on the
+    SLICED param shapes so moments of scalar params (shape ``(1,)`` per
+    device) classify as sharded, exactly mirroring ``init_fn``."""
+    from jax.sharding import PartitionSpec
+
+    def sliced(p):
+        n = int(p.size)
+        return jax.ShapeDtypeStruct(
+            ((n + _pad_len(n, num_shards)) // num_shards,), p.dtype)
+
+    abstract = jax.eval_shape(optimizer.init, jax.tree.map(sliced, params))
+    return jax.tree.map(
+        lambda leaf: PartitionSpec(axis_name) if leaf.ndim
+        else PartitionSpec(), abstract)
+
+
+def zero_sharded_optimizer(
+    optimizer: optax.GradientTransformation,
+    axis_name: str,
+    average: bool = True,
+) -> optax.GradientTransformation:
+    """Wrap ``optimizer`` so its state is sharded 1/N over ``axis_name``
+    (ZeRO-1). Must run inside ``shard_map``/``pmap``; params replicated
+    over the axis. ``init`` and ``update`` must both run in that context
+    (state leaves are per-device slices)."""
+
+    def init_fn(params):
+        idx = jax.lax.axis_index(axis_name)
+        world = _axis_size(axis_name)
+        sliced = jax.tree.map(lambda p: _shard_leaf(p, idx, world), params)
+        return optimizer.init(sliced)
+
+    def update_fn(updates, state, params=None, **extra):
+        idx = jax.lax.axis_index(axis_name)
+        world = _axis_size(axis_name)
+        g_slices = jax.tree.map(
+            lambda g: _scatter_grad(g, axis_name, world, average), updates)
+        p_slices = None if params is None else jax.tree.map(
+            lambda p: _shard_leaf(p, idx, world), params)
+        u_slices, state = optimizer.update(g_slices, state, p_slices,
+                                           **extra)
+        # The original gradient leaves carry the static shapes to restore.
+        full = jax.tree.map(
+            lambda u, g: _gather_updates(u, axis_name, g.shape, g.size),
+            u_slices, updates)
+        return full, state
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,165 @@
+"""ZeRO-1 sharded optimizer state (jax/zero.py): the sharded wrapper must
+reproduce the unsharded optimizer's trajectory exactly while holding only
+1/N of the moment entries per device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.jax import zero_sharded_optimizer
+from horovod_tpu.jax.zero import zero_state_specs
+from horovod_tpu.parallel import make_mesh
+
+N_DEV = 8
+FEATURES = 13  # deliberately not divisible by 8: exercises padding
+
+
+def _setup():
+    rng = np.random.RandomState(0)
+    params = {
+        "w": jnp.asarray(rng.randn(FEATURES, 4), jnp.float32),
+        "b": jnp.asarray(rng.randn(4), jnp.float32),  # 4 < 8 devices
+    }
+    x = jnp.asarray(rng.randn(N_DEV * 8, FEATURES), jnp.float32)
+    y = jnp.asarray(rng.randn(N_DEV * 8, 4), jnp.float32)
+    return params, x, y
+
+
+def _loss(p, xb, yb):
+    return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+
+def _train(params, x, y, tx_factory, inner_factory, steps=25):
+    mesh = make_mesh({"data": N_DEV})
+    tx = tx_factory()
+    # Array state leaves are per-device slices; scalar leaves (Adam count)
+    # stay replicated.
+    state_specs = zero_state_specs(inner_factory(), params, "data", N_DEV)
+
+    def body(p, state, xb, yb):
+        loss, grads = jax.value_and_grad(_loss)(p, xb, yb)
+        # Per-shard grads; the wrapper (or explicit pmean) does the
+        # cross-device reduction.
+        updates, state = tx.update(grads, state, p)
+        return optax.apply_updates(p, updates), state, \
+            jax.lax.pmean(loss, "data")
+
+    init = jax.jit(jax.shard_map(
+        lambda p: tx.init(p), mesh=mesh, in_specs=P(),
+        out_specs=state_specs, check_vma=False))
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), state_specs, P("data"), P("data")),
+        out_specs=(P(), state_specs, P()), check_vma=False))
+
+    state = init(params)
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, x, y)
+        losses.append(float(loss))
+    return params, state, losses
+
+
+def _train_reference(params, x, y, steps=25):
+    """Unsharded reference: full-batch mean gradient, plain optimizer."""
+    tx = optax.adam(1e-2)
+    state = tx.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, grads = jax.value_and_grad(_loss)(p, x, y)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state)
+        losses.append(float(loss))
+    return params, losses
+
+
+def test_zero_matches_unsharded_adam():
+    hvd.init()
+    params, x, y = _setup()
+    sharded_params, _, sharded_losses = _train(
+        params, x, y,
+        lambda: zero_sharded_optimizer(optax.adam(1e-2), axis_name="data"),
+        lambda: optax.adam(1e-2))
+    ref_params, ref_losses = _train_reference(params, x, y)
+    np.testing.assert_allclose(sharded_losses, ref_losses, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(sharded_params),
+                    jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-6)
+    hvd.shutdown()
+
+
+def test_zero_state_is_sharded():
+    hvd.init()
+    params, x, y = _setup()
+    _, state, _ = _train(
+        params, x, y,
+        lambda: zero_sharded_optimizer(optax.adam(1e-2), axis_name="data"),
+        lambda: optax.adam(1e-2), steps=1)
+    # Adam mu leaf for "w": full size 13*4=52 -> padded 56 -> 7 per device,
+    # global (out_specs P("data")) = 8 * 7 = 56 entries.
+    mu = state[0].mu
+    assert mu["w"].size == 56
+    assert mu["b"].size == 8  # 4 padded to 8, 1 per device
+    hvd.shutdown()
+
+
+def test_zero_momentum_sgd_matches():
+    hvd.init()
+    params, x, y = _setup()
+
+    def factory():
+        return zero_sharded_optimizer(
+            optax.sgd(1e-2, momentum=0.9), axis_name="data")
+
+    _, _, losses = _train(params, x, y, factory,
+                          lambda: optax.sgd(1e-2, momentum=0.9))
+    tx = optax.sgd(1e-2, momentum=0.9)
+    state = tx.init(params)
+    p = params
+    ref_losses = []
+    for _ in range(25):
+        loss, grads = jax.value_and_grad(_loss)(p, x, y)
+        updates, state = tx.update(grads, state, p)
+        p = optax.apply_updates(p, updates)
+        ref_losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-5)
+    hvd.shutdown()
+
+
+def test_zero_scalar_param_leaf():
+    """Moments of a scalar param live as a (1,)-per-device sharded slice;
+    the spec helper must classify them as sharded, not replicated."""
+    hvd.init()
+    mesh = make_mesh({"data": N_DEV})
+    params = {"w": jnp.ones((4,)), "t": jnp.asarray(0.5)}  # scalar leaf
+    inner = optax.adam(1e-2)
+    tx = zero_sharded_optimizer(inner, axis_name="data")
+    specs = zero_state_specs(inner, params, "data", N_DEV)
+
+    init = jax.jit(jax.shard_map(tx.init, mesh=mesh, in_specs=P(),
+                                 out_specs=specs, check_vma=False))
+    state = init(params)
+    mu = state[0].mu
+    assert mu["t"].size == N_DEV  # scalar padded to one entry per device
+
+    def body(p, s):
+        g = jax.tree.map(jnp.ones_like, p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    step = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), specs), out_specs=(P(), specs),
+        check_vma=False))
+    p2, state = step(params, state)
+    # Every device applied the same full update to the scalar.
+    assert float(p2["t"]) != 0.5
+    hvd.shutdown()
