@@ -270,7 +270,8 @@ def test_bert_with_flash_attention():
     out_ref = model_ref.apply(variables, ids, deterministic=True)
 
     model_flash = BertEncoder(
-        cfg, attention_fn=make_attention_fn(block_q=16, block_k=16))
+        cfg, attention_fn=make_attention_fn(use_flash=True, block_q=16,
+                                       block_k=16))
     out_flash = model_flash.apply(variables, ids, deterministic=True)
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
                                atol=5e-2, rtol=5e-2)

@@ -60,7 +60,7 @@ def test_flash_attention_seam():
     variables = ref_model.init(jax.random.PRNGKey(0), ids)
     out_ref = ref_model.apply(variables, ids)
     flash_model = LlamaLM(cfg, attention_fn=make_attention_fn(
-        causal=True, block_q=16, block_k=16))
+        causal=True, use_flash=True, block_q=16, block_k=16))
     out_flash = flash_model.apply(variables, ids)
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_ref),
                                atol=5e-2, rtol=5e-2)
