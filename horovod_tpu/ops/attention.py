@@ -27,6 +27,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+FLASH_AUTO_MIN_SEQ = 512
+# v5e-tuned default inner tiles (see flash_attention docstring).
+FLASH_DEFAULT_BLOCK_Q = 256
+FLASH_DEFAULT_BLOCK_K = 2048
+
+
+def _auto_interpret() -> bool:
+    """Pallas interpreter mode off-TPU (hermetic CPU tests)."""
+    import jax as _jax
+    return _jax.default_backend() != "tpu"
+
+
 
 def reference_attention(q, k, v, key_mask=None, causal=False,
                         sm_scale: Optional[float] = None):
@@ -367,8 +379,10 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q, k, v, key_mask=None, causal: bool = False,
-                    sm_scale: Optional[float] = None, block_q: int = 256,
-                    block_k: int = 2048, interpret: Optional[bool] = None):
+                    sm_scale: Optional[float] = None,
+                    block_q: int = FLASH_DEFAULT_BLOCK_Q,
+                    block_k: int = FLASH_DEFAULT_BLOCK_K,
+                    interpret: Optional[bool] = None):
     """Flash attention forward. ``interpret=None`` auto-selects Pallas
     interpreter mode off-TPU (hermetic CPU tests run the same kernel).
 
@@ -376,7 +390,7 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     are VMEM-resident regardless of ``block_k``, so large inner tiles just
     cut ``fori_loop`` overhead; both are clamped to the sequence length."""
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = _auto_interpret()
     b, sk = k.shape[0], k.shape[1]
     maskf = (jnp.ones((b, sk), jnp.float32) if key_mask is None
              else key_mask.astype(jnp.float32))
@@ -384,11 +398,11 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
                   interpret)
 
 
-FLASH_AUTO_MIN_SEQ = 512
 
 
 def make_attention_fn(causal: bool = False, use_flash="auto",
-                      block_q: int = 256, block_k: int = 2048):
+                      block_q: int = FLASH_DEFAULT_BLOCK_Q,
+                      block_k: int = FLASH_DEFAULT_BLOCK_K):
     """Adapter for ``horovod_tpu.models.bert.SelfAttention(attention_fn=...)``
     — signature (q, k, v, mask) with mask of shape (B, Sk) or None.
 

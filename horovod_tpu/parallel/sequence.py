@@ -20,6 +20,7 @@ Both are shard_map-tier functions: call them inside
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
@@ -164,9 +165,59 @@ def _zigzag_causal_block(q, k, v, sm_scale, my_idx, src, key_mask):
                     lambda: lax.cond(src < my_idx, lt_case, gt_case))
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_block_pair(q, maskf, k_blk, v_blk, diag_causal, scale):
+    """(out, lse) of one ring block via the Pallas kernel. Forward only —
+    the backward recomputes the block densely (the lse output carries real
+    gradient through the cross-block merge, which the Pallas backward
+    kernels don't model; the dense block backward is exactly what the
+    non-flash ring differentiates anyway)."""
+    from ..ops.attention import (
+        FLASH_DEFAULT_BLOCK_K,
+        FLASH_DEFAULT_BLOCK_Q,
+        _auto_interpret,
+        _flash_forward,
+    )
+
+    return _flash_forward(q, k_blk, v_blk, maskf, diag_causal, scale,
+                          FLASH_DEFAULT_BLOCK_Q, FLASH_DEFAULT_BLOCK_K,
+                          _auto_interpret())
+
+
+def _flash_block_pair_dense(q, maskf, k_blk, v_blk, diag_causal, scale):
+    """Dense twin producing the identical (out, lse) pair — the backward
+    rule differentiates this."""
+    s = q.shape[1]
+    pos = jnp.arange(s)
+    a, m, l = _block_attend(q, k_blk, v_blk, scale, pos, pos, diag_causal,
+                            maskf)
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (a / l_safe).transpose(0, 2, 1, 3).astype(q.dtype)
+    lse = (m + jnp.log(l_safe))[..., 0]                   # (b, h, s)
+    bh, hh, sh = lse.shape
+    return o, lse.reshape(bh * hh, 1, sh)
+
+
+def _flash_block_pair_fwd(q, maskf, k_blk, v_blk, diag_causal, scale):
+    out = _flash_block_pair(q, maskf, k_blk, v_blk, diag_causal, scale)
+    return out, (q, maskf, k_blk, v_blk)
+
+
+def _flash_block_pair_bwd(diag_causal, scale, res, cts):
+    q, maskf, k_blk, v_blk = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _flash_block_pair_dense(
+            q_, maskf, k_, v_, diag_causal, scale), q, k_blk, v_blk)
+    dq, dk, dv = vjp(cts)
+    return dq, None, dk, dv
+
+
+_flash_block_pair.defvjp(_flash_block_pair_fwd, _flash_block_pair_bwd)
+
+
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
                    sm_scale: Optional[float] = None, key_mask=None,
-                   layout: str = "contiguous"):
+                   layout: str = "contiguous", use_flash="auto"):
     """Attention over a sequence sharded along ``axis_name``.
 
     Args (local shards, inside shard_map):
@@ -177,6 +228,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         balances causal work across devices, since with contiguous layout
         device N-1 computes every ring step while device 0 is fully masked
         after the first).
+      use_flash: run each ring block through the Pallas flash kernel
+        instead of materialising the (S_local x S_local) score matrix —
+        the per-block (out, lse) pair merges into the online softmax as
+        (acc=out, m=lse, l=1). "auto" (default) enables it for the
+        contiguous layout once S_local >= FLASH_AUTO_MIN_SEQ; the zigzag
+        layout always uses the dense half-block path.
     Returns: (B, S_local, H, D) — attention of local queries over the FULL
       global sequence, in the same layout as the inputs.
     """
@@ -189,6 +246,14 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     if layout == "zigzag" and s_local % 2:
         raise ValueError(
             f"zigzag layout needs an even local sequence (got {s_local})")
+    if use_flash == "auto":
+        from ..ops.attention import FLASH_AUTO_MIN_SEQ
+        use_flash = (layout == "contiguous"
+                     and s_local >= FLASH_AUTO_MIN_SEQ)
+    elif use_flash and layout != "contiguous":
+        raise ValueError(
+            "ring_attention flash inner kernel supports the contiguous "
+            "layout only")
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
@@ -199,7 +264,36 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
 
     q_pos = positions(my_idx)
 
+    def _empty_contrib():
+        return (jnp.zeros((b, hn, s_local, d), jnp.float32),
+                jnp.full((b, hn, s_local, 1), NEG_INF / 2, jnp.float32),
+                jnp.zeros((b, hn, s_local, 1), jnp.float32))
+
+    def flash_contrib(k_blk, v_blk, mask_blk, diag_causal):
+        """One ring block through the Pallas kernel: the (normalised out,
+        lse) pair is an online-softmax contribution with acc=out, m=lse,
+        l=1 (out_i carries weight exp(lse_i) in the cross-block merge)."""
+        if mask_blk is None:
+            mask_blk = jnp.ones((b, s_local), bool)
+        o, lse = _flash_block_pair(q, mask_blk, k_blk, v_blk, diag_causal,
+                                   scale)
+        a = o.transpose(0, 2, 1, 3).astype(jnp.float32)  # (b, hn, s, d)
+        bm = lse.reshape(b, hn, s_local)[..., None]      # -inf if fully masked
+        return a, bm, jnp.ones_like(bm)
+
     def contributions(k_blk, v_blk, mask_blk, src):
+        if use_flash:
+            if not causal:
+                return flash_contrib(k_blk, v_blk, mask_blk, False)
+            # Contiguous causal: past blocks attend fully, the diagonal
+            # block is standard intra-block causal, future blocks skip.
+            return lax.cond(
+                src < my_idx,
+                lambda: flash_contrib(k_blk, v_blk, mask_blk, False),
+                lambda: lax.cond(
+                    src == my_idx,
+                    lambda: flash_contrib(k_blk, v_blk, mask_blk, True),
+                    _empty_contrib))
         if causal and layout == "zigzag":
             # Only the allowed half-blocks are computed — balanced ~half a
             # dense block per device per step.
@@ -208,18 +302,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         if causal and layout == "contiguous":
             # Blocks entirely in the future are skipped, not masked: device
             # i computes i+1 of the N steps (zigzag balances this).
-            def empty():
-                return (jnp.zeros((b, hn, s_local, d), jnp.float32),
-                        jnp.full((b, hn, s_local, 1), NEG_INF / 2,
-                                 jnp.float32),
-                        jnp.zeros((b, hn, s_local, 1), jnp.float32))
-
             def compute():
                 a, bm, bl = _block_attend(q, k_blk, v_blk, scale, q_pos,
                                           positions(src), causal, mask_blk)
                 return a, bm, bl
 
-            return lax.cond(src <= my_idx, compute, empty)
+            return lax.cond(src <= my_idx, compute, _empty_contrib)
         a, bm, bl = _block_attend(q, k_blk, v_blk, scale, q_pos,
                                   positions(src), causal, mask_blk)
         return a, bm, bl
