@@ -2,8 +2,10 @@
 
 Demonstrates sequences sharded across chips: each chip holds S/N tokens and
 K/V blocks rotate over ICI (``horovod_tpu.parallel.sequence.ring_attention``).
-Per-chip memory stays O(S_local^2 -> S_local), so max context scales linearly
-with the mesh.
+From 512 local tokens each ring block runs through the Pallas flash kernel
+automatically — O(S_local) forward memory (the backward recomputes blocks
+densely, O(S_local^2) per block) — and max context scales linearly with
+the mesh.
 
     python examples/jax_long_context_ring_attention.py --seq-len 8192
 """

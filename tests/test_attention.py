@@ -354,3 +354,26 @@ def test_ring_attention_flash_zigzag_rejected():
             mesh=mesh,
             in_specs=(P(None, "seq"),) * 3,
             out_specs=P(None, "seq"), check_vma=False)(q, k, v)
+
+
+def test_ulysses_auto_flash_long_seq():
+    # From FLASH_AUTO_MIN_SEQ the resharded (full-sequence) attention takes
+    # the Pallas kernel path; pin it against the reference.
+    rng = np.random.RandomState(16)
+    b, s, h, d = 1, 512, 2, 16
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)) * 0.3
+    q, k, v = mk(), mk(), mk()
+    mesh = make_mesh({"seq": 2}, devices=jax.devices()[:2])
+    ref = reference_attention(q, k, v, causal=True)
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq",
+                                          causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=1e-3)
