@@ -236,7 +236,9 @@ class Controller:
         def post(out: np.ndarray, _ctx=ctx, _compression=compression):
             if _compression is not None:
                 out = np.asarray(_compression.decompress(out, _ctx))
-            if average:
+            if average and out.dtype != np.bool_:
+                # bool reduces as logical OR (MPI_LOR); "average" has no
+                # meaning there and must not promote to float.
                 out = out / size
             return wrap(out) if wrap is not None else out
 

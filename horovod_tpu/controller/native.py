@@ -189,7 +189,8 @@ class NativeController:
         if code is None:
             return NativeHandle.failed(RuntimeError(
                 f"dtype {array.dtype} is not supported by the native engine "
-                "(supported: float32/float64/int32/int64/uint8/float16/"
+                "(supported: float32/float64/int32/int64/uint8/int8/int16/"
+                "uint16/bool/float16/"
                 "bfloat16); set HOROVOD_ENGINE=python for arbitrary dtypes"))
         shape = (ctypes.c_longlong * max(array.ndim, 1))(*array.shape)
         h = self._lib.hvd_eng_enqueue(
@@ -220,7 +221,9 @@ class NativeController:
         def post(out, _ctx=ctx, _compression=compression):
             if _compression is not None:
                 out = np.asarray(_compression.decompress(out, _ctx))
-            if average:
+            if average and out.dtype != np.bool_:
+                # bool reduces as logical OR (MPI_LOR); "average" has no
+                # meaning there and must not promote to float.
                 out = out / size
             return wrap(out) if wrap is not None else out
 

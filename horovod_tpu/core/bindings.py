@@ -35,6 +35,10 @@ _DTYPE_CODES = {
     "uint8": 4,
     "float16": 5,
     "bfloat16": 6,
+    "int8": 7,
+    "int16": 8,
+    "uint16": 9,
+    "bool": 10,
 }
 _DTYPE_NAMES = {v: k for k, v in _DTYPE_CODES.items()}
 

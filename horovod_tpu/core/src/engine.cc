@@ -79,6 +79,10 @@ std::string dtype_name(uint8_t code) {
     case 4: return "uint8";
     case 5: return "float16";
     case 6: return "bfloat16";
+    case 7: return "int8";
+    case 8: return "int16";
+    case 9: return "uint16";
+    case 10: return "bool";
   }
   return "dtype#" + std::to_string((int)code);
 }
@@ -86,11 +90,12 @@ std::string dtype_name(uint8_t code) {
 namespace {
 
 size_t dtype_size(uint8_t dt) {
+  // Must mirror ring.cc's DType enum (codes are the ctypes ABI).
   switch (dt) {
-    case 0: case 2: return 4;
-    case 1: case 3: return 8;
-    case 4: return 1;
-    case 5: case 6: return 2;
+    case 0: case 2: return 4;               // f32, i32
+    case 1: case 3: return 8;               // f64, i64
+    case 4: case 7: case 10: return 1;      // u8, i8, bool
+    case 5: case 6: case 8: case 9: return 2;  // f16, bf16, i16, u16
   }
   return 0;
 }

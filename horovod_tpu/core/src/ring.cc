@@ -64,14 +64,18 @@ enum DType {
   DT_U8 = 4,
   DT_F16 = 5,
   DT_BF16 = 6,
+  DT_I8 = 7,
+  DT_I16 = 8,
+  DT_U16 = 9,
+  DT_BOOL = 10,  // reduced with logical OR (any), like MPI_LOR
 };
 
 size_t dtype_size(int dt) {
   switch (dt) {
     case DT_F32: case DT_I32: return 4;
     case DT_F64: case DT_I64: return 8;
-    case DT_U8: return 1;
-    case DT_F16: case DT_BF16: return 2;
+    case DT_U8: case DT_I8: case DT_BOOL: return 1;
+    case DT_F16: case DT_BF16: case DT_I16: case DT_U16: return 2;
   }
   return 0;
 }
@@ -167,6 +171,30 @@ void accumulate(void* dst, const void* src, long count, int dt) {
       uint8_t* d = (uint8_t*)dst;
       const uint8_t* s = (const uint8_t*)src;
       for (long i = 0; i < count; i++) d[i] = (uint8_t)(d[i] + s[i]);
+      break;
+    }
+    case DT_I8: {
+      int8_t* d = (int8_t*)dst;
+      const int8_t* s = (const int8_t*)src;
+      for (long i = 0; i < count; i++) d[i] = (int8_t)(d[i] + s[i]);
+      break;
+    }
+    case DT_I16: {
+      int16_t* d = (int16_t*)dst;
+      const int16_t* s = (const int16_t*)src;
+      for (long i = 0; i < count; i++) d[i] = (int16_t)(d[i] + s[i]);
+      break;
+    }
+    case DT_U16: {
+      uint16_t* d = (uint16_t*)dst;
+      const uint16_t* s = (const uint16_t*)src;
+      for (long i = 0; i < count; i++) d[i] = (uint16_t)(d[i] + s[i]);
+      break;
+    }
+    case DT_BOOL: {
+      uint8_t* d = (uint8_t*)dst;
+      const uint8_t* s = (const uint8_t*)src;
+      for (long i = 0; i < count; i++) d[i] = (uint8_t)(d[i] || s[i]);
       break;
     }
     case DT_F16: {

@@ -402,7 +402,8 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
 
 def make_attention_fn(causal: bool = False, use_flash="auto",
                       block_q: int = FLASH_DEFAULT_BLOCK_Q,
-                      block_k: int = FLASH_DEFAULT_BLOCK_K):
+                      block_k: int = FLASH_DEFAULT_BLOCK_K,
+                      sm_scale: Optional[float] = None):
     """Adapter for ``horovod_tpu.models.bert.SelfAttention(attention_fn=...)``
     — signature (q, k, v, mask) with mask of shape (B, Sk) or None.
 
@@ -419,7 +420,9 @@ def make_attention_fn(causal: bool = False, use_flash="auto",
             flash = q.shape[1] >= FLASH_AUTO_MIN_SEQ
         if flash:
             return flash_attention(q, k, v, key_mask=mask, causal=causal,
+                                   sm_scale=sm_scale,
                                    block_q=block_q, block_k=block_k)
-        return reference_attention(q, k, v, key_mask=mask, causal=causal)
+        return reference_attention(q, k, v, key_mask=mask, causal=causal,
+                                   sm_scale=sm_scale)
 
     return fn

@@ -363,21 +363,10 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
 
     qg, kg, vg = scatter_heads(q), scatter_heads(k), scatter_heads(v)
     if attention_fn is None:
-        # Same auto selection as make_attention_fn: the resharded arrays
-        # hold the FULL sequence, so long-context calls hit the Pallas
-        # kernel and short ones the plain XLA path.
-        from ..ops.attention import (
-            FLASH_AUTO_MIN_SEQ,
-            flash_attention,
-            reference_attention,
-        )
+        # make_attention_fn's auto selection: the resharded arrays hold
+        # the FULL sequence, so long-context calls hit the Pallas kernel
+        # and short ones the plain XLA path.
+        from ..ops.attention import make_attention_fn
 
-        if qg.shape[1] >= FLASH_AUTO_MIN_SEQ:
-            out = flash_attention(qg, kg, vg, causal=causal,
-                                  sm_scale=sm_scale)
-        else:
-            out = reference_attention(qg, kg, vg, causal=causal,
-                                      sm_scale=sm_scale)
-    else:
-        out = attention_fn(qg, kg, vg, None)
-    return gather_heads(out)
+        attention_fn = make_attention_fn(causal=causal, sm_scale=sm_scale)
+    return gather_heads(attention_fn(qg, kg, vg, None))

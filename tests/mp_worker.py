@@ -47,6 +47,27 @@ def scenario_allreduce(rank, size):
     scale = sum(r + 1 for r in range(size)) / size
     np.testing.assert_allclose(tc, np.linspace(-2, 2, 16) * scale, atol=1e-2)
 
+    # Full reference dtype matrix (test_torch.py runs ByteTensor ...
+    # DoubleTensor): small ints sum exactly; bool reduces as logical OR.
+    for dt in (np.uint8, np.int8, np.int16, np.uint16, np.int64,
+               np.float16, np.float64):
+        xd = (np.arange(5) % 3 + rank).astype(dt)
+        td = np.asarray(hvd.allreduce(xd, average=False,
+                                      name=f"t.{np.dtype(dt).name}"))
+        expect(td.dtype == np.dtype(dt),
+               f"dtype changed: {td.dtype} != {np.dtype(dt)}")
+        want_d = (size * (np.arange(5) % 3) + sum(range(size))).astype(dt)
+        np.testing.assert_array_equal(td, want_d)
+
+    xb = np.zeros(4, dtype=bool)
+    xb[rank % 4] = True
+    tb = np.asarray(hvd.allreduce(xb, average=False, name="t.bool"))
+    expect(tb.dtype == np.dtype(bool), f"bool became {tb.dtype}")
+    want_b = np.zeros(4, bool)
+    for r in range(size):
+        want_b[r % 4] = True
+    np.testing.assert_array_equal(tb, want_b)
+
 
 def scenario_fusion(rank, size):
     # Many small tensors in flight at once: the controller packs them into
