@@ -47,8 +47,26 @@ def _allreduce_tree(tree, average: bool, axis_name: Optional[str],
     if not leaves:
         return tree
     if isinstance(leaves[0], jax.core.Tracer):
-        reduced = [C.allreduce(g, average=average, axis_name=axis_name)
-                   for g in leaves]
+        # Under jit, compression is a dtype cast XLA fuses into the
+        # collective: the psum moves half the bytes over ICI/DCN and the
+        # result is cast back to the original dtype. Only worth doing when
+        # the axis is actually bound (shard_map): on the pjit-style
+        # identity fallback the round-trip would truncate gradients for
+        # zero wire savings.
+        compress_traced = compression is not None
+        if compress_traced:
+            try:
+                jax.lax.axis_index(C._resolve_axis(axis_name))
+            except NameError:
+                compress_traced = False
+        reduced = []
+        for g in leaves:
+            if compress_traced:
+                g, ctx = compression.compress(g)
+            r = C.allreduce(g, average=average, axis_name=axis_name)
+            if compress_traced:
+                r = compression.decompress(r, ctx)
+            reduced.append(r)
         return jax.tree_util.tree_unflatten(treedef, reduced)
     st = basics.state()
     if st.topology.size == 1:
@@ -78,8 +96,10 @@ def DistributedOptimizer(
     accumulation (``torch/__init__.py:71-73``) via ``optax.MultiSteps``: the
     cross-rank reduction fires once per applied step.
 
-    ``compression`` applies on the eager tier's wire format; under jit, cast
-    gradients yourself (XLA fuses the cast into the collective).
+    ``compression`` applies on both tiers: on the eager tier it shrinks the
+    wire format; under jit it casts the gradient before the psum (XLA fuses
+    the cast into the collective, halving ICI/DCN bytes for
+    ``Compression.bf16``/``fp16``) and casts the result back.
     """
 
     def init_fn(params):

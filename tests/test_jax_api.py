@@ -113,3 +113,52 @@ def test_broadcast_parameters_single():
     assert out is params  # size-1 no-op
     opt_out = hvd.broadcast_optimizer_state(params, root_rank=0)
     assert opt_out is params
+
+
+def test_distributed_optimizer_compression_in_jit():
+    """Under jit, Compression.bf16 casts the gradient before the psum (the
+    collective moves bf16) and restores f32 afterwards."""
+    from horovod_tpu.parallel import make_mesh
+
+    hvd.init()
+    mesh = make_mesh({"data": 8})
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="data",
+                                  compression=hvd.Compression.bf16)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt_state = tx.init(params)
+
+    def step(p, o, g):
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o
+
+    f = jax.shard_map(
+        step, mesh=mesh, in_specs=(P(), P(), P()), out_specs=(P(), P()),
+        check_vma=False)
+    grads = {"w": jnp.full((4,), 2.0, jnp.float32)}
+    jaxpr = str(jax.make_jaxpr(f)(params, opt_state, grads))
+    # The collective's operand must be bf16 (cast fused into the psum).
+    assert "bf16[4]" in jaxpr, jaxpr[:2000]
+
+    p2, _ = jax.jit(f)(params, opt_state, grads)
+    # Result back in f32, numerically the plain SGD step.
+    assert p2["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(p2["w"]), 1.0 - 0.1 * 2.0,
+                               rtol=1e-2)
+    hvd.shutdown()
+
+
+def test_compression_skipped_on_unbound_axis():
+    """Plain jit (pjit-style identity fallback): the bf16 round-trip would
+    truncate gradients for zero wire savings, so it must not happen."""
+    hvd.init()
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="data",
+                                  compression=hvd.Compression.bf16)
+    p = {"w": jnp.ones((4,), jnp.float32)}
+    o = tx.init(p)
+    g = {"w": jnp.full((4,), 1.0000001, jnp.float32)}
+    u, _ = jax.jit(lambda g, o, p: tx.update(g, o, p))(g, o, p)
+    got = float(np.asarray(u["w"])[0])
+    full = float(np.float32(-0.1) * np.float32(1.0000001))
+    # bf16 would collapse 1.0000001 -> 1.0 and yield exactly -0.1.
+    assert abs(got - full) < 1e-9, got
+    hvd.shutdown()
