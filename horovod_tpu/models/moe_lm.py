@@ -22,7 +22,7 @@ objective.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -127,11 +127,13 @@ class MoeBlock(nn.Module):
     config: MoeConfig
     expert_axis: Optional[str] = None
     local_experts: Optional[int] = None
+    attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x):
         cfg = self.config
-        x = x + LlamaAttention(cfg.llama(), name="attention")(
+        x = x + LlamaAttention(cfg.llama(), attention_fn=self.attention_fn,
+                               name="attention")(
             RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x))
         h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
         return x + MoeFFN(cfg, expert_axis=self.expert_axis,
@@ -155,6 +157,7 @@ class MoeLM(nn.Module):
     config: MoeConfig
     expert_axis: Optional[str] = None
     local_experts: Optional[int] = None
+    attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, input_ids):
@@ -167,9 +170,11 @@ class MoeLM(nn.Module):
             if i % cfg.moe_every == cfg.moe_every - 1:
                 x = MoeBlock(cfg, expert_axis=self.expert_axis,
                              local_experts=self.local_experts,
+                             attention_fn=self.attention_fn,
                              name=f"layer_{i}")(x)
             else:
-                x = LlamaBlock(cfg.llama(), name=f"layer_{i}")(x)
+                x = LlamaBlock(cfg.llama(), attention_fn=self.attention_fn,
+                               name=f"layer_{i}")(x)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="lm_head")(x)

@@ -93,3 +93,19 @@ def test_moe_lm_trains():
         params, opt_state, loss = step(params, opt_state)
         losses.append(float(loss))
     assert losses[-1] < losses[0] - 0.5, losses[::10]
+
+
+def test_moe_lm_flash_attention_fn():
+    """The attention_fn seam (flash kernel) matches the reference path,
+    same as LlamaLM's."""
+    from horovod_tpu.ops.attention import make_attention_fn
+
+    ids = _ids(3)
+    ref_model = MoeLM(MOE_TINY)
+    variables = ref_model.init(jax.random.PRNGKey(0), ids)
+    ref = ref_model.apply({"params": variables["params"]}, ids)
+    flash_model = MoeLM(MOE_TINY, attention_fn=make_attention_fn(
+        causal=True, use_flash=True, block_q=16, block_k=16))
+    out = flash_model.apply({"params": variables["params"]}, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-2, rtol=5e-2)
