@@ -160,3 +160,23 @@ def test_nic_discovery_timeout_returns_error():
 def test_discover_routable_addrs_single_host_is_noop():
     from horovod_tpu.run.launch import discover_routable_addrs
     assert discover_routable_addrs(["localhost"], 22, "ab" * 32) is None
+
+
+def test_version_flag():
+    res = _run_launcher(["-v"])
+    assert res.returncode == 0
+    assert "horovod_tpu v" in res.stdout
+
+
+def test_missing_np_still_errors():
+    res = _run_launcher([sys.executable, "-c", "pass"])
+    assert res.returncode != 0
+    assert "-np" in res.stderr
+
+
+def test_host_long_form_alias():
+    # Reference spells the flag --host; both spellings must work.
+    res = _run_launcher(["-np", "1", "--host", "localhost:1",
+                         sys.executable, "-c", "print('ok-alias')"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ok-alias" in res.stdout
