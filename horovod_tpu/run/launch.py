@@ -467,12 +467,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="horovodrun",
         description="Launch a horovod_tpu job (TPU-native horovodrun: no "
                     "mpirun, no ssh preflight for local jobs).")
+    from .. import __version__
+
     parser.add_argument("-np", "--num-proc", dest="np", type=int,
-                        default=None,
+                        required=True,
                         help="total number of processes (ranks)")
-    parser.add_argument("-v", "--version", action="store_true",
-                        help="print the horovod_tpu version and exit "
-                             "(reference horovodrun -v)")
+    # argparse's version action exits during parse, before required-arg
+    # validation, so plain `horovodrun -v` works (reference horovodrun -v).
+    parser.add_argument("-v", "--version", action="version",
+                        version=f"horovod_tpu v{__version__}")
     parser.add_argument("-H", "--hosts", "--host", default=None,
                         help="host1:slots,host2:slots (default: all local)")
     parser.add_argument("--controller-addr", default=None,
@@ -504,13 +507,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="training command")
     args = parser.parse_args(argv)
-    if args.version:
-        from .. import __version__
-
-        print(f"horovod_tpu v{__version__}")
-        return 0
-    if args.np is None:
-        parser.error("the following arguments are required: -np/--num-proc")
     if not args.command:
         parser.error("no command given")
     if args.spmd and args.bind_chips:
