@@ -7,8 +7,20 @@ batches, img/sec) running through the framework's hot path:
 ``hvd.DistributedOptimizer`` inside a jitted ``shard_map`` over the device
 mesh, bf16 activations.
 
-Prints ONE JSON line:
+Always prints ONE JSON line. On success:
   {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+On failure (e.g. the tunneled TPU pool is wedged at backend init):
+  {"metric": ..., "value": null, ..., "error": "tpu_backend_init_timeout",
+   "phase": "backend_init", "attempts": N, "elapsed_s": T}
+
+Architecture: a parent SUPERVISOR forks measurement children. The child arms
+a kernel-level SIGALRM watchdog (a Python handler can't run while a wedged
+native backend-init holds the GIL), so a wedged child dies silently — the
+parent observes returncode -14 (a shell would report 142 = 128+SIGALRM) and
+the child cannot print anything. The parent is never wedged, so it can
+always emit the structured record, distinguish "pool down" from "framework
+broken" (via a cheap matmul PROBE child before each expensive full attempt),
+and retry with backoff inside its budget.
 
 vs_baseline anchor: the only absolute throughput figure in the reference repo
 is tf_cnn_benchmarks ResNet-101 at 1656.82 total img/sec on 16 P100s
@@ -21,28 +33,13 @@ number; ResNet-101 is ~1.7x the FLOPs of ResNet-50 — noted, not hidden).
 import json
 import os
 import signal
+import subprocess
 import sys
+import tempfile
 import time
 
-# Watchdog: the tunneled TPU backend can wedge at init when the chip is held
-# by a stale claim; die after 10 minutes instead of hanging the harness
-# forever. The DEFAULT SIGALRM action (kernel-level kill) is used on purpose:
-# a Python handler cannot run while the hang holds the GIL inside native
-# backend-init code. Overridable via BENCH_TIMEOUT_S.
-signal.signal(signal.SIGALRM, signal.SIG_DFL)
-signal.alarm(int(os.environ.get("BENCH_TIMEOUT_S", "600")))
-sys.stderr.write("bench.py: watchdog armed (SIGALRM, "
-                 f"{os.environ.get('BENCH_TIMEOUT_S', '600')}s)\n")
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-from jax.sharding import PartitionSpec as P
-
-import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
-
+METRIC = "resnet50_synthetic_train_images_per_sec_per_chip"
+UNIT = "images/sec/chip"
 BASELINE_IMG_SEC_PER_CHIP = 1656.82 / 16  # docs/benchmarks.md:28-34
 
 BATCH_PER_CHIP = 256  # ~2.5% over 128: deeper MXU pipelining per step
@@ -50,8 +47,54 @@ IMAGE_SIZE = 224
 WARMUP = 3
 ITERS = 10
 
+# Supervisor knobs (seconds). Budget covers all probes, attempts, backoffs.
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "1740"))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_TIMEOUT_S", "540"))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "180"))
 
-def main():
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement (or a cheap backend probe).
+# --------------------------------------------------------------------------
+
+def _phase(status_path, name):
+    """Record the phase the child is in, so the parent can report how far a
+    killed child got (backend_init wedge vs compile vs measurement)."""
+    if status_path:
+        with open(status_path, "a") as f:
+            f.write(name + "\n")
+
+
+def child_probe(status_path):
+    """Cheap liveness probe: import jax, run one tiny matmul. If the shared
+    TPU pool is wedged at backend init this hangs and the watchdog kills us;
+    the parent then knows the failure is external, not a framework bug."""
+    _phase(status_path, "import")
+    import jax
+    import jax.numpy as jnp
+    _phase(status_path, "backend_init")
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    del y
+    _phase(status_path, "ok")
+    # flush: stdout is a pipe to the parent (block-buffered); a teardown
+    # wedge + watchdog kill must not discard an already-produced result.
+    print(json.dumps({"probe": "ok", "devices": len(jax.devices())}),
+          flush=True)
+
+
+def child_bench(status_path):
+    _phase(status_path, "import")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+
+    _phase(status_path, "backend_init")
     hvd.init()
     n = hvd.local_num_devices()
     mesh = hvd.parallel.mesh()
@@ -103,6 +146,7 @@ def main():
     batch_stats = hvd.parallel.replicate(batch_stats, mesh)
     opt_state = hvd.parallel.replicate(opt_state, mesh)
 
+    _phase(status_path, "compile_warmup")
     for _ in range(WARMUP):
         params, batch_stats, opt_state, loss = step(
             params, batch_stats, opt_state, x, y)
@@ -114,6 +158,7 @@ def main():
     # guards against can no longer happen. Disarm so a legitimately slow
     # measurement (interpreter mode, busy host) is never killed mid-run.
     signal.alarm(0)
+    _phase(status_path, "measure")
 
     # Best of three windows: the tunnel adds run-to-run noise that only ever
     # slows a window down, so the fastest window is the closest estimate of
@@ -129,13 +174,245 @@ def main():
 
     total_img_sec = batch * ITERS / best_elapsed
     per_chip = total_img_sec / n
+    _phase(status_path, "ok")
+    # flush: see child_probe — don't let a teardown wedge eat the result.
     print(json.dumps({
-        "metric": "resnet50_synthetic_train_images_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
+        "unit": UNIT,
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
-    }))
+    }), flush=True)
+
+
+def child_main(mode):
+    timeout = PROBE_TIMEOUT_S if mode == "probe" else ATTEMPT_TIMEOUT_S
+    # Kernel-default SIGALRM action (hard kill) on purpose: a Python handler
+    # cannot run while the hang holds the GIL inside native backend-init code.
+    signal.signal(signal.SIGALRM, signal.SIG_DFL)
+    signal.alarm(timeout)
+    sys.stderr.write(f"bench.py[{mode}]: watchdog armed ({timeout}s)\n")
+    status_path = os.environ.get("BENCH_STATUS_FILE")
+    if mode == "probe":
+        child_probe(status_path)
+    else:
+        child_bench(status_path)
+
+
+# --------------------------------------------------------------------------
+# Parent: supervisor. Never touches jax, so it can never wedge.
+# --------------------------------------------------------------------------
+
+def _read_phase(status_path):
+    try:
+        with open(status_path) as f:
+            phases = [ln.strip() for ln in f if ln.strip()]
+        return phases[-1] if phases else "spawn"
+    except OSError:
+        return "unknown"
+
+
+# In-flight child, so the SIGTERM handler can kill it: an orphaned child
+# would keep holding the shared TPU pool claim — the exact "stale claim"
+# wedge condition this script exists to survive.
+_CURRENT_CHILD = None
+
+
+def _run_child(mode, deadline):
+    """Run one child; returns (parsed_json_or_None, rc, last_phase, stderr_tail)."""
+    global _CURRENT_CHILD
+    timeout = PROBE_TIMEOUT_S if mode == "probe" else ATTEMPT_TIMEOUT_S
+    # Don't start a child whose worst-case lifetime (watchdog + margin)
+    # would outlive our budget.
+    remaining = deadline - time.monotonic()
+    if remaining < timeout + 70:
+        return None, None, "budget_exhausted", ""
+    with tempfile.NamedTemporaryFile(
+            mode="r", suffix=".phase", delete=False) as st:
+        status_path = st.name
+    env = dict(os.environ, BENCH_CHILD=mode, BENCH_STATUS_FILE=status_path)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    _CURRENT_CHILD = proc
+    # The child self-destructs via SIGALRM at `timeout`; the margin covers
+    # interpreter startup + teardown. BUT: once the child reaches the
+    # "measure" phase it has disarmed its own watchdog on purpose (a slow
+    # measurement is not a wedge), so the parent must extend the same grace —
+    # bounded by the overall budget — instead of re-imposing the kill.
+    hard_deadline = time.monotonic() + timeout + 60
+    out, err, rc = "", "", -9
+    while True:
+        try:
+            out, err = proc.communicate(timeout=10)
+            rc = proc.returncode
+            break
+        except subprocess.TimeoutExpired:
+            now = time.monotonic()
+            if now < hard_deadline:
+                continue
+            # Long grace ONLY for "measure" (watchdog deliberately disarmed,
+            # result not yet produced). At "ok" the result is already flushed
+            # into the pipe — a teardown wedge earns an immediate kill, and
+            # communicate() below still retrieves the buffered JSON.
+            if _read_phase(status_path) == "measure" and now < deadline - 30:
+                continue
+            proc.kill()
+            tail_out, tail_err = proc.communicate()
+            out, err, rc = out + tail_out, err + tail_err, -9
+            break
+    _CURRENT_CHILD = None
+    last_phase = _read_phase(status_path)
+    try:
+        os.unlink(status_path)
+    except OSError:
+        pass
+    parsed = None
+    for line in reversed(out.strip().splitlines()):
+        try:
+            candidate = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(candidate, dict):
+            parsed = candidate
+            break
+    return parsed, rc, last_phase, err[-2000:]
+
+
+def supervisor():
+    t_start = time.monotonic()
+    deadline = t_start + TOTAL_BUDGET_S
+    attempts = 0
+    probe_ok_ever = False
+    last_bench = None   # {"rc", "phase"} of the last real bench failure
+    last_probe = None   # {"rc", "phase"} of the last real probe failure
+    backoff = 20
+    deterministic_probe_failures = 0
+    deterministic_bench_failures = 0
+
+    def _shield():
+        # Past this point exactly one JSON line will be printed; block
+        # SIGTERM so on_term can't interleave a second, contradictory one.
+        signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGTERM})
+
+    def classify():
+        """Attribute the failure truthfully from what actually happened:
+        - a full attempt ran and died            → bench_failed
+        - probe ok but no attempt ever fit       → budget_exhausted
+        - probe died by signal / wedge           → tpu_backend_init_timeout
+        - probe exited cleanly non-zero (env/
+          import break — NOT a pool problem)     → probe_error
+        - nothing ran at all                     → budget_exhausted
+        """
+        if attempts:
+            return "bench_failed"
+        if last_probe is None:
+            return "budget_exhausted"
+        if last_probe["rc"] is not None and last_probe["rc"] > 0:
+            return "probe_error"
+        return "tpu_backend_init_timeout"
+
+    def emit_failure(error):
+        _shield()
+        # phase/rc come from the failure class named by `error`; the other
+        # tier's last failure (if any) rides along so interleavings like
+        # "attempt failed, then pool went down" stay fully attributed.
+        src = last_bench if error == "bench_failed" else last_probe
+        record = {
+            "metric": METRIC, "value": None, "unit": UNIT,
+            "vs_baseline": None, "error": error,
+            "phase": src["phase"] if src else "none",
+            "rc": src["rc"] if src else None,
+            "attempts": attempts, "probe_ok": probe_ok_ever,
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+        }
+        if error == "bench_failed" and last_probe is not None:
+            record["probe_phase"] = last_probe["phase"]
+            record["probe_rc"] = last_probe["rc"]
+        print(json.dumps(record), flush=True)
+
+    # If something above us (driver budget) SIGTERMs the supervisor, still
+    # leave a parseable record on stdout — after killing the in-flight
+    # child, which would otherwise orphan and hold the TPU pool claim.
+    def on_term(signum, frame):
+        if _CURRENT_CHILD is not None:
+            try:
+                _CURRENT_CHILD.kill()
+            except OSError:
+                pass
+        emit_failure("supervisor_killed")
+        os._exit(3)
+    signal.signal(signal.SIGTERM, on_term)
+
+    while True:
+        # A bench attempt needs ATTEMPT+70s after a successful probe (~40s
+        # when the pool is healthy). If that can't fit any more, don't burn
+        # a full 180s wedged-probe timeout just to learn it.
+        if deadline - time.monotonic() < ATTEMPT_TIMEOUT_S + 110:
+            emit_failure(classify())
+            return 3
+
+        # 1) Cheap probe: is the pool even alive? Saves a full 540 s attempt
+        #    when the backend is wedged, and cleanly separates "pool down"
+        #    from "framework broken" in the failure record.
+        parsed, rc, phase, err = _run_child("probe", deadline)
+        if phase == "budget_exhausted":
+            emit_failure(classify())
+            return 3
+        if not (parsed and parsed.get("probe") == "ok"):
+            last_probe = {"rc": rc, "phase": phase}
+            sys.stderr.write(
+                f"bench.py: probe failed (rc={rc}, phase={phase}); "
+                f"backing off {backoff}s\n")
+            # A clean non-zero exit (traceback, bad env) is deterministic:
+            # retrying for half an hour can't fix an ImportError.
+            if rc is not None and rc > 0:
+                deterministic_probe_failures += 1
+                if deterministic_probe_failures >= 2:
+                    if err:
+                        sys.stderr.write(err + "\n")
+                    emit_failure("probe_error")
+                    return 3
+            else:
+                deterministic_probe_failures = 0
+            time.sleep(min(backoff, max(0, deadline - time.monotonic())))
+            backoff = min(backoff * 2, 160)
+            continue
+        probe_ok_ever = True
+        backoff = 20  # pool is alive again: next transient starts fresh
+        deterministic_probe_failures = 0
+
+        # 2) Full measurement attempt.
+        parsed, rc, phase, err = _run_child("bench", deadline)
+        if parsed and parsed.get("value") is not None:
+            _shield()
+            print(json.dumps(parsed), flush=True)
+            return 0
+        if phase == "budget_exhausted":
+            # Keep the last REAL failure for attribution — the sentinel
+            # carries no diagnostic value.
+            emit_failure(classify())
+            return 3
+        attempts += 1
+        last_bench = {"rc": rc, "phase": phase}
+        sys.stderr.write(
+            f"bench.py: attempt {attempts} failed (rc={rc}, phase={phase})\n")
+        if err:
+            sys.stderr.write(err + "\n")
+        # Same 2-strike rule as the probe: a clean non-zero exit is a code
+        # bug, not a pool transient — don't spend the budget re-proving it.
+        if rc is not None and rc > 0:
+            deterministic_bench_failures += 1
+            if deterministic_bench_failures >= 2:
+                emit_failure("bench_failed")
+                return 3
+        else:
+            deterministic_bench_failures = 0
+        time.sleep(min(20, max(0, deadline - time.monotonic())))
 
 
 if __name__ == "__main__":
-    main()
+    mode = os.environ.get("BENCH_CHILD")
+    if mode:
+        child_main(mode)
+    else:
+        sys.exit(supervisor())

@@ -1,0 +1,162 @@
+"""bench.py supervisor: the driver must ALWAYS get one parseable JSON line.
+
+Round-1 failure mode (VERDICT.md "What's weak" #1): the measurement child is
+hard-killed by its kernel-level SIGALRM watchdog when the tunneled TPU pool
+wedges at backend init, so it can't print anything and the driver recorded
+rc=142 with parsed=null. The supervisor parent never touches jax, so these
+tests drive it with stubbed children and assert the contract: success line
+passed through verbatim, failure line structured and phase-attributed.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location("bench_under_test", BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # No real sleeping/backoff in unit tests.
+    monkeypatch.setattr(mod.time, "sleep", lambda s: None)
+    old_handler = signal.getsignal(signal.SIGTERM)
+    yield mod
+    # supervisor() installs a SIGTERM handler and blocks SIGTERM once it has
+    # printed its one JSON line; undo both so tests stay isolated.
+    signal.signal(signal.SIGTERM, old_handler)
+    signal.pthread_sigmask(signal.SIG_UNBLOCK, {signal.SIGTERM})
+
+
+def _drive(bench, monkeypatch, capsys, script):
+    """Run supervisor() with _run_child stubbed to pop results off `script`
+    (a list of (parsed, rc, phase, err) tuples, probe/bench interleaved)."""
+    calls = []
+
+    def fake_run_child(mode, deadline):
+        calls.append(mode)
+        if not script:
+            return None, None, "budget_exhausted", ""
+        return script.pop(0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    rc = bench.supervisor()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(line), calls
+
+
+def test_success_line_passthrough(bench, monkeypatch, capsys):
+    good = {"metric": bench.METRIC, "value": 2400.0, "unit": bench.UNIT,
+            "vs_baseline": 23.2}
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [
+        ({"probe": "ok", "devices": 1}, 0, "ok", ""),
+        (good, 0, "ok", ""),
+    ])
+    assert rc == 0
+    assert parsed == good
+    assert calls == ["probe", "bench"]
+
+
+def test_pool_down_emits_backend_init_timeout(bench, monkeypatch, capsys):
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [
+        (None, -14, "backend_init", "watchdog armed"),
+        (None, -14, "backend_init", "watchdog armed"),
+    ])
+    assert rc == 3
+    assert parsed["value"] is None
+    assert parsed["error"] == "tpu_backend_init_timeout"
+    assert parsed["phase"] == "backend_init"
+    assert parsed["probe_ok"] is False
+    # Never burned a full bench attempt while the pool was down.
+    assert "bench" not in calls
+
+
+def test_framework_break_distinguished_from_pool_down(
+        bench, monkeypatch, capsys):
+    """Probe succeeds but the measurement dies → error says bench_failed
+    (framework problem), not pool-down, and records the phase reached."""
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [
+        ({"probe": "ok", "devices": 1}, 0, "ok", ""),
+        (None, 1, "compile_warmup", "Traceback ..."),
+        ({"probe": "ok", "devices": 1}, 0, "ok", ""),
+        (None, 1, "compile_warmup", "Traceback ..."),
+    ])
+    assert rc == 3
+    assert parsed["error"] == "bench_failed"
+    assert parsed["phase"] == "compile_warmup"
+    assert parsed["probe_ok"] is True
+    assert parsed["attempts"] == 2
+
+
+def test_retry_after_transient_failure(bench, monkeypatch, capsys):
+    good = {"metric": bench.METRIC, "value": 2300.0, "unit": bench.UNIT,
+            "vs_baseline": 22.2}
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [
+        (None, -14, "backend_init", ""),       # probe: pool hiccup
+        ({"probe": "ok", "devices": 1}, 0, "ok", ""),
+        (good, 0, "ok", ""),
+    ])
+    assert rc == 0
+    assert parsed["value"] == 2300.0
+
+
+def test_deterministic_probe_error_stops_early(bench, monkeypatch, capsys):
+    """A clean non-zero probe exit (ImportError, bad env) is not a pool
+    outage: two in a row must end the run as probe_error, not burn the whole
+    budget and mislabel it tpu_backend_init_timeout."""
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [
+        (None, 1, "import", "ImportError: ..."),
+        (None, 1, "import", "ImportError: ..."),
+    ])
+    assert rc == 3
+    assert parsed["error"] == "probe_error"
+    assert parsed["phase"] == "import"
+    assert calls == ["probe", "probe"]
+
+
+def test_bench_budget_exhaustion_preserves_last_real_phase(
+        bench, monkeypatch, capsys):
+    """When the budget dies at a bench attempt, the record must keep the
+    previous real failure's phase, not the budget_exhausted sentinel."""
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [
+        ({"probe": "ok", "devices": 1}, 0, "ok", ""),
+        (None, 1, "compile_warmup", "Traceback ..."),
+        ({"probe": "ok", "devices": 1}, 0, "ok", ""),
+        (None, None, "budget_exhausted", ""),
+    ])
+    assert rc == 3
+    assert parsed["error"] == "bench_failed"
+    assert parsed["phase"] == "compile_warmup"
+    assert parsed["rc"] == 1
+    assert parsed["attempts"] == 1
+
+
+def test_no_probe_when_bench_cannot_fit(bench, monkeypatch, capsys):
+    """With less budget than one bench attempt, don't burn a wedged-probe
+    timeout just to learn the bench can't run anyway."""
+    monkeypatch.setattr(bench, "TOTAL_BUDGET_S",
+                        bench.ATTEMPT_TIMEOUT_S)  # < ATTEMPT + 110
+    rc, parsed, calls = _drive(bench, monkeypatch, capsys, [])
+    assert rc == 3
+    assert parsed["error"] == "budget_exhausted"
+    assert calls == []
+
+
+def test_child_probe_cpu_end_to_end():
+    """Real subprocess round-trip of the probe child on the CPU backend."""
+    env = dict(os.environ, BENCH_CHILD="probe", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run([sys.executable, BENCH], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    parsed = json.loads(out.stdout.strip().splitlines()[-1])
+    assert parsed["probe"] == "ok"
