@@ -5,12 +5,19 @@ the long-context compute core required by the rebuild (task brief:
 "long-context ... first-class"), and the ``attention_fn`` seam of
 ``horovod_tpu.models.bert.SelfAttention`` plugs into it.
 
-Design: classic FlashAttention-2 online-softmax blocking. Q is tiled over the
-grid; each program streams K/V blocks from VMEM, maintaining running max,
-normalizer, and output accumulator — O(S) memory instead of O(S^2), and the
-(block_q x d) @ (d x block_k) products keep the MXU fed. Backward uses the
-rematerialized XLA path (``jax.custom_vjp``): recomputing attention in the
-backward is the standard TPU trade (HBM bandwidth for FLOPs).
+Design: classic FlashAttention-2 online-softmax blocking. The grid is
+(batch*heads, q_blocks, k_blocks); Pallas streams one (block_k, d) K/V tile
+per innermost grid step from HBM into VMEM (BlockSpec index_maps drive the
+double-buffered DMA pipeline), so VMEM holds O(block_q*d + block_k*d) — not
+O(seq_k*d) — and the ceiling on sequence length is HBM, not VMEM. Running
+max / normalizer / output accumulate in VMEM scratch across the innermost
+dimension (TPU grids execute sequentially), and the
+(block_q x d) @ (d x block_k) products keep the MXU fed.
+
+Backward is a Pallas FA-2 backward (two kernels: a dq pass streaming K/V
+and a dk/dv pass streaming Q/dO), reconstituting probabilities from the
+saved per-row log-sum-exp instead of storing the S x S matrix. Set
+``HOROVOD_FLASH_XLA_BWD=1`` to fall back to the rematerialized XLA backward.
 """
 
 from __future__ import annotations
@@ -59,32 +66,45 @@ def reference_attention(q, k, v, key_mask=None, causal=False,
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
-                  block_k: int, sm_scale: float, causal: bool, seq_k: int,
-                  block_q: int):
-    # Block shapes: q (1, block_q, d), k/v (1, seq_k, d), mask (1, seq_k).
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    d = q.shape[-1]
-    qi_block = pl.program_id(1)
+# Lane width of the m/l scratch accumulators. TPU VMEM wants a 128-wide
+# trailing dim; the running max/normalizer live column-broadcast across it.
+_STATE_LANES = 128
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
 
-    num_kb = seq_k // block_k
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+                  m_scr, l_scr, acc_scr, *, block_k: int, sm_scale: float,
+                  causal: bool, num_kb: int, block_q: int):
+    # Grid (bh, qb, kb), kb innermost. Block shapes: q (1, block_q, d)
+    # (constant across kb — fetched once), k/v (1, block_k, d) (a NEW tile
+    # streams in from HBM each kb step), mask (1, 1, block_k). Running
+    # softmax state persists in VMEM scratch across the kb loop.
+    qb, kb = pl.program_id(1), pl.program_id(2)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: K blocks strictly above the diagonal touch no allowed entry;
+    # skip their compute entirely (the DMA still runs — grid fetches are
+    # static — but the MXU work, the dominant cost, is elided).
+    live = (kb * block_k <= qb * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (block_q, block_k)
-        kmask = mask_ref[0, 0, pl.ds(kb * block_k, block_k)]
-        allowed = jnp.broadcast_to((kmask != 0)[None, :],
+        allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
                                    (block_q, block_k))
         if causal:
-            q_pos = qi_block * block_q + jax.lax.broadcasted_iota(
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -97,18 +117,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *,
         p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    # Fully-masked rows (l == 0) produce zeros, not NaNs.
-    out = acc / jnp.maximum(l, 1e-30)
-    o_ref[0] = out.astype(o_ref.dtype)
-    # Log-sum-exp per row, saved for the backward pass (FlashAttention-2):
-    # exp(s - lse) reconstitutes the softmax without storing the S x S probs.
-    lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        # Fully-masked rows (l == 0) produce zeros, not NaNs.
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # Log-sum-exp per row, saved for the backward pass
+        # (FlashAttention-2): exp(s - lse) reconstitutes the softmax without
+        # storing the S x S probs.
+        lse_ref[0, 0] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 def _fold_heads(q, k, v, key_mask):
@@ -153,24 +177,33 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
             f"blocks ({block_q},{block_k}); pad to a block multiple")
 
     qf, kf, vf, maskf = _fold_heads(q, k, v, key_mask)
-    grid = (b * h, sq // block_q)
+    num_kb = sk // block_k
+    # kb innermost: K/V tiles stream HBM→VMEM one per step; q block and the
+    # o/lse output blocks are revisited (their index_maps ignore kb), so
+    # they stay VMEM-resident across the whole kb sweep.
+    grid = (b * h, sq // block_q, num_kb)
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, sm_scale=scale,
-                          causal=causal, seq_k=sk, block_q=block_q),
+                          causal=causal, num_kb=num_kb, block_q=block_q),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sk), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _STATE_LANES), jnp.float32),
+            pltpu.VMEM((block_q, _STATE_LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf, maskf)
@@ -178,30 +211,35 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                         delta_ref, dq_ref, *, block_k: int, sm_scale: float,
-                         causal: bool, seq_k: int, block_q: int):
-    # Recompute p block-by-block from q, k and the saved lse; no S x S
-    # materialization (FlashAttention-2 backward, dq pass).
-    q = q_ref[0].astype(jnp.float32) * sm_scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]          # (block_q, 1)
-    delta = delta_ref[0, 0][:, None]      # (block_q, 1)
-    d = q.shape[-1]
-    qi_block = pl.program_id(1)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    num_kb = seq_k // block_k
+                         delta_ref, dq_ref, dq_scr, *, block_k: int,
+                         sm_scale: float, causal: bool, num_kb: int,
+                         block_q: int):
+    # Grid (bh, qb, kb), kb innermost: K/V tiles stream from HBM while
+    # q/do/lse/delta stay resident. Recompute p block-by-block from q, k and
+    # the saved lse; no S x S materialization (FA-2 backward, dq pass).
+    qb, kb = pl.program_id(1), pl.program_id(2)
 
-    def body(kb, acc):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (kb * block_k <= qb * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]          # (block_q, 1)
+        delta = delta_ref[0, 0][:, None]      # (block_q, 1)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        allowed = jnp.broadcast_to(
-            (mask_ref[0, 0, pl.ds(kb * block_k, block_k)] != 0)[None, :],
-            (block_q, block_k))
+        allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
+                                   (block_q, block_k))
         if causal:
-            q_pos = qi_block * block_q + jax.lax.broadcasted_iota(
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -213,36 +251,40 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return acc + jax.lax.dot_general(
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    acc = jax.lax.fori_loop(0, num_kb, body, acc0)
-    dq_ref[0] = (acc * sm_scale).astype(dq_ref.dtype)
+    @pl.when(kb == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = (dq_scr[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                           delta_ref, dk_ref, dv_ref, *, block_q: int,
-                           sm_scale: float, causal: bool, seq_q: int,
-                           block_k: int):
-    # dk/dv pass: one K/V block per program, streaming Q/do blocks.
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
-    d = k_blk.shape[-1]
-    kb = pl.program_id(1)
-    kmask = (mask_ref[0, 0] != 0)  # (block_k,)
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    num_qb = seq_q // block_q
+                           delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                           block_q: int, sm_scale: float, causal: bool,
+                           num_qb: int, block_k: int):
+    # Grid (bh, kb, qb), qb innermost: Q/dO/lse/delta tiles stream from HBM
+    # while this program's K/V block stays resident. dk/dv accumulate in
+    # VMEM scratch across the qb sweep.
+    kb, qb = pl.program_id(1), pl.program_id(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32) * sm_scale
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, 0, pl.ds(qb * block_q, block_q)][:, None]
+    @pl.when(qb == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (kb * block_k <= qb * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        kmask = (mask_ref[0, 0] != 0)  # (block_k,)
+        q_blk = q_ref[0].astype(jnp.float32) * sm_scale
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
         s = jax.lax.dot_general(
             q_blk, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # (block_q, block_k)
@@ -254,7 +296,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                 jnp.int32, (block_q, block_k), 1)
             allowed = allowed & (k_pos <= q_pos)
         p = jnp.where(allowed, jnp.exp(s - lse), 0.0)
-        dv = dv + jax.lax.dot_general(
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
@@ -263,14 +305,14 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         ds = p * (dp - delta)
         # q_blk carries sm_scale already, so dk = (ds^T @ q) * scale falls
         # out directly.
-        dk = dk + jax.lax.dot_general(
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dk, dv = jax.lax.fori_loop(0, num_qb, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qb == num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
@@ -289,46 +331,53 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                     axis=-1).reshape(b * h, 1, sq)
 
+    num_kb = sk // block_k
+    num_qb = sq // block_q
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          sm_scale=scale, causal=causal, seq_k=sk,
+                          sm_scale=scale, causal=causal, num_kb=num_kb,
                           block_q=block_q),
-        grid=(b * h, sq // block_q),
+        grid=(b * h, num_qb, num_kb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sk), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, i: (bh, 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, maskf, dof, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
-                          sm_scale=scale, causal=causal, seq_q=sq,
+                          sm_scale=scale, causal=causal, num_qb=num_qb,
                           block_k=block_k),
-        grid=(b * h, sk // block_k),
+        grid=(b * h, num_kb, num_qb),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda bh, j: (bh, 0, j)),
-            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, 1, sq), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, j, i: (bh, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf, maskf, dof, lse, delta)
@@ -386,9 +435,12 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     """Flash attention forward. ``interpret=None`` auto-selects Pallas
     interpreter mode off-TPU (hermetic CPU tests run the same kernel).
 
-    Default blocks are tuned on v5e (S=2048, D=64: 2x over 128x128): K/V
-    are VMEM-resident regardless of ``block_k``, so large inner tiles just
-    cut ``fori_loop`` overhead; both are clamped to the sequence length."""
+    ``block_q``/``block_k`` set the VMEM working set AND the HBM→VMEM
+    streaming granule: per grid step one (block_k, d) K and V tile is DMAed
+    in (double-buffered by Pallas), so peak VMEM is
+    O(block_q*d + 2*block_k*d) independent of sequence length — S is bounded
+    by HBM, not VMEM. Defaults tuned on v5e at S=2048, D=64 (~2x over
+    128x128); both are clamped/halved to divide the sequence length."""
     if interpret is None:
         interpret = _auto_interpret()
     b, sk = k.shape[0], k.shape[1]

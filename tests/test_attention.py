@@ -127,6 +127,35 @@ def test_flash_block_fallback_non_divisible():
                                rtol=2e-2, atol=2e-2)
 
 
+def test_flash_long_context_32k():
+    # The whole point of streaming K/V from HBM via BlockSpec index_maps:
+    # S=32k runs with a VMEM working set of O(block) — under the old
+    # whole-K/V-in-VMEM layout this shape could not fit a real chip's VMEM.
+    # Interpret mode executes the same kernel logic; the reference is
+    # q-chunked to bound host memory (a monolithic S x S logits array at
+    # 32k is 4 GiB).
+    b, s, h, d = 1, 32768, 1, 16
+    rng = np.random.RandomState(20)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)) * 0.3
+    q, k, v = mk(), mk(), mk()
+
+    out = flash_attention(q, k, v, causal=True, block_q=2048, block_k=2048)
+
+    chunk = 2048
+    for start in range(0, s, chunk * 4):  # spot-check 1/4 of the chunks
+        qc = q[:, start:start + chunk]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qc, k).astype(jnp.float32)
+        logits = logits / (d ** 0.5)
+        ki = jnp.arange(s)[None, :]
+        qi = (start + jnp.arange(chunk))[:, None]
+        logits = jnp.where((ki <= qi)[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        ref_c = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+        np.testing.assert_allclose(
+            np.asarray(out[:, start:start + chunk]), np.asarray(ref_c),
+            atol=2e-5, rtol=1e-4)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_attention(causal):
     q, k, v = _qkv(4)
