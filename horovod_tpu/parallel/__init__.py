@@ -11,6 +11,7 @@ from .hierarchical import (  # noqa: F401
     hierarchical_allreduce,
 )
 from .pipeline import (  # noqa: F401
+    pipeline_1f1b,
     collect_from_last_stage,
     pipeline_apply,
     pipeline_loss,

@@ -7,13 +7,27 @@ activations hop stage→stage over ICI with ``jax.lax.ppermute``, and the
 whole schedule compiles into one XLA program — no per-microbatch host
 round-trips, no NCCL-style send/recv threads.
 
-Schedule: GPipe (Huang et al. 2019) — all microbatches flow forward through
-the stage ring inside one ``lax.scan``; XLA overlaps each tick's compute
-with the ppermute transfer. The bubble fraction is ``(S-1)/(M+S-1)`` for
-``S`` stages and ``M`` microbatches, so pick ``M >= 4*S`` in practice.
-Autodiff runs through the scan/ppermute, giving the mirrored backward
-schedule for free; wrap the stage body in ``jax.checkpoint`` (the
-``remat`` flag below) to keep live memory at one microbatch per stage.
+Schedules:
+
+- **GPipe** (Huang et al. 2019, the default) — all microbatches flow
+  forward through the stage ring inside one ``lax.scan``; XLA overlaps each
+  tick's compute with the ppermute transfer. The bubble fraction is
+  ``(S-1)/(M+S-1)`` for ``S`` stages and ``M`` microbatches, so pick
+  ``M >= 4*S`` in practice. Autodiff runs through the scan/ppermute, giving
+  the mirrored backward schedule for free; wrap the stage body in
+  ``jax.checkpoint`` (the ``remat`` flag below) to keep per-tick live
+  memory at one microbatch per stage — but the scan still stashes one
+  carry per tick, so activation memory grows O(M).
+- **1F1B** (PipeDream-Flush, Narayanan et al. 2021) — forward and backward
+  interleave inside ONE ``lax.scan``: once warm, each round runs one
+  forward (new microbatch) and one backward (completed microbatch), with
+  activations ppermuting down the ring and cotangents ppermuting back up.
+  A microbatch's stashed input lives only ``2(S-1-s)+1`` rounds at stage
+  ``s``, so activation memory is O(S) — independent of M — at the same
+  bubble as GPipe. Because the backward is fused into the schedule, the
+  cotangent of each microbatch must exist the moment the last stage
+  finishes it: the 1F1B path therefore owns the loss (``loss_fn``) and
+  returns ``(loss, grads)`` directly instead of activations.
 
 Usage sketch (see ``tests/test_pipeline.py``)::
 
@@ -50,15 +64,35 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stage_params: Any,
                    microbatches: jax.Array,
                    axis_name: str = "pipe",
-                   remat: bool = True) -> jax.Array:
-    """Run a GPipe forward pass. MUST be called inside ``shard_map`` with
-    ``stage_params`` sharded over ``axis_name`` (leading stage axis) and
-    ``microbatches`` of shape ``[M, mb, ...]`` replicated along it.
+                   remat: bool = True,
+                   schedule: str = "gpipe",
+                   loss_fn: Callable[..., jax.Array] | None = None,
+                   targets: jax.Array | None = None):
+    """Run a pipelined forward (GPipe) or fused forward+backward (1F1B).
+    MUST be called inside ``shard_map`` with ``stage_params`` sharded over
+    ``axis_name`` (leading stage axis) and ``microbatches`` of shape
+    ``[M, mb, ...]`` replicated along it.
 
-    Returns ``[M, mb, ...]`` outputs that are VALID ON THE LAST STAGE ONLY
-    (other stages hold garbage); reduce with :func:`pipeline_loss` or mask
-    by ``lax.axis_index(axis_name) == S-1`` before use.
+    ``schedule="gpipe"`` (default) returns ``[M, mb, ...]`` outputs that
+    are VALID ON THE LAST STAGE ONLY (other stages hold garbage); reduce
+    with :func:`pipeline_loss` or mask by
+    ``lax.axis_index(axis_name) == S-1`` before use, and take gradients
+    with ordinary autodiff through the call.
+
+    ``schedule="1f1b"`` requires ``loss_fn(y[, target]) -> scalar`` (the
+    per-microbatch loss; ``targets [M, ...]`` optional) and returns
+    ``(loss, grads)``: the mean per-microbatch loss (replicated over the
+    axis) and the local stage's parameter gradients (same ``[1, ...]``
+    leading-axis layout as ``stage_params`` — use ``P(axis_name)`` as its
+    out_spec). See :func:`pipeline_1f1b` for why the backward is fused.
     """
+    if schedule == "1f1b":
+        return pipeline_1f1b(stage_fn, stage_params, microbatches,
+                             loss_fn, targets, axis_name=axis_name)
+    if schedule != "gpipe":
+        raise ValueError(
+            f"pipeline_apply: unknown schedule {schedule!r}; "
+            "expected 'gpipe' or '1f1b'")
     idx = jax.lax.axis_index(axis_name)
     n_stages = axis_size(axis_name)
     num_mb = microbatches.shape[0]
@@ -88,6 +122,115 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     _, ys = jax.lax.scan(tick, init, jnp.arange(num_mb + n_stages - 1))
     # On the last stage, microbatch m completes at tick m + (S-1).
     return jax.lax.dynamic_slice_in_dim(ys, n_stages - 1, num_mb)
+
+
+def pipeline_1f1b(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                  stage_params: Any,
+                  microbatches: jax.Array,
+                  loss_fn: Callable[..., jax.Array],
+                  targets: jax.Array | None = None,
+                  axis_name: str = "pipe"):
+    """1F1B (PipeDream-Flush) fused training schedule. MUST be called
+    inside ``shard_map`` (same sharding contract as :func:`pipeline_apply`).
+
+    One ``lax.scan`` over ``M + 2(S-1)`` rounds runs the whole fwd+bwd:
+    stage ``s`` forwards microbatch ``m`` at round ``m + s`` and backwards
+    it at round ``m + 2(S-1) - s`` (the last stage back-to-back, upstream
+    stages as the cotangent ppermutes up the ring). Each stage stashes only
+    the microbatch INPUTS still awaiting their backward (ring buffer of
+    ``2S-1`` slots) and recomputes the stage VJP from the stash — so
+    activation memory is O(S), independent of M, where GPipe's scan stashes
+    O(M) carries. Gradients accumulate per stage across microbatches; no
+    autodiff runs through the scan itself (the VJPs are taken per stage,
+    per round).
+
+    ``loss_fn(y)`` or ``loss_fn(y, target)`` must return the scalar loss of
+    one microbatch; the returned ``loss``/``grads`` correspond to the MEAN
+    over microbatches. Gradients flow to ``stage_params`` only (not to
+    ``microbatches``/``targets``).
+    """
+    if loss_fn is None:
+        raise ValueError("pipeline_1f1b: loss_fn is required (the 1F1B "
+                         "schedule computes the backward in-line, so it "
+                         "must own the per-microbatch loss)")
+    idx = jax.lax.axis_index(axis_name)
+    n_stages = axis_size(axis_name)
+    num_mb = microbatches.shape[0]
+    last = n_stages - 1
+    span = 2 * (n_stages - 1)
+
+    local_params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0),
+                                stage_params)
+
+    def mb_loss(y, t):
+        return loss_fn(y) if targets is None else loss_fn(y, t)
+
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    stash_size = 2 * n_stages - 1  # > max input lifetime 2(S-1)+1 rounds
+
+    def round_fn(carry, r):
+        fwd_recv, bwd_recv, stash, grad_acc, loss_acc = carry
+
+        # ---- forward slot: microbatch m_f = r - idx ----
+        m_f = r - idx
+        do_f = (m_f >= 0) & (m_f < num_mb)
+        m_f_c = jnp.clip(m_f, 0, num_mb - 1)
+        inject = jax.lax.dynamic_index_in_dim(microbatches, m_f_c,
+                                              keepdims=False)
+        x_in = jnp.where(idx == 0, inject, fwd_recv)
+        y = stage_fn(local_params, x_in)
+        tgt = (jnp.zeros(()) if targets is None
+               else jax.lax.dynamic_index_in_dim(targets, m_f_c,
+                                                 keepdims=False))
+        # Last stage: per-microbatch loss + its cotangent, available the
+        # round the microbatch completes — this is what lets the backward
+        # start immediately instead of after a full forward sweep.
+        l_m, dy = jax.value_and_grad(mb_loss)(y, tgt)
+        loss_acc = loss_acc + jnp.where(do_f & (idx == last), l_m, 0.0)
+        stash = jnp.where(
+            do_f,
+            jax.lax.dynamic_update_index_in_dim(
+                stash, x_in, m_f_c % stash_size, axis=0),
+            stash)
+
+        # ---- backward slot: microbatch m_b = r - (2(S-1) - idx) ----
+        m_b = r - (span - idx)
+        do_b = (m_b >= 0) & (m_b < num_mb)
+        m_b_c = jnp.clip(m_b, 0, num_mb - 1)
+        # At the last stage m_b == m_f: the cotangent is this round's dy.
+        # Upstream stages receive theirs from the next stage's previous
+        # round via the reverse ppermute. Mean-loss scaling folds in here.
+        g_in = jnp.where(idx == last, dy / num_mb, bwd_recv)
+        x_saved = jax.lax.dynamic_index_in_dim(stash, m_b_c % stash_size,
+                                               keepdims=False)
+        _, stage_vjp = jax.vjp(stage_fn, local_params, x_saved)
+        dp, dx = stage_vjp(g_in)
+        grad_acc = jax.tree.map(
+            lambda acc, g: acc + jnp.where(do_b, g, 0.0), grad_acc, dp)
+
+        # Ring hops: activations down (wrap edge into stage 0 is ignored —
+        # it always injects), cotangents up (wrap edge into the last stage
+        # is ignored — it always uses its own dy).
+        fwd_send = jax.lax.ppermute(y, axis_name, fwd)
+        bwd_send = jax.lax.ppermute(dx, axis_name, bwd)
+        return (fwd_send, bwd_send, stash, grad_acc, loss_acc), None
+
+    zero_act = jnp.zeros_like(microbatches[0])
+    init = (
+        zero_act,
+        zero_act,
+        jnp.zeros((stash_size,) + microbatches.shape[1:],
+                  microbatches.dtype),
+        jax.tree.map(jnp.zeros_like, local_params),
+        jnp.zeros(()),
+    )
+    (_, _, _, grad_acc, loss_acc), _ = jax.lax.scan(
+        round_fn, init, jnp.arange(num_mb + span))
+
+    loss = jax.lax.psum(loss_acc, axis_name) / num_mb
+    grads = jax.tree.map(lambda g: g[None], grad_acc)
+    return loss, grads
 
 
 def collect_from_last_stage(y: jax.Array,
