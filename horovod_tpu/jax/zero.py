@@ -78,7 +78,14 @@ def zero_state_specs(optimizer: optax.GradientTransformation, params,
     transformation; ``params`` the full replicated params; ``num_shards``
     the size of ``axis_name``. The abstract state is evaluated on the
     SLICED param shapes so moments of scalar params (shape ``(1,)`` per
-    device) classify as sharded, exactly mirroring ``init_fn``."""
+    device) classify as sharded, exactly mirroring ``init_fn``.
+
+    Classification is by shape: array leaves matching a sliced-param shape
+    are sharded; 0-d leaves replicated; anything else raises (it cannot be
+    a per-param moment). Caveat: a replicated 1-d table whose length
+    happens to equal a slice length is indistinguishable by shape and
+    would be mis-classified as sharded — keep non-param state scalar or
+    compose it outside the ZeRO wrapper."""
     from jax.sharding import PartitionSpec
 
     def sliced(p):
@@ -86,10 +93,28 @@ def zero_state_specs(optimizer: optax.GradientTransformation, params,
         return jax.ShapeDtypeStruct(
             ((n + _pad_len(n, num_shards)) // num_shards,), p.dtype)
 
-    abstract = jax.eval_shape(optimizer.init, jax.tree.map(sliced, params))
-    return jax.tree.map(
-        lambda leaf: PartitionSpec(axis_name) if leaf.ndim
-        else PartitionSpec(), abstract)
+    sliced_params = jax.tree.map(sliced, params)
+    slice_shapes = {s.shape for s in jax.tree.leaves(sliced_params)}
+    abstract = jax.eval_shape(optimizer.init, sliced_params)
+
+    def classify(leaf):
+        if leaf.ndim == 0:
+            return PartitionSpec()          # step counts, scalar hyperparams
+        if leaf.shape in slice_shapes:
+            return PartitionSpec(axis_name)  # moments etc. mirroring a slice
+        # Anything else (inject_hyperparams arrays, schedule tables, ...)
+        # is NOT derived from the sliced params: sharding it over the axis
+        # would silently split a replicated quantity. Refuse rather than
+        # guess.
+        raise ValueError(
+            f"zero_state_specs: optimizer state leaf of shape {leaf.shape} "
+            f"matches no sliced-param shape {sorted(slice_shapes)} and is "
+            "not a scalar; its sharding cannot be inferred. Keep such "
+            "state (e.g. optax.inject_hyperparams arrays, schedule "
+            "tables) as 0-d scalars, or compose that transformation "
+            "outside the ZeRO wrapper.")
+
+    return jax.tree.map(classify, abstract)
 
 
 def zero_sharded_optimizer(

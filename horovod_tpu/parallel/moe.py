@@ -9,9 +9,10 @@ dispatch mask, exchanged all-to-all so each device holds its expert's
 tokens from every peer, transformed, and exchanged back.
 
 Routing is top-k with capacity dropping (Switch for ``k=1``, GShard for
-``k=2``): per expert at most ``capacity = ceil(T/E * capacity_factor)``
-tokens survive; overflow tokens pass through with zero expert output (the
-standard residual-passthrough convention). The Switch load-balancing
+``k=2``): per expert at most ``capacity = ceil(k*T/E * capacity_factor)``
+assignments survive (scaled by ``k`` because top-k routing emits ``k*T``
+assignments in total); overflow tokens pass through with zero expert
+output (the standard residual-passthrough convention). The Switch load-balancing
 auxiliary loss is returned alongside the output.
 """
 
@@ -92,7 +93,12 @@ def _dispatch_masks(probs: jax.Array, capacity: int, num_selected: int,
 
 def _capacity(tokens: int, num_experts: int, capacity_factor: float,
               num_selected: int) -> int:
-    return max(int(-(-tokens * capacity_factor // num_experts)),
+    # GShard top-k convention: top-k routing emits k*T assignments, so
+    # capacity provisions k*T/E * factor slots per expert — otherwise even
+    # perfectly uniform top-2 routing would capacity-drop ~37% of
+    # assignments at the default capacity_factor of 1.25.
+    return max(int(-(-tokens * num_selected * capacity_factor
+                     // num_experts)),
                num_selected)
 
 

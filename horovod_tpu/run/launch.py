@@ -212,12 +212,15 @@ def discover_routable_addrs(hosts: List[str], ssh_port: int, secret: str,
                 # package import to enumerate its NICs.
                 remote = (f"env HOROVOD_SECRET_KEY={shlex.quote(secret)} "
                           f"python3 - {i} {driver_addrs}")
-                p = subprocess.Popen(
-                    ["ssh", "-o", "StrictHostKeyChecking=no",
-                     "-p", str(ssh_port), host, remote],
-                    stdin=open(task_fn_module.__file__),
-                    stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-                    text=True)
+                # close the script handle once Popen has dup'd it into the
+                # child — otherwise one fd leaks per remote host per run.
+                with open(task_fn_module.__file__) as script:
+                    p = subprocess.Popen(
+                        ["ssh", "-o", "StrictHostKeyChecking=no",
+                         "-p", str(ssh_port), host, remote],
+                        stdin=script,
+                        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                        text=True)
                 # Drain stderr continuously: a chatty remote interpreter
                 # must not wedge on a full pipe mid-protocol.
                 buf: List[str] = []

@@ -34,7 +34,8 @@ def _dense_reference(params, x, logits, k, capacity_factor):
     slot-filling order."""
     probs = np.asarray(jax.nn.softmax(logits, axis=-1))
     x = np.asarray(x)
-    capacity = max(int(np.ceil(T * capacity_factor / E)), k)
+    # GShard convention: capacity scales with k (top-k emits k*T assignments).
+    capacity = max(int(np.ceil(T * k * capacity_factor / E)), k)
     out = np.zeros_like(x)
     fill = np.zeros(E, np.int64)
     chosen = [[] for _ in range(T)]  # (expert, gate, kept)
@@ -104,6 +105,26 @@ def test_moe_capacity_drops_tokens():
         nonzero = np.abs(y[dev]).sum(axis=-1) > 1e-9
         assert nonzero.sum() == 1, nonzero
         assert nonzero[0]  # slot-filling keeps the earliest token
+
+
+def test_moe_top2_default_capacity_no_drops_at_uniform_routing():
+    """Capacity must provision k*T/E*factor slots: perfectly uniform top-2
+    routing at the default capacity_factor=1.25 must drop nothing. (Under
+    an unscaled T/E*factor capacity, ~37% of assignments would be dropped
+    here.)"""
+    params, x, _ = _setup(seed=3)
+    # Token t's top-1 is expert t%E, top-2 is (t+1)%E: every expert receives
+    # exactly 2T/E assignments.
+    logits_np = np.full((E, T, E), -10.0, np.float32)
+    for t in range(T):
+        logits_np[:, t, t % E] = 10.0
+        logits_np[:, t, (t + 1) % E] = 9.0
+    logits = jnp.asarray(logits_np)
+    y_default, _ = _run_moe(params, x, logits, k=2, capacity_factor=1.25)
+    y_ample, _ = _run_moe(params, x, logits, k=2, capacity_factor=float(E))
+    np.testing.assert_allclose(y_default, y_ample, rtol=1e-6, atol=1e-6)
+    # And nothing passed through as zeros.
+    assert (np.abs(y_default).sum(axis=-1) > 1e-9).all()
 
 
 def test_moe_bf16_routing_matches_f32_many_tokens():

@@ -163,3 +163,17 @@ def test_zero_scalar_param_leaf():
     # Every device applied the same full update to the scalar.
     assert float(p2["t"]) != 0.5
     hvd.shutdown()
+
+
+def test_zero_state_specs_rejects_unrecognized_array_leaf():
+    """State arrays not shaped like a param slice (schedule tables,
+    inject_hyperparams arrays) cannot be safely sharded over the axis —
+    zero_state_specs must refuse rather than silently mis-shard them."""
+    import pytest
+
+    params = {"w": jnp.zeros((FEATURES, 4))}
+    weird = optax.GradientTransformation(
+        init=lambda p: {"table": jnp.zeros((100,))},  # no slice is (100,)
+        update=lambda u, s, p=None: (u, s))
+    with pytest.raises(ValueError, match="cannot be inferred"):
+        zero_state_specs(weird, params, "data", N_DEV)
