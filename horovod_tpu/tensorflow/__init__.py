@@ -7,8 +7,11 @@ layer ``tensorflow/mpi_ops.cc``. The reference targets TF1 graph mode
 IndexedSlices→allgather sparse path, broadcast_variables,
 DistributedOptimizer, DistributedGradientTape), with collectives executed by
 the shared controller through ``tf.py_function`` so they work inside traced
-``tf.function`` graphs. ``BroadcastGlobalVariablesHook`` (TF1 sessions) has
-no TF2 equivalent surface; use ``broadcast_variables`` /
+``tf.function`` graphs. For migrating TF1 session code, the v1 surface is
+kept as a ``tf.compat.v1`` shim: ``broadcast_global_variables`` returns the
+grouped assign op and ``BroadcastGlobalVariablesHook`` is a
+``SessionRunHook`` (reference ``tensorflow/__init__.py:90-143``); TF2 eager
+users should prefer ``broadcast_variables`` /
 ``keras.callbacks.BroadcastGlobalVariablesCallback``.
 """
 
@@ -119,14 +122,57 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         v.assign(tf.convert_to_tensor(np.asarray(h.wait()), dtype=v.dtype))
 
 
-def broadcast_global_variables(root_rank: int = 0) -> None:
-    """TF1-compat name (reference ``tensorflow/__init__.py:90-98``): in TF2
-    there is no global collection; broadcast the trackable variables of the
-    current default strategy is not defined — prefer
-    ``broadcast_variables(model.variables)``."""
-    raise NotImplementedError(
-        "TF2 has no global-variables collection; call "
-        "hvd.broadcast_variables(model.variables, root_rank) instead")
+def _broadcast_group_op(variables, root_rank: int):
+    """Grouped assign op: every variable takes root's value. Graph-mode
+    analogue of :func:`broadcast_variables` (the reference builds the same
+    ``tf.group`` of assigns, ``tensorflow/__init__.py:100-109``)."""
+    return tf.group(*[
+        v.assign(broadcast(v, root_rank=root_rank,
+                           name=f"broadcast.gvar.{i}"))
+        for i, v in enumerate(variables)
+    ])
+
+
+def broadcast_global_variables(root_rank: int = 0):
+    """TF1-compat (reference ``tensorflow/__init__.py:90-98``): broadcast
+    the ``tf.compat.v1`` global-variables collection from ``root_rank``,
+    returning the grouped assign op to run in your session. Only meaningful
+    under the v1 graph stack — TF2 eager has no global collection; call
+    ``broadcast_variables(model.variables, root_rank)`` there."""
+    gvars = tf.compat.v1.global_variables()
+    if tf.executing_eagerly() or not gvars:
+        raise NotImplementedError(
+            "no tf.compat.v1 global-variables collection is active; in "
+            "TF2 eager call hvd.broadcast_variables(model.variables, "
+            "root_rank) instead (session users: build the model inside a "
+            "tf.compat.v1 graph so variables register in the collection, "
+            "or use hvd.BroadcastGlobalVariablesHook)")
+    return _broadcast_group_op(gvars, root_rank)
+
+
+class BroadcastGlobalVariablesHook(tf.compat.v1.train.SessionRunHook):
+    """``SessionRunHook`` broadcasting all global variables from
+    ``root_rank`` when the session is created — the TF1 checkpoint/resume
+    consistency contract (reference ``tensorflow/__init__.py:112-143``).
+
+    ``device`` is accepted for signature parity and ignored: collective
+    placement is the controller's concern here, not a graph device string.
+    """
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device
+
+    def begin(self):
+        # Rebuild if a new graph is active (reference :130-134).
+        if (self.bcast_op is None
+                or self.bcast_op.graph is not tf.compat.v1.get_default_graph()):
+            self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
 
 
 class DistributedGradientTape(tf.GradientTape):

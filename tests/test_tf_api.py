@@ -87,3 +87,43 @@ def test_compression_tf():
     c, ctx = hvd.Compression.fp16.compress(x)
     assert c.dtype == tf.float16
     assert hvd.Compression.fp16.decompress(c, ctx).dtype == tf.float32
+
+
+def test_broadcast_global_variables_eager_raises():
+    hvd.init()
+    with pytest.raises(NotImplementedError, match="broadcast_variables"):
+        hvd.broadcast_global_variables(0)
+
+
+def test_tf1_broadcast_global_variables_hook():
+    """TF1-compat shim (reference tensorflow/__init__.py:90-143): inside a
+    v1 graph + session, the hook broadcasts the global-variables collection
+    at session creation. At size 1 broadcast is identity, so the check is
+    that the op builds, runs, and leaves values intact."""
+    hvd.init()
+    graph = tf.Graph()
+    with graph.as_default():
+        v = tf.compat.v1.get_variable(
+            "hook_var", initializer=tf.constant([1.5, -2.0]))
+        hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
+        hook.begin()
+        assert hook.bcast_op is not None
+        assert hook.bcast_op.graph is graph
+        init_op = tf.compat.v1.global_variables_initializer()
+        with tf.compat.v1.Session(graph=graph) as sess:
+            sess.run(init_op)
+            hook.after_create_session(sess, None)
+            np.testing.assert_allclose(sess.run(v), [1.5, -2.0])
+
+
+def test_tf1_broadcast_global_variables_op_rebuilt_per_graph():
+    hvd.init()
+    hook = hvd.BroadcastGlobalVariablesHook(root_rank=0)
+    with tf.Graph().as_default():
+        tf.compat.v1.get_variable("g1_var", initializer=tf.constant(1.0))
+        hook.begin()
+        op1 = hook.bcast_op
+    with tf.Graph().as_default():
+        tf.compat.v1.get_variable("g2_var", initializer=tf.constant(2.0))
+        hook.begin()
+        assert hook.bcast_op is not op1
