@@ -540,52 +540,56 @@ class Engine {
 
   // Tensor Fusion packing (reference FuseResponses, operations.cc:450-573):
   // join ALLREDUCE responses of equal dtype while the fused byte count stays
-  // under the threshold, with look-ahead past mismatched dtypes.
+  // under the threshold, with look-ahead past mismatched dtypes. dtype/bytes
+  // are snapshotted under ONE mu_ acquisition for the whole cycle — the old
+  // per-candidate response_dtype()/response_bytes() helpers took the lock
+  // O(n^2) times exactly when fusion matters (hundreds of small tensors).
   std::vector<Response> fuse_responses(std::vector<Response> responses) {
+    struct Pending {
+      Response r;
+      uint8_t dtype = 0;
+      long long bytes = 0;
+    };
+    std::deque<Pending> pending;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (auto& r : responses) {
+        Pending p;
+        if (r.response_type == RESP_ALLREDUCE) {
+          p.dtype = table_.at(r.tensor_names[0]).request.dtype;
+          for (const auto& name : r.tensor_names)
+            p.bytes += (long long)table_.at(name).data.size();
+        }
+        p.r = std::move(r);
+        pending.push_back(std::move(p));
+      }
+    }
     std::vector<Response> out;
-    std::deque<Response> pending(
-        std::make_move_iterator(responses.begin()),
-        std::make_move_iterator(responses.end()));
     while (!pending.empty()) {
-      Response first = std::move(pending.front());
+      Pending first = std::move(pending.front());
       pending.pop_front();
-      if (first.response_type != RESP_ALLREDUCE) {
-        out.push_back(std::move(first));
+      if (first.r.response_type != RESP_ALLREDUCE) {
+        out.push_back(std::move(first.r));
         continue;
       }
-      uint8_t dtype = response_dtype(first);
-      long long total = response_bytes(first);
+      long long total = first.bytes;
       for (size_t i = 0; i < pending.size();) {
-        Response& cand = pending[i];
-        if (cand.response_type == RESP_ALLREDUCE &&
-            response_dtype(cand) == dtype) {
-          long long nbytes = response_bytes(cand);
-          if (total + nbytes <= fusion_threshold_) {
-            for (auto& n : cand.tensor_names)
-              first.tensor_names.push_back(std::move(n));
-            total += nbytes;
+        Pending& cand = pending[i];
+        if (cand.r.response_type == RESP_ALLREDUCE &&
+            cand.dtype == first.dtype) {
+          if (total + cand.bytes <= fusion_threshold_) {
+            for (auto& n : cand.r.tensor_names)
+              first.r.tensor_names.push_back(std::move(n));
+            total += cand.bytes;
             pending.erase(pending.begin() + (long)i);
             continue;
           }
         }
         i++;  // look-ahead (reference operations.cc:483-499)
       }
-      out.push_back(std::move(first));
+      out.push_back(std::move(first.r));
     }
     return out;
-  }
-
-  uint8_t response_dtype(const Response& r) {
-    std::lock_guard<std::mutex> g(mu_);
-    return table_.at(r.tensor_names[0]).request.dtype;
-  }
-
-  long long response_bytes(const Response& r) {
-    std::lock_guard<std::mutex> g(mu_);
-    long long total = 0;
-    for (const auto& name : r.tensor_names)
-      total += (long long)table_.at(name).data.size();
-    return total;
   }
 
   // Reference CheckForStalledTensors (operations.cc:688-769).
