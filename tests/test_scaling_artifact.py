@@ -1,0 +1,42 @@
+"""Scaling-efficiency harness (examples/scaling_efficiency.py): the curve
+artifact the driver archives each round must keep its shape — parseable
+JSON, power-of-two sizes up to the device count, positive rates, efficiency
+consistent with the rates and non-increasing in world size (on the shared-
+core CPU box efficiency is ~1/n by construction; real numbers need chips)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_scaling_harness_curve_shape():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples",
+                                      "scaling_efficiency.py"),
+         "--model", "mlp", "--steps", "5", "--warmup", "2",
+         "--batch-per-chip", "32"],
+        env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, out.stderr[-2000:]
+    record = json.loads(out.stdout.strip().splitlines()[-1])
+
+    assert record["metric"] == "scaling_efficiency"
+    sizes = record["sizes"]
+    assert sizes == [1, 2, 4, 8]
+    rates = {int(k): v for k, v in record["img_sec"].items()}
+    eff = {int(k): v for k, v in record["efficiency"].items()}
+    assert all(rates[n] > 0 for n in sizes)
+    # Efficiency must be rates-consistent...
+    for n in sizes:
+        expected = rates[n] / (n * rates[1])
+        assert abs(eff[n] - expected) < 1e-3, (n, eff[n], expected)
+    # ...anchored at 1 for n=1, and non-increasing in n (true on real chips
+    # up to noise and by construction on shared host cores).
+    assert eff[1] == 1.0
+    for a, b in zip(sizes, sizes[1:]):
+        assert eff[b] <= eff[a] * 1.1, (a, b, eff)
