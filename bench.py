@@ -316,7 +316,14 @@ def supervisor():
         # phase/rc come from the failure class named by `error`; the other
         # tier's last failure (if any) rides along so interleavings like
         # "attempt failed, then pool went down" stay fully attributed.
-        src = last_bench if error == "bench_failed" else last_probe
+        # supervisor_killed prefers the bench attempt's diagnostics when one
+        # ran (a SIGTERM during backoff must not erase a known phase).
+        if error == "bench_failed":
+            src = last_bench
+        elif error == "supervisor_killed":
+            src = last_bench if last_bench is not None else last_probe
+        else:
+            src = last_probe
         record = {
             "metric": METRIC, "value": None, "unit": UNIT,
             "vs_baseline": None, "error": error,
@@ -363,9 +370,10 @@ def supervisor():
             sys.stderr.write(
                 f"bench.py: probe failed (rc={rc}, phase={phase}); "
                 f"backing off {backoff}s\n")
-            # A clean non-zero exit (traceback, bad env) is deterministic:
+            # A clean exit without a usable result — rc>0 (traceback, bad
+            # env) or rc==0 with unparseable output — is deterministic:
             # retrying for half an hour can't fix an ImportError.
-            if rc is not None and rc > 0:
+            if rc is not None and rc >= 0:
                 deterministic_probe_failures += 1
                 if deterministic_probe_failures >= 2:
                     if err:
@@ -398,9 +406,10 @@ def supervisor():
             f"bench.py: attempt {attempts} failed (rc={rc}, phase={phase})\n")
         if err:
             sys.stderr.write(err + "\n")
-        # Same 2-strike rule as the probe: a clean non-zero exit is a code
-        # bug, not a pool transient — don't spend the budget re-proving it.
-        if rc is not None and rc > 0:
+        # Same 2-strike rule as the probe: a clean exit without a usable
+        # result is a code bug, not a pool transient — don't spend the
+        # budget re-proving it.
+        if rc is not None and rc >= 0:
             deterministic_bench_failures += 1
             if deterministic_bench_failures >= 2:
                 emit_failure("bench_failed")
