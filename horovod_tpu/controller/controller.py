@@ -224,12 +224,26 @@ class Controller:
 
     def allreduce_async(self, tensor, average: bool = True,
                         name: Optional[str] = None, compression=None,
-                        wrap: Optional[Callable] = None) -> Handle:
+                        wrap: Optional[Callable] = None,
+                        inplace: bool = False) -> Handle:
+        """``inplace=True``: the result is written back into ``tensor``'s
+        memory and ``tensor`` is the resolved value. The star transport
+        inherently stages through pickled messages, so this is emulated
+        with one final copy (the native engine does it with zero copies —
+        same API either way)."""
         array = np.asarray(tensor)
+        if inplace and (not array.flags.writeable
+                        or not array.flags.c_contiguous):
+            h = self.handles.allocate()
+            h.set_error(ValueError(
+                "in-place allreduce requires a writable C-contiguous array"))
+            return h
         ctx = None
         if compression is not None:
             compressed, ctx = compression.compress(array)
-            array = np.asarray(compressed)
+            array_in = np.asarray(compressed)
+        else:
+            array_in = array
 
         size = self.topo.size
 
@@ -240,9 +254,13 @@ class Controller:
                 # bool reduces as logical OR (MPI_LOR); "average" has no
                 # meaning there and must not promote to float.
                 out = out / size
+            if inplace:
+                np.copyto(array, out, casting="unsafe")
+                out = array
             return wrap(out) if wrap is not None else out
 
-        return self._enqueue("allreduce", name, array, RequestType.ALLREDUCE,
+        return self._enqueue("allreduce", name, array_in,
+                             RequestType.ALLREDUCE,
                              average=average, postprocess=post)
 
     def allgather_async(self, tensor, name: Optional[str] = None,
@@ -252,10 +270,25 @@ class Controller:
 
     def broadcast_async(self, tensor, root_rank: int,
                         name: Optional[str] = None,
-                        wrap: Optional[Callable] = None) -> Handle:
-        return self._enqueue("broadcast", name, np.asarray(tensor),
+                        wrap: Optional[Callable] = None,
+                        inplace: bool = False) -> Handle:
+        array = np.asarray(tensor)
+        if inplace and (not array.flags.writeable
+                        or not array.flags.c_contiguous):
+            h = self.handles.allocate()
+            h.set_error(ValueError(
+                "in-place broadcast requires a writable C-contiguous array"))
+            return h
+
+        def post(out: np.ndarray):
+            if inplace:
+                np.copyto(array, out, casting="unsafe")
+                out = array
+            return wrap(out) if wrap is not None else out
+
+        return self._enqueue("broadcast", name, array,
                              RequestType.BROADCAST, root_rank=root_rank,
-                             postprocess=wrap)
+                             postprocess=post)
 
     def allreduce(self, tensor, average: bool = True,
                   name: Optional[str] = None, compression=None,

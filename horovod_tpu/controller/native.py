@@ -4,10 +4,14 @@ The Python ``Controller`` (controller.py) keeps the negotiation/fusion/cache
 machine in Python over a TCP star. This twin drives the C++ engine
 (``core/src/engine.cc``) instead, the way the reference's Python layer drives
 ``horovod/common/operations.cc`` over ctypes (``common/basics.py:20-28``):
-enqueue copies the host buffer into the engine, the engine's background
-thread negotiates/fuses/executes over the authenticated TCP ring (control
-token + data phases on the same connections), and completion surfaces
-through int handles (reference ``torch/handle_manager.h``).
+enqueue hands the engine a POINTER to the caller-owned host buffer (zero
+copy — the handle pins the array, like the reference's ``_handle_map``),
+the engine's background thread negotiates/fuses/executes over the
+authenticated TCP ring (control token + data phases on the same
+connections) reducing in place on that memory, and completion surfaces
+through int handles (reference ``torch/handle_manager.h``). Value-semantics
+APIs make exactly ONE defensive copy up front so the caller's array is
+never mutated; the in-place APIs (``inplace=True``) make none.
 
 Python keeps the parts that belong to the API layer, exactly as the
 reference does: averaging as a post-divide (``torch/mpi_ops_v2.cc:66-72``),
@@ -43,18 +47,27 @@ _SHUTDOWN_MSG = "Horovod has been shut down"
 class NativeHandle:
     """Handle over an engine operation. API-compatible with
     ``common.handles.Handle`` (wait/done), so ``hvd.synchronize``/``poll``
-    work unchanged."""
+    work unchanged.
 
-    __slots__ = ("_ctl", "_id", "_postprocess", "_result", "_error", "_taken")
+    ``_buffer`` pins the numpy array whose memory the engine reads — and,
+    for allreduce/broadcast, writes the result into (zero-copy; the
+    reference's ``_handle_map`` keeps tensors alive the same way,
+    ``torch/mpi_ops.py:54``). It must stay referenced until the handle is
+    resolved and released."""
+
+    __slots__ = ("_ctl", "_id", "_postprocess", "_result", "_error",
+                 "_taken", "_buffer")
 
     def __init__(self, ctl: "NativeController", handle_id: int,
-                 postprocess: Optional[Callable[[np.ndarray], Any]]):
+                 postprocess: Optional[Callable[[np.ndarray], Any]],
+                 buffer: Optional[np.ndarray] = None):
         self._ctl = ctl
         self._id = handle_id
         self._postprocess = postprocess
         self._result = None
         self._error: Optional[BaseException] = None
         self._taken = False
+        self._buffer = buffer
 
     @classmethod
     def failed(cls, exc: BaseException) -> "NativeHandle":
@@ -65,6 +78,7 @@ class NativeHandle:
         h._result = None
         h._error = exc
         h._taken = True
+        h._buffer = None
         return h
 
     def done(self) -> bool:
@@ -91,16 +105,23 @@ class NativeHandle:
                     f"handle {self._id} not complete after {timeout}s")
         try:
             if rc == 0:
-                ndim = lib.hvd_eng_result_ndim(self._id)
-                shape_arr = (ctypes.c_longlong * max(ndim, 1))()
-                lib.hvd_eng_result_shape(self._id, shape_arr)
-                shape = tuple(shape_arr[i] for i in range(ndim))
-                dtype = bindings.dtype_from_code(
-                    lib.hvd_eng_result_dtype(self._id))
-                out = np.empty(shape, dtype=dtype)
-                if out.nbytes:
-                    lib.hvd_eng_result_copy(
-                        self._id, out.ctypes.data_as(ctypes.c_void_p))
+                if lib.hvd_eng_result_in_place(self._id):
+                    # allreduce/broadcast: the engine reduced/received
+                    # directly in the enqueued buffer — no result copy.
+                    out = self._buffer
+                else:
+                    # allgather: the output shape is only known after
+                    # negotiation; one copy out of the slot.
+                    ndim = lib.hvd_eng_result_ndim(self._id)
+                    shape_arr = (ctypes.c_longlong * max(ndim, 1))()
+                    lib.hvd_eng_result_shape(self._id, shape_arr)
+                    shape = tuple(shape_arr[i] for i in range(ndim))
+                    dtype = bindings.dtype_from_code(
+                        lib.hvd_eng_result_dtype(self._id))
+                    out = np.empty(shape, dtype=dtype)
+                    if out.nbytes:
+                        lib.hvd_eng_result_copy(
+                            self._id, out.ctypes.data_as(ctypes.c_void_p))
                 if self._postprocess is not None:
                     out = self._postprocess(out)
                 self._result = out
@@ -116,6 +137,7 @@ class NativeHandle:
         finally:
             lib.hvd_eng_release(self._id)
             self._taken = True
+            self._ctl._unpin(self._id)
 
 
 class NativeController:
@@ -131,6 +153,13 @@ class NativeController:
         self.topo = topology
         self._lock = threading.Lock()
         self._autoname_counter: Dict[str, int] = {}
+        # Buffers the C++ engine holds raw pointers into, keyed by engine
+        # handle id. The NativeHandle also references its buffer, but a
+        # caller may drop the handle without waiting — pinning here keeps
+        # the memory alive for the background thread regardless (the
+        # reference's _handle_map contract, torch/mpi_ops.py:54). Entries
+        # for never-waited handles stay pinned for the controller's life.
+        self._pinned: Dict[int, np.ndarray] = {}
         self._shut = False
 
         ring_addrs = os.environ.get("HOROVOD_RING_ADDRS", "")
@@ -169,6 +198,10 @@ class NativeController:
 
     # ------------------------------------------------------------------ API
 
+    def _unpin(self, handle_id: int) -> None:
+        with self._lock:
+            self._pinned.pop(handle_id, None)
+
     def _autoname(self, kind: str, name: Optional[str]) -> str:
         if name is not None:
             return name
@@ -179,12 +212,29 @@ class NativeController:
 
     def _enqueue(self, kind: str, name: Optional[str], array,
                  root_rank: int = -1,
-                 postprocess: Optional[Callable] = None) -> NativeHandle:
+                 postprocess: Optional[Callable] = None,
+                 inplace: bool = False) -> NativeHandle:
+        """Zero-copy enqueue: the engine reads — and for allreduce /
+        broadcast WRITES the result — directly in ``array``'s memory; the
+        handle pins the array until completion.
+
+        ``inplace=False`` (value semantics): the input is defensively
+        copied ONCE here, so the caller's array is never mutated and may be
+        reused immediately — the engine then works on our private copy,
+        which becomes the result. ``inplace=True``: ``array`` itself is the
+        target (caller-owned, writable, alive until the handle resolves —
+        the reference's in-place contract, torch/mpi_ops.py:156-176)."""
         name = self._autoname(kind, name)
         array = np.asarray(array)
-        if not array.flags.c_contiguous:
-            # ascontiguousarray promotes 0-d to 1-d; preserve the shape.
-            array = np.ascontiguousarray(array).reshape(array.shape)
+        if inplace and kind != "allgather" and (
+                not array.flags.c_contiguous or not array.flags.writeable):
+            return NativeHandle.failed(ValueError(
+                f"in-place {kind} requires a writable C-contiguous array"))
+        if not inplace:
+            # One defensive copy (also guarantees contiguity + ownership);
+            # replaces the engine-side enqueue copy, the fused copy-out and
+            # the ctypes result copy of the old 4-copy path.
+            array = np.array(array, order="C", copy=True)
         code = bindings.RingBackend.dtype_code(array.dtype)
         if code is None:
             return NativeHandle.failed(RuntimeError(
@@ -206,16 +256,34 @@ class NativeController:
             from .controller import ShutdownError
 
             return NativeHandle.failed(ShutdownError(_SHUTDOWN_MSG))
-        return NativeHandle(self, h, postprocess)
+        with self._lock:
+            self._pinned[h] = array
+        return NativeHandle(self, h, postprocess, buffer=array)
 
     def allreduce_async(self, tensor, average: bool = True,
                         name: Optional[str] = None, compression=None,
-                        wrap: Optional[Callable] = None) -> NativeHandle:
-        array = np.asarray(tensor)
+                        wrap: Optional[Callable] = None,
+                        inplace: bool = False) -> NativeHandle:
+        """``inplace=True``: ``tensor`` must be a writable C-contiguous
+        numpy array (or a view of framework memory, e.g. a torch CPU
+        tensor's ``.numpy()`` view); the reduced — and averaged — result
+        lands in that memory with zero copies."""
+        orig = np.asarray(tensor)
         ctx = None
         if compression is not None:
-            compressed, ctx = compression.compress(array)
+            # A dtype-changing compressor returns a fresh temporary we own:
+            # enqueue it in-place (no defensive copy) — decompress rebuilds
+            # the caller-facing result. Compression.none returns the input
+            # ALIASED, so only skip the defensive copy when the compressed
+            # array provably doesn't share the caller's memory — UNLESS the
+            # caller itself asked for in-place, where mutating the alias is
+            # the contract.
+            compressed, ctx = compression.compress(orig)
             array = np.asarray(compressed)
+            enqueue_inplace = inplace or not np.may_share_memory(array, orig)
+        else:
+            array = orig
+            enqueue_inplace = inplace
         size = self.topo.size
 
         def post(out, _ctx=ctx, _compression=compression):
@@ -224,10 +292,29 @@ class NativeController:
             if average and out.dtype != np.bool_:
                 # bool reduces as logical OR (MPI_LOR); "average" has no
                 # meaning there and must not promote to float.
-                out = out / size
+                if out.dtype.kind == "f":
+                    # Every path owns `out` (the caller's buffer under the
+                    # in-place contract, our defensive copy, or the
+                    # decompress temporary): divide without another
+                    # allocation.
+                    np.divide(out, size, out=out)
+                elif inplace and out is orig:
+                    # Integer in-place: float temporary, truncate-cast back
+                    # — the reference's output.div_(size) end state
+                    # (torch/mpi_ops_v2.cc:66-72).
+                    np.copyto(out, out / size, casting="unsafe")
+                else:
+                    out = out / size  # int value semantics promote to float
+            if inplace and out is not orig:
+                # Compression built a fresh array: honor the in-place
+                # contract by landing it in the caller's buffer (matches
+                # the star controller).
+                np.copyto(orig, out, casting="unsafe")
+                out = orig
             return wrap(out) if wrap is not None else out
 
-        return self._enqueue("allreduce", name, array, postprocess=post)
+        return self._enqueue("allreduce", name, array, postprocess=post,
+                             inplace=enqueue_inplace)
 
     def allgather_async(self, tensor, name: Optional[str] = None,
                         wrap: Optional[Callable] = None) -> NativeHandle:
@@ -236,9 +323,11 @@ class NativeController:
 
     def broadcast_async(self, tensor, root_rank: int,
                         name: Optional[str] = None,
-                        wrap: Optional[Callable] = None) -> NativeHandle:
+                        wrap: Optional[Callable] = None,
+                        inplace: bool = False) -> NativeHandle:
         return self._enqueue("broadcast", name, np.asarray(tensor),
-                             root_rank=root_rank, postprocess=wrap)
+                             root_rank=root_rank, postprocess=wrap,
+                             inplace=inplace)
 
     def allreduce(self, tensor, average: bool = True,
                   name: Optional[str] = None, compression=None,

@@ -108,7 +108,8 @@ def build() -> str:
             os.path.join(_SRC_DIR, f) for f in os.listdir(_SRC_DIR)
             if f.endswith(".cc"))
         tmp_path = f"{lib_path}.tmp.{os.getpid()}"
-        cmd = ["g++", *_CXX_FLAGS, *sources, "-o", tmp_path]
+        # -lrt: shm_open lives in librt on pre-2.34 glibc (no-op on newer).
+        cmd = ["g++", *_CXX_FLAGS, *sources, "-o", tmp_path, "-lrt"]
         logging.debug("building native core: %s", " ".join(cmd))
         try:
             result = subprocess.run(cmd, capture_output=True, text=True)
@@ -202,6 +203,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_eng_result_ndim.restype = ctypes.c_int
         lib.hvd_eng_result_dtype.argtypes = [ctypes.c_longlong]
         lib.hvd_eng_result_dtype.restype = ctypes.c_int
+        lib.hvd_eng_result_in_place.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_result_in_place.restype = ctypes.c_int
         lib.hvd_eng_result_shape.argtypes = [
             ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong)]
         lib.hvd_eng_result_shape.restype = None

@@ -42,8 +42,18 @@
 #include "response_cache.h"
 #include "timeline.h"
 
-// Ring data plane C ABI (ring.cc).
+#include "sha256.h"
+
+// Ring data plane C ABI (ring.cc) + /dev/shm local data plane (shm.cc).
 extern "C" {
+void* hvd_shm_create(int local_rank, int local_size, const char* name,
+                     long slot_bytes);
+int hvd_shm_allreduce_g(void* h, void* buf, long count, int dtype);
+int hvd_shm_broadcast_g(void* h, void* buf, long count, int dtype, int root);
+int hvd_shm_allgather_g(void* h, const void* in, const long* counts,
+                        void* out, int dtype);
+void hvd_shm_destroy(void* h);
+const char* hvd_shm_last_error();
 int hvd_ring_init(int rank, int size, const char* addrs, const uint8_t* secret,
                   int secret_len);
 int hvd_ring_allreduce(void* buf, long count, int dtype, int average);
@@ -122,14 +132,25 @@ struct HandleSlot {
   int status = 0;  // 0 pending, 1 ok, 2 error
   std::string error;
   uint8_t dtype = 0;
+  // Result landed in the caller's own buffer (allreduce/broadcast): data
+  // stays empty and the Python side returns the array it enqueued.
+  bool in_place = false;
   std::vector<int64_t> shape;
   std::vector<uint8_t> data;
 };
 
 // Tensor-table entry (reference TensorTableEntry, common/common.h:167-184).
+// ZERO-COPY CONTRACT: `user` points at the caller-owned buffer passed to
+// enqueue. The caller (native.py keeps the numpy array referenced on the
+// handle) guarantees it stays alive and un-mutated until the handle
+// resolves; the engine reads from it and — for allreduce/broadcast —
+// writes the result back into it, the way the reference reduces in place
+// on framework-owned memory (mpi_operations.cc:40-49, torch
+// _handle_map keeping tensors alive, torch/mpi_ops.py:54).
 struct Entry {
   Request request;
-  std::vector<uint8_t> data;
+  uint8_t* user = nullptr;
+  size_t nbytes = 0;
   long long handle = -1;
 };
 
@@ -198,8 +219,9 @@ class EngineError : public std::runtime_error {
 // so the rings must exist first). Analogue of the reference's
 // NCCLHierarchicalAllreduce comm pair (nccl_operations.cc:167-363).
 struct HierState {
-  void* local_ring = nullptr;  // ring inside this node
+  void* local_ring = nullptr;  // TCP ring inside this node (shm fallback)
   void* cross_ring = nullptr;  // ring of local roots (local_rank 0 only)
+  void* shm = nullptr;         // /dev/shm local group (preferred local plane)
   int local_rank = 0, local_size = 1, cross_rank = 0, cross_size = 1;
   bool allreduce = false, allgather = false;
 };
@@ -236,7 +258,7 @@ class Engine {
   // ------------------------------------------------------- enqueue (any thread)
 
   // Returns handle >= 0; -2 duplicate name; -3 shut down.
-  long long enqueue(uint8_t op, const std::string& name, const void* data,
+  long long enqueue(uint8_t op, const std::string& name, void* data,
                     const int64_t* shape, int ndim, uint8_t dtype,
                     int32_t root_rank) {
     std::lock_guard<std::mutex> g(mu_);
@@ -251,9 +273,8 @@ class Engine {
     e.request.tensor_name = name;
     size_t count = 1;
     for (int i = 0; i < ndim; i++) count *= (size_t)shape[i];
-    size_t nbytes = count * dtype_size(dtype);
-    e.data.resize(nbytes);
-    if (nbytes) std::memcpy(e.data.data(), data, nbytes);
+    e.nbytes = count * dtype_size(dtype);
+    e.user = (uint8_t*)data;  // zero-copy: see Entry's contract note
     long long h = next_handle_++;
     e.handle = h;
     handles_.emplace(h, HandleSlot{});
@@ -349,7 +370,7 @@ class Engine {
   // True when the two-level data plane is active (test/introspection seam;
   // the Python controller exposes its rings the same way).
   bool hier_active() const {
-    return hier_.local_ring != nullptr &&
+    return (hier_.local_ring != nullptr || hier_.shm != nullptr) &&
            (hier_.allreduce || hier_.allgather);
   }
 
@@ -384,7 +405,8 @@ class Engine {
     if (size_ > 1) hvd_ring_shutdown();
     if (hier_.local_ring) hvd_ringh_destroy(hier_.local_ring);
     if (hier_.cross_ring) hvd_ringh_destroy(hier_.cross_ring);
-    hier_.local_ring = hier_.cross_ring = nullptr;
+    if (hier_.shm) hvd_shm_destroy(hier_.shm);
+    hier_.local_ring = hier_.cross_ring = hier_.shm = nullptr;
     if (timeline_) timeline_->close();
   }
 
@@ -558,7 +580,7 @@ class Engine {
         if (r.response_type == RESP_ALLREDUCE) {
           p.dtype = table_.at(r.tensor_names[0]).request.dtype;
           for (const auto& name : r.tensor_names)
-            p.bytes += (long long)table_.at(name).data.size();
+            p.bytes += (long long)table_.at(name).nbytes;
         }
         p.r = std::move(r);
         pending.push_back(std::move(p));
@@ -772,33 +794,41 @@ class Engine {
     it->second.data = std::move(data);
   }
 
+  // Result already lives in the caller's buffer: no bytes cross the ABI.
+  void complete_in_place(Entry* e) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = handles_.find(e->handle);
+    if (it == handles_.end()) return;
+    it->second.status = 1;
+    it->second.dtype = e->request.dtype;
+    it->second.shape = e->request.shape;
+    it->second.in_place = true;
+  }
+
   long long execute_allreduce(std::vector<Entry*>& entries,
                               const std::string& tname) {
     uint8_t dtype = entries[0]->request.dtype;
     size_t esz = dtype_size(dtype);
     size_t total_bytes = 0;
-    for (Entry* e : entries) total_bytes += e->data.size();
+    for (Entry* e : entries) total_bytes += e->nbytes;
 
     if (entries.size() == 1) {
-      // Unfused: reduce in place on the entry's own contiguous copy and
-      // hand the buffer to the handle — no fusion-buffer staging (the
-      // reference likewise reduces unfused entries in place,
-      // mpi_operations.cc:40-49).
+      // Unfused: reduce in place directly on the caller's buffer — zero
+      // staging copies (the reference likewise reduces unfused entries in
+      // place, mpi_operations.cc:40-49).
       Entry* e = entries[0];
       if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
       if (size_ > 1) {
-        if (hier_.allreduce && hier_.local_ring) {
-          hier_ring_allreduce(e->data.data(), (long)(total_bytes / esz),
-                              dtype);
-        } else if (hvd_ring_allreduce(e->data.data(),
-                                      (long)(total_bytes / esz), dtype,
-                                      0) != 0) {
+        if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
+          hier_ring_allreduce(e->user, (long)(total_bytes / esz), dtype);
+        } else if (hvd_ring_allreduce(e->user, (long)(total_bytes / esz),
+                                      dtype, 0) != 0) {
           throw EngineError(std::string("ring allreduce failed: ") +
                             hvd_ring_last_error());
         }
       }
       if (timeline_) timeline_->activity_end(tname);
-      complete(e, e->request.shape, std::move(e->data));
+      complete_in_place(e);
       return (long long)total_bytes;
     }
 
@@ -816,15 +846,15 @@ class Engine {
     if (timeline_) timeline_->activity_start(tname, "MEMCPY_IN_FUSION_BUFFER");
     size_t off = 0;
     for (Entry* e : entries) {
-      std::memcpy(fusion_buffer_.data() + off, e->data.data(), e->data.size());
-      off += e->data.size();
+      std::memcpy(fusion_buffer_.data() + off, e->user, e->nbytes);
+      off += e->nbytes;
     }
     if (timeline_) {
       timeline_->activity_end(tname);
       timeline_->activity_start(tname, "TCP_COLLECTIVE");
     }
     if (size_ > 1) {
-      if (hier_.allreduce && hier_.local_ring) {
+      if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
         hier_ring_allreduce(fusion_buffer_.data(),
                             (long)(total_bytes / esz), dtype);
       } else if (hvd_ring_allreduce(fusion_buffer_.data(),
@@ -838,20 +868,35 @@ class Engine {
       timeline_->activity_end(tname);
       timeline_->activity_start(tname, "MEMCPY_OUT_FUSION_BUFFER");
     }
+    // Unpack straight back into the caller buffers — the old path staged
+    // through per-entry vectors plus a ctypes copy on the Python side.
     off = 0;
     for (Entry* e : entries) {
-      std::vector<uint8_t> out(e->data.size());
-      std::memcpy(out.data(), fusion_buffer_.data() + off, out.size());
-      off += out.size();
-      complete(e, e->request.shape, std::move(out));
+      std::memcpy(e->user, fusion_buffer_.data() + off, e->nbytes);
+      off += e->nbytes;
+      complete_in_place(e);
     }
     if (timeline_) timeline_->activity_end(tname);
     return (long long)total_bytes;
   }
 
-  // Two-level allreduce: sum inside the node, exchange node sums across the
-  // local roots' cross ring, fan back out locally.
+  // Two-level allreduce: sum inside the node (through /dev/shm when
+  // active, TCP local ring otherwise), exchange node sums across the local
+  // roots' cross ring, fan back out locally.
   void hier_ring_allreduce(void* buf, long count, uint8_t dtype) {
+    if (hier_.shm) {
+      if (hvd_shm_allreduce_g(hier_.shm, buf, count, dtype) != 0)
+        throw EngineError(std::string("shm local allreduce failed: ") +
+                          hvd_shm_last_error());
+      if (hier_.local_rank == 0 &&
+          hvd_ringh_allreduce(hier_.cross_ring, buf, count, dtype, 0) != 0)
+        throw EngineError(std::string("cross ring allreduce failed: ") +
+                          hvd_ring_last_error());
+      if (hvd_shm_broadcast_g(hier_.shm, buf, count, dtype, 0) != 0)
+        throw EngineError(std::string("shm local broadcast failed: ") +
+                          hvd_shm_last_error());
+      return;
+    }
     if (hvd_ringh_allreduce(hier_.local_ring, buf, count, dtype, 0) != 0)
       throw EngineError(std::string("local ring allreduce failed: ") +
                         hvd_ring_last_error());
@@ -880,22 +925,29 @@ class Engine {
     std::vector<uint8_t> out((size_t)total_elems * esz);
     if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
     if (size_ > 1) {
-      if (hier_.allgather && hier_.local_ring) {
-        // Two-level: gather inside the node, local roots exchange node
-        // blobs, fan the full result back out (MPIHierarchicalAllgather
-        // shape, mpi_operations.cc:179-329; contiguous rank grouping makes
-        // node order == rank order).
+      if (hier_.allgather && (hier_.local_ring || hier_.shm)) {
+        // Two-level: gather inside the node (shm slots or TCP local ring),
+        // local roots exchange node blobs, fan the full result back out
+        // (MPIHierarchicalAllgather shape, mpi_operations.cc:179-329 — the
+        // shm path IS its MPI_Win_allocate_shared window; contiguous rank
+        // grouping makes node order == rank order).
         int ls = hier_.local_size, cr = hier_.cross_rank;
         std::vector<long> local_counts(counts.begin() + (size_t)cr * ls,
                                        counts.begin() + (size_t)(cr + 1) * ls);
         long long local_elems = 0;
         for (long c : local_counts) local_elems += c;
         std::vector<uint8_t> local_out((size_t)local_elems * esz);
-        if (hvd_ringh_allgather(hier_.local_ring, e.data.data(),
-                                local_counts.data(), local_out.data(),
-                                dtype) != 0)
-          throw EngineError(std::string("local ring allgather failed: ") +
-                            hvd_ring_last_error());
+        int lrc = hier_.shm
+                      ? hvd_shm_allgather_g(hier_.shm, e.user,
+                                            local_counts.data(),
+                                            local_out.data(), dtype)
+                      : hvd_ringh_allgather(hier_.local_ring, e.user,
+                                            local_counts.data(),
+                                            local_out.data(), dtype);
+        if (lrc != 0)
+          throw EngineError(std::string("local allgather failed: ") +
+                            (hier_.shm ? hvd_shm_last_error()
+                                       : hvd_ring_last_error()));
         if (hier_.local_rank == 0) {
           std::vector<long> group_counts(hier_.cross_size, 0);
           for (int g = 0; g < hier_.cross_size; g++)
@@ -907,17 +959,22 @@ class Engine {
             throw EngineError(std::string("cross ring allgather failed: ") +
                               hvd_ring_last_error());
         }
-        if (hvd_ringh_broadcast(hier_.local_ring, out.data(),
-                                (long)total_elems, dtype, 0) != 0)
-          throw EngineError(std::string("local ring broadcast failed: ") +
-                            hvd_ring_last_error());
-      } else if (hvd_ring_allgather(e.data.data(), counts.data(), out.data(),
+        int brc = hier_.shm
+                      ? hvd_shm_broadcast_g(hier_.shm, out.data(),
+                                            (long)total_elems, dtype, 0)
+                      : hvd_ringh_broadcast(hier_.local_ring, out.data(),
+                                            (long)total_elems, dtype, 0);
+        if (brc != 0)
+          throw EngineError(std::string("local broadcast failed: ") +
+                            (hier_.shm ? hvd_shm_last_error()
+                                       : hvd_ring_last_error()));
+      } else if (hvd_ring_allgather(e.user, counts.data(), out.data(),
                                     dtype) != 0) {
         throw EngineError(std::string("ring allgather failed: ") +
                           hvd_ring_last_error());
       }
     } else {
-      std::memcpy(out.data(), e.data.data(), e.data.size());
+      std::memcpy(out.data(), e.user, e.nbytes);
     }
     if (timeline_) timeline_->activity_end(tname);
     std::vector<int64_t> shape = e.request.shape;
@@ -930,19 +987,19 @@ class Engine {
   }
 
   long long execute_broadcast(Entry& e, const std::string& tname) {
-    std::vector<uint8_t> out = e.data;
     size_t esz = dtype_size(e.request.dtype);
     if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
     if (size_ > 1) {
-      if (hvd_ring_broadcast(out.data(), (long)(out.size() / esz),
+      // In place on the caller's buffer: the root sends from it, everyone
+      // else receives into it.
+      if (hvd_ring_broadcast(e.user, (long)(e.nbytes / esz),
                              e.request.dtype, e.request.root_rank) != 0)
         throw EngineError(std::string("ring broadcast failed: ") +
                           hvd_ring_last_error());
     }
     if (timeline_) timeline_->activity_end(tname);
-    long long nbytes = (long long)out.size();
-    complete(&e, e.request.shape, std::move(out));
-    return nbytes;
+    complete_in_place(&e);
+    return (long long)e.nbytes;
   }
 
   // ------------------------------------------------------------ members
@@ -1054,12 +1111,44 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
   if ((hvd::g_hier.allreduce || hvd::g_hier.allgather) && local_addrs &&
       cross_addrs && hvd::g_hier.local_size > 1 &&
       hvd::g_hier.cross_size > 1 && !(cpu_ops && strcmp(cpu_ops, "star") == 0)) {
-    hvd::g_hier.local_ring = hvd_ringh_create(
-        hvd::g_hier.local_rank, hvd::g_hier.local_size, local_addrs, secret,
-        secret_len);
-    if (!hvd::g_hier.local_ring) {
-      hvd::g_last_error = hvd_ring_last_error();
-      return -1;
+    // Local plane: /dev/shm by default — same-host bytes move as memcpys
+    // through one shared mapping (the reference's MPI_Win_allocate_shared
+    // analogue, mpi_operations.cc:216-243) instead of crossing the kernel
+    // socket stack twice over loopback. HOROVOD_SHM_DISABLE=1 falls back
+    // to the TCP local ring. The choice is env-derived, so it is identical
+    // on every local rank — a mixed group would deadlock.
+    if (!env_true("HOROVOD_SHM_DISABLE")) {
+      // Segment name from the job secret + group id: unique per job, equal
+      // across the group's ranks.
+      hvd::SHA256 hasher;
+      hasher.update(secret, (size_t)secret_len);
+      int32_t group = hvd::g_hier.cross_rank;
+      hasher.update((const uint8_t*)&group, sizeof(group));
+      uint8_t digest[32];
+      hasher.finish(digest);
+      char name[32] = "/hvd";
+      for (int i = 0; i < 8; i++)
+        std::snprintf(name + 4 + 2 * i, 3, "%02x", digest[i]);
+      long slot = 4 << 20;
+      const char* slot_env = getenv("HOROVOD_SHM_SLOT_BYTES");
+      if (slot_env && *slot_env && atol(slot_env) > 0) slot = atol(slot_env);
+      hvd::g_hier.shm = hvd_shm_create(
+          hvd::g_hier.local_rank, hvd::g_hier.local_size, name, slot);
+      if (!hvd::g_hier.shm) {
+        hvd::g_last_error = std::string("shm local data plane failed (") +
+                            hvd_shm_last_error() +
+                            "); set HOROVOD_SHM_DISABLE=1 to use the TCP "
+                            "local ring";
+        return -1;
+      }
+    } else {
+      hvd::g_hier.local_ring = hvd_ringh_create(
+          hvd::g_hier.local_rank, hvd::g_hier.local_size, local_addrs, secret,
+          secret_len);
+      if (!hvd::g_hier.local_ring) {
+        hvd::g_last_error = hvd_ring_last_error();
+        return -1;
+      }
     }
     if (hvd::g_hier.local_rank == 0) {
       hvd::g_hier.cross_ring = hvd_ringh_create(
@@ -1069,7 +1158,8 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
         hvd::g_last_error = hvd_ring_last_error();
         // Don't leak the half-built pair (its bound listener would make a
         // retry fail with EADDRINUSE forever).
-        hvd_ringh_destroy(hvd::g_hier.local_ring);
+        if (hvd::g_hier.local_ring) hvd_ringh_destroy(hvd::g_hier.local_ring);
+        if (hvd::g_hier.shm) hvd_shm_destroy(hvd::g_hier.shm);
         hvd::g_hier = hvd::HierState{};
         return -1;
       }
@@ -1085,7 +1175,7 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
   return 0;
 }
 
-long long hvd_eng_enqueue(int op, const char* name, const void* data,
+long long hvd_eng_enqueue(int op, const char* name, void* data,
                           const long long* shape, int ndim, int dtype,
                           int root_rank) {
   if (!hvd::g_engine) {
@@ -1126,6 +1216,13 @@ int hvd_eng_result_ndim(long long h) {
 int hvd_eng_result_dtype(long long h) {
   auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
   return s ? (int)s->dtype : -1;
+}
+
+// 1 when the result was written into the caller's enqueue buffer
+// (allreduce/broadcast); 0 when it lives in the slot (allgather).
+int hvd_eng_result_in_place(long long h) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  return s && s->in_place ? 1 : 0;
 }
 
 void hvd_eng_result_shape(long long h, long long* out) {

@@ -679,4 +679,12 @@ void hvd_ringh_destroy(void* h) {
   delete (Ring*)h;
 }
 
+// --- dtype kernels shared with the /dev/shm local data plane (shm.cc) ------
+
+void hvd_dtype_accumulate(void* dst, const void* src, long count, int dtype) {
+  accumulate(dst, src, count, dtype);
+}
+
+long hvd_dtype_size(int dtype) { return (long)dtype_size(dtype); }
+
 }  // extern "C"

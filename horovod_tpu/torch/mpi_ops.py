@@ -26,6 +26,23 @@ def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
     return tensor.detach().cpu().numpy()
 
 
+def _inplace_view(tensor: torch.Tensor) -> Optional[np.ndarray]:
+    """Writable numpy view SHARING the torch tensor's memory, or None when
+    no such view exists (non-CPU, non-contiguous, or a dtype numpy can't
+    alias, e.g. bf16). With a view, the controller's in-place path writes
+    collective results straight into the tensor's storage — the dlpack-free
+    equivalent of the reference's zero-copy device hand-off (CPU torch
+    tensors and numpy share memory natively)."""
+    t = tensor.detach()
+    if t.device.type != "cpu" or not t.is_contiguous():
+        return None
+    try:
+        view = t.numpy()
+    except (TypeError, RuntimeError):
+        return None
+    return view if view.flags.c_contiguous and view.flags.writeable else None
+
+
 def _controller():
     return basics.controller()
 
@@ -50,10 +67,18 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
                      name: Optional[str] = None) -> Handle:
-    """In-place: the result is copied back into ``tensor`` on completion
-    (reference ``allreduce_async_``, torch/mpi_ops.py:156-176)."""
+    """In-place (reference ``allreduce_async_``, torch/mpi_ops.py:156-176).
+    CPU-contiguous tensors take the zero-copy path: the controller reduces
+    directly in the tensor's storage through a shared-memory numpy view;
+    otherwise the result is copied back on completion."""
     if _size() == 1:
         return handle_manager.completed(tensor)
+
+    view = _inplace_view(tensor)
+    if view is not None:
+        return _controller().allreduce_async(
+            view, average=average, name=name, inplace=True,
+            wrap=lambda a, _t=tensor: _t)
 
     def wrap(a: np.ndarray, _t=tensor):
         with torch.no_grad():
@@ -93,6 +118,12 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
         return handle_manager.completed(tensor)
+
+    view = _inplace_view(tensor)
+    if view is not None:
+        return _controller().broadcast_async(
+            view, root_rank=root_rank, name=name, inplace=True,
+            wrap=lambda a, _t=tensor: _t)
 
     def wrap(a: np.ndarray, _t=tensor):
         with torch.no_grad():

@@ -478,7 +478,145 @@ def scenario_hierarchical(rank, size):
             got, np.full(4, size * i + sum(range(size))), rtol=1e-6)
 
 
+def scenario_inplace(rank, size):
+    from horovod_tpu.common import basics
+
+    ctrl = basics.controller()
+
+    # In-place allreduce: the resolved value IS the enqueued array (no
+    # result copy), holding the averaged sum.
+    x = np.arange(8, dtype=np.float32) + rank
+    out = ctrl.allreduce_async(x, average=True, name="inp.avg",
+                               inplace=True).wait()
+    expect(out is x, "in-place allreduce returned a different object")
+    np.testing.assert_allclose(
+        x, np.arange(8, dtype=np.float32) + (size - 1) / 2.0, rtol=1e-6)
+
+    # Value semantics must NOT mutate the caller's input (the zero-copy
+    # engine works on a defensive copy).
+    y = np.ones(8, np.float32) * rank
+    y_before = y.copy()
+    res = ctrl.allreduce_async(y, average=False, name="inp.value").wait()
+    np.testing.assert_array_equal(y, y_before)
+    expect(res is not y, "value allreduce aliased the input")
+    np.testing.assert_allclose(res, np.ones(8) * sum(range(size)), rtol=1e-6)
+
+    # Int average in place: float math, truncate-cast back (the reference's
+    # output.div_ semantics).
+    xi = np.full(4, 3, np.int32) if rank % 2 == 0 else np.full(4, 4, np.int32)
+    ctrl.allreduce_async(xi, average=True, name="inp.int",
+                         inplace=True).wait()
+    vals = [3 if r % 2 == 0 else 4 for r in range(size)]
+    expect(xi.dtype == np.int32, f"int buffer became {xi.dtype}")
+    np.testing.assert_array_equal(xi, np.full(4, int(sum(vals) / size)))
+
+    # Several in-flight in-place ops: the FUSED path must unpack straight
+    # back into each caller buffer.
+    bufs = [np.ones(32, np.float32) * (i + rank) for i in range(8)]
+    handles = [ctrl.allreduce_async(b, average=False, name=f"inp.fuse.{i}",
+                                    inplace=True)
+               for i, b in enumerate(bufs)]
+    for i, (b, h) in enumerate(zip(bufs, handles)):
+        got = h.wait()
+        expect(got is b, "fused in-place result is a different object")
+        np.testing.assert_allclose(
+            b, np.ones(32) * (size * i + sum(range(size))), rtol=1e-6)
+
+    # In-place broadcast: non-roots receive into their own buffer.
+    z = np.full(6, float(rank), np.float32)
+    got = ctrl.broadcast_async(z, root_rank=1 % size, name="inp.bcast",
+                               inplace=True).wait()
+    expect(got is z, "in-place broadcast returned a different object")
+    np.testing.assert_array_equal(z, np.full(6, float(1 % size)))
+
+    # In-place + wire compression: the fp16 round-trip builds fresh arrays,
+    # but the result must still land in the caller's buffer and resolve to
+    # it (both engines honor the same contract).
+    xc = (np.linspace(-2, 2, 16, dtype=np.float32) * (rank + 1)).copy()
+    got = ctrl.allreduce_async(xc, average=True, name="inp.fp16",
+                               compression=Compression.fp16,
+                               inplace=True).wait()
+    expect(got is xc, "in-place compressed allreduce returned a new object")
+    scale_f = sum(r + 1 for r in range(size)) / size
+    np.testing.assert_allclose(xc, np.linspace(-2, 2, 16) * scale_f,
+                               atol=1e-2)
+
+    # torch in-place rides a shared-memory numpy view: zero copies end to
+    # end, the tensor's own storage holds the result.
+    import torch
+
+    import horovod_tpu.torch as hvd_torch
+
+    t = torch.arange(10, dtype=torch.float32) + rank
+    got = hvd_torch.allreduce_(t, average=False, name="inp.torch")
+    expect(got is t, "torch allreduce_ returned a different tensor")
+    np.testing.assert_allclose(
+        t.numpy(), size * np.arange(10) + sum(range(size)), rtol=1e-6)
+
+
+def scenario_copybench(rank, size):
+    # Micro-bench: unfused large-buffer allreduce, value path (1 defensive
+    # copy) vs in-place path (0 copies). Prints bytes/sec for the parent
+    # test to compare — the in-place path must not be slower; before the
+    # zero-copy engine it carried 4 staging copies.
+    import time
+
+    from horovod_tpu.common import basics
+
+    ctrl = basics.controller()
+    mb = int(os.environ.get("HOROVOD_COPYBENCH_MB", "32"))
+    reps = int(os.environ.get("HOROVOD_COPYBENCH_REPS", "6"))
+    x = np.ones(mb * (1 << 20) // 4, np.float32)
+
+    def run(inplace):
+        # Warmup outside the timed window (connection setup, fusion buffer).
+        ctrl.allreduce_async(x, average=False, name=f"cb.warm.{inplace}",
+                             inplace=inplace).wait()
+        t0 = time.perf_counter()
+        for i in range(reps):
+            ctrl.allreduce_async(x, average=False,
+                                 name=f"cb.{inplace}.{i}",
+                                 inplace=inplace).wait()
+        dt = time.perf_counter() - t0
+        return reps * x.nbytes / dt
+
+    value_bps = run(False)
+    inplace_bps = run(True)
+    print(f"copybench rank={rank} value={value_bps / 1e6:.1f}MB/s "
+          f"inplace={inplace_bps / 1e6:.1f}MB/s "
+          f"ratio={inplace_bps / value_bps:.3f}", flush=True)
+
+
+def scenario_shmbench(rank, size):
+    # Local-phase bandwidth probe: repeated hierarchical allreduce on a
+    # large buffer. The parent runs this twice — /dev/shm local plane vs
+    # HOROVOD_SHM_DISABLE=1 (TCP loopback local ring) — and compares the
+    # printed bytes/sec.
+    import time
+
+    from horovod_tpu.common import basics
+
+    ctrl = basics.state().controller
+    if not getattr(ctrl, "hierarchical_active", False):
+        raise AssertionError("hierarchical data plane not active")
+    mb = int(os.environ.get("HOROVOD_SHMBENCH_MB", "16"))
+    reps = int(os.environ.get("HOROVOD_SHMBENCH_REPS", "6"))
+    x = np.ones(mb * (1 << 20) // 4, np.float32)
+    ctrl.allreduce_async(x, average=False, name="shmb.warm",
+                         inplace=True).wait()
+    t0 = time.perf_counter()
+    for i in range(reps):
+        ctrl.allreduce_async(x, average=False, name=f"shmb.{i}",
+                             inplace=True).wait()
+    dt = time.perf_counter() - t0
+    print(f"shmbench rank={rank} rate={reps * x.nbytes / dt / 1e6:.1f}MB/s",
+          flush=True)
+
+
 SCENARIOS = {
+    "inplace": scenario_inplace,
+    "copybench": scenario_copybench,
+    "shmbench": scenario_shmbench,
     "hierarchical": scenario_hierarchical,
     "mxnet": scenario_mxnet,
     "autotune": scenario_autotune,

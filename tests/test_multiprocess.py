@@ -73,7 +73,7 @@ def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "allgather", "broadcast", "cache",
     "error_mismatch", "duplicate_name", "optimizer", "torch", "tensorflow",
-    "mxnet",
+    "mxnet", "inplace",
 ])
 def test_two_ranks(scenario):
     run_ranks(scenario, size=2)
@@ -81,6 +81,22 @@ def test_two_ranks(scenario):
 
 def test_three_ranks_allreduce():
     run_ranks("allreduce", size=3)
+
+
+def test_copybench_inplace_not_slower():
+    """Zero-copy micro-bench: the in-place path (0 staging copies) must at
+    least match the value path (1 defensive copy) in bytes/sec; before the
+    zero-copy engine the eager tier staged 4 host copies per tensor."""
+    outs = run_ranks("copybench", size=2, timeout=300)
+    ratios = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("copybench"):
+                ratios.append(float(line.rsplit("ratio=", 1)[1]))
+    assert len(ratios) == 2, outs
+    # Shared-core CI box is noisy; require "not meaningfully slower" and
+    # let the printed numbers document the typical win.
+    assert min(ratios) > 0.85, ratios
 
 
 def test_stall_warning():
@@ -136,6 +152,7 @@ def test_star_data_plane(scenario):
 
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "cache", "error_mismatch", "duplicate_name",
+    "inplace",
 ])
 def test_python_engine(scenario):
     # The Python controller (TCP star control plane) remains selectable via
@@ -165,6 +182,49 @@ def test_hierarchical_two_level(engine):
     assert res.returncode == 0, res.stdout + res.stderr
     for r in range(4):
         assert f"worker rank={r} scenario=hierarchical: OK" in res.stdout
+
+
+def _run_shmbench(shm_disable):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    env["HOROVOD_ENGINE"] = "native"
+    if shm_disable:
+        env["HOROVOD_SHM_DISABLE"] = "1"
+    else:
+        env.pop("HOROVOD_SHM_DISABLE", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+         "-H", "localhost:2,localhost:2",
+         sys.executable, WORKER, "shmbench"],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    # Launcher output is rank-prefixed ("[2]: shmbench rank=2 rate=...").
+    rates = [float(line.rsplit("rate=", 1)[1].replace("MB/s", ""))
+             for line in res.stdout.splitlines()
+             if "shmbench rank=" in line and "rate=" in line]
+    assert len(rates) == 4, res.stdout
+    return min(rates)
+
+
+def test_shm_local_plane_beats_loopback():
+    """The /dev/shm local data plane (MPI_Win_allocate_shared analogue)
+    must clearly beat the TCP loopback local ring it replaces — same-host
+    bytes move as memcpys through one shared mapping instead of crossing
+    the kernel socket stack twice."""
+    shm_rate = _run_shmbench(shm_disable=False)
+    tcp_rate = _run_shmbench(shm_disable=True)
+    print(f"shm={shm_rate:.1f}MB/s loopback={tcp_rate:.1f}MB/s "
+          f"ratio={shm_rate / tcp_rate:.2f}")
+    # Observed ~1.5-1.9x end-to-end on the 1-core CI box. The local phase
+    # alone is far beyond 2x; the measured number is diluted by the
+    # cross-ring TCP phase both configs share and by 4 processes
+    # timesharing one core across the shm barriers. Assert with margin so
+    # scheduler noise can't flake the build.
+    assert shm_rate > 1.25 * tcp_rate, (shm_rate, tcp_rate)
 
 
 def test_autotune_categorical_hierarchical_stays_correct():
