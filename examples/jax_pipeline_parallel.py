@@ -1,13 +1,16 @@
-"""Pipeline-parallel training over a data x pipe mesh (GPipe schedule).
+"""Pipeline-parallel training over a data x pipe mesh (GPipe or 1F1B).
 
 Demonstrates pipeline parallelism (``horovod_tpu.parallel.pipeline``, a TPU
 extension — the reference is DP-only, SURVEY.md §2.3): a deep stack of
 residual MLP blocks is split into stages along the ``pipe`` mesh axis,
 microbatches stream through the stage ring with ``ppermute`` hand-offs
-inside one compiled ``lax.scan``, and per-stage rematerialisation keeps
-live memory at one microbatch per stage.
+inside one compiled ``lax.scan``. ``--schedule gpipe`` (default) relies on
+autodiff through the scan with per-stage remat; ``--schedule 1f1b`` runs
+the fused forward/backward schedule whose activation memory is O(stages)
+regardless of the microbatch count.
 
     python examples/jax_pipeline_parallel.py --steps 50 --microbatches 16
+    python examples/jax_pipeline_parallel.py --schedule 1f1b
 """
 
 import argparse
@@ -36,6 +39,8 @@ def main():
     parser.add_argument("--features", type=int, default=256)
     parser.add_argument("--layers-per-stage", type=int, default=2)
     parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--schedule", choices=["gpipe", "1f1b"],
+                        default="gpipe")
     args = parser.parse_args()
 
     hvd.init()
@@ -74,25 +79,46 @@ def main():
     w_true = jnp.asarray(rng.randn(f, f) / np.sqrt(f), jnp.float32)
     target = jnp.tanh(data @ w_true)
 
-    def body(p, x, y):
-        outs = pipeline_apply(stage_fn, p, x, axis_name="pipe")
-        per_mb = jnp.mean((outs - y) ** 2, axis=(1, 2))
-        return jax.lax.pmean(pipeline_loss(per_mb, "pipe"), "data")
-
-    def loss_fn(p, x, y):
-        return jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(P("pipe"), P(None, "data"), P(None, "data")),
-            out_specs=P(), check_vma=False)(p, x, y)
-
     tx = optax.adam(args.lr)
     opt_state = tx.init(stacked)
 
-    @jax.jit
-    def step(p, o, x, y):
-        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
-        u, o = tx.update(g, o, p)
-        return optax.apply_updates(p, u), o, loss
+    if args.schedule == "gpipe":
+        def body(p, x, y):
+            outs = pipeline_apply(stage_fn, p, x, axis_name="pipe")
+            per_mb = jnp.mean((outs - y) ** 2, axis=(1, 2))
+            return jax.lax.pmean(pipeline_loss(per_mb, "pipe"), "data")
+
+        def loss_fn(p, x, y):
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P("pipe"), P(None, "data"), P(None, "data")),
+                out_specs=P(), check_vma=False)(p, x, y)
+
+        @jax.jit
+        def step(p, o, x, y):
+            loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
+    else:
+        # 1F1B computes (loss, grads) inside the schedule itself; average
+        # both over the data axis in the same compiled program.
+        def f1b_body(p, x, y):
+            loss, grads = pipeline_apply(
+                stage_fn, p, x, axis_name="pipe", schedule="1f1b",
+                loss_fn=lambda o, t: jnp.mean((o - t) ** 2), targets=y)
+            return (jax.lax.pmean(loss, "data"),
+                    jax.tree.map(lambda g: jax.lax.pmean(g, "data"), grads))
+
+        f1b = jax.shard_map(
+            f1b_body, mesh=mesh,
+            in_specs=(P("pipe"), P(None, "data"), P(None, "data")),
+            out_specs=(P(), P("pipe")), check_vma=False)
+
+        @jax.jit
+        def step(p, o, x, y):
+            loss, g = f1b(p, x, y)
+            u, o = tx.update(g, o, p)
+            return optax.apply_updates(p, u), o, loss
 
     t0, loss = None, None
     for i in range(args.steps):

@@ -292,7 +292,8 @@ class NativeController:
             if average and out.dtype != np.bool_:
                 # bool reduces as logical OR (MPI_LOR); "average" has no
                 # meaning there and must not promote to float.
-                if out.dtype.kind == "f":
+                # ml_dtypes.bfloat16 registers as kind 'V', not 'f'.
+                if out.dtype.kind == "f" or str(out.dtype) == "bfloat16":
                     # Every path owns `out` (the caller's buffer under the
                     # in-place contract, our defensive copy, or the
                     # decompress temporary): divide without another

@@ -22,22 +22,51 @@ from ..common.handles import Handle, HandleManager
 handle_manager = HandleManager()
 
 
+def _bf16_view(t: torch.Tensor) -> np.ndarray:
+    """Memory-SHARING numpy view of a contiguous CPU bf16 tensor: numpy has
+    no native bf16, so reinterpret the bits as uint16 and view them as
+    ml_dtypes.bfloat16 — the dtype the ring data plane reduces natively
+    (round-to-nearest-even, ring.cc DT_BF16). torch.uint16 exists from
+    torch 2.3; older torch cannot bit-view bf16."""
+    import ml_dtypes
+
+    u16 = getattr(torch, "uint16", None)
+    if u16 is None:
+        raise TypeError(
+            "bf16 collectives need torch >= 2.3 (torch.uint16 bit view)")
+    return t.view(u16).numpy().view(ml_dtypes.bfloat16)
+
+
 def _to_numpy(tensor: torch.Tensor) -> np.ndarray:
-    return tensor.detach().cpu().numpy()
+    t = tensor.detach().cpu()
+    if t.dtype == torch.bfloat16:
+        return _bf16_view(t.contiguous())
+    return t.numpy()
+
+
+def _to_torch(a: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+    """Numpy result -> torch tensor of ``like``'s dtype (bf16 through the
+    same bit-reinterpretation as :func:`_bf16_view`)."""
+    a = np.ascontiguousarray(a)
+    if str(a.dtype) == "bfloat16":
+        out = torch.from_numpy(a.view(np.uint16)).view(torch.bfloat16)
+    else:
+        out = torch.from_numpy(a)
+    return out.to(like.dtype)
 
 
 def _inplace_view(tensor: torch.Tensor) -> Optional[np.ndarray]:
     """Writable numpy view SHARING the torch tensor's memory, or None when
-    no such view exists (non-CPU, non-contiguous, or a dtype numpy can't
-    alias, e.g. bf16). With a view, the controller's in-place path writes
-    collective results straight into the tensor's storage — the dlpack-free
-    equivalent of the reference's zero-copy device hand-off (CPU torch
-    tensors and numpy share memory natively)."""
+    no such view exists (non-CPU, non-contiguous, or bf16 on torch < 2.3).
+    With a view, the controller's in-place path writes collective results
+    straight into the tensor's storage — the dlpack-free equivalent of the
+    reference's zero-copy device hand-off (CPU torch tensors and numpy
+    share memory natively; bf16 goes through the uint16 bit view)."""
     t = tensor.detach()
     if t.device.type != "cpu" or not t.is_contiguous():
         return None
     try:
-        view = t.numpy()
+        view = _bf16_view(t) if t.dtype == torch.bfloat16 else t.numpy()
     except (TypeError, RuntimeError):
         return None
     return view if view.flags.c_contiguous and view.flags.writeable else None
@@ -61,8 +90,7 @@ def allreduce_async(tensor: torch.Tensor, average: bool = True,
         return handle_manager.completed(tensor.clone())
     return _controller().allreduce_async(
         _to_numpy(tensor), average=average, name=name,
-        wrap=lambda a: torch.from_numpy(np.ascontiguousarray(a)).to(
-            tensor.dtype).reshape(a.shape))
+        wrap=lambda a: _to_torch(a, tensor).reshape(a.shape))
 
 
 def allreduce_async_(tensor: torch.Tensor, average: bool = True,
@@ -82,8 +110,7 @@ def allreduce_async_(tensor: torch.Tensor, average: bool = True,
 
     def wrap(a: np.ndarray, _t=tensor):
         with torch.no_grad():
-            _t.copy_(torch.from_numpy(np.ascontiguousarray(a)).to(
-                _t.dtype).reshape(_t.shape))
+            _t.copy_(_to_torch(a, _t).reshape(_t.shape))
         return _t
 
     return _controller().allreduce_async(
@@ -96,8 +123,7 @@ def allgather_async(tensor: torch.Tensor,
         return handle_manager.completed(tensor.clone())
     return _controller().allgather_async(
         _to_numpy(tensor), name=name,
-        wrap=lambda a: torch.from_numpy(np.ascontiguousarray(a)).to(
-            tensor.dtype).reshape(a.shape))
+        wrap=lambda a: _to_torch(a, tensor).reshape(a.shape))
 
 
 def broadcast_async(tensor: torch.Tensor, root_rank: int,
@@ -108,8 +134,7 @@ def broadcast_async(tensor: torch.Tensor, root_rank: int,
         return handle_manager.completed(tensor.clone())
     return _controller().broadcast_async(
         _to_numpy(tensor), root_rank=root_rank, name=name,
-        wrap=lambda a: torch.from_numpy(np.ascontiguousarray(a)).to(
-            tensor.dtype).reshape(a.shape))
+        wrap=lambda a: _to_torch(a, tensor).reshape(a.shape))
 
 
 def broadcast_async_(tensor: torch.Tensor, root_rank: int,
@@ -127,8 +152,7 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
 
     def wrap(a: np.ndarray, _t=tensor):
         with torch.no_grad():
-            _t.copy_(torch.from_numpy(np.ascontiguousarray(a)).to(
-                _t.dtype).reshape(_t.shape))
+            _t.copy_(_to_torch(a, _t).reshape(_t.shape))
         return _t
 
     return _controller().broadcast_async(

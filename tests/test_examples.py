@@ -120,6 +120,17 @@ def test_pipeline_example_smoke():
     assert "samples/sec through" in out
 
 
+def test_pipeline_example_1f1b_smoke():
+    out = _run([sys.executable,
+                os.path.join(EX, "jax_pipeline_parallel.py"),
+                "--steps", "10", "--microbatches", "8",
+                "--microbatch-size", "4", "--features", "32",
+                "--schedule", "1f1b"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=4"})
+    assert "samples/sec through" in out
+
+
 def test_scaling_efficiency_smoke():
     out = _run([sys.executable, os.path.join(EX, "scaling_efficiency.py"),
                 "--model", "mlp", "--steps", "3", "--warmup", "1",

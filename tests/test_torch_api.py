@@ -77,3 +77,13 @@ def test_compression_roundtrip():
     out = hvd.Compression.fp16.decompress(c, ctx)
     assert out.dtype == torch.float32
     np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-3)
+
+
+def test_bf16_roundtrip_size1():
+    hvd.init()
+    t = torch.linspace(-2, 2, 8).to(torch.bfloat16)
+    out = hvd.allreduce(t, average=True)
+    assert out.dtype == torch.bfloat16
+    np.testing.assert_allclose(out.float().numpy(), t.float().numpy())
+    g = hvd.allgather(t)
+    assert g.dtype == torch.bfloat16
