@@ -1,0 +1,117 @@
+"""Flash attention kernel micro-benchmark (forward and forward+backward).
+
+Reproduces the README flash row and sweeps block sizes, so kernel changes
+(e.g. the round-2 HBM→VMEM streaming rewrite) can be re-measured on
+hardware with one command:
+
+    python examples/flash_attention_benchmark.py                 # defaults
+    python examples/flash_attention_benchmark.py --sweep         # block sweep
+    python examples/flash_attention_benchmark.py --seq-len 32768 --batch 1
+
+Prints one JSON line per configuration:
+  {"metric": "flash_fwd_ms", "B":..,"S":..,"H":..,"D":..,
+   "block_q":..,"block_k":..,"fwd_ms":..,"train_ms":..}
+
+Off-TPU this runs the same kernel in Pallas interpreter mode — useful only
+for correctness, the timings are meaningless there (a warning is printed).
+"""
+
+import argparse
+import itertools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.attention import _fit_block, flash_attention
+
+
+def bench_config(b, s, h, d, block_q, block_k, iters, causal=True):
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(b, s, h, d).astype(np.float32) * 0.3, jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+
+    fwd = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k))
+
+    def loss(q, k, v):
+        return (flash_attention(q, k, v, causal=causal, block_q=block_q,
+                                block_k=block_k).astype(jnp.float32) ** 2
+                ).sum()
+
+    train = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    def time_fn(fn):
+        out = fn(q, k, v)
+        jax.block_until_ready(out)
+        # Device fetch as the sync barrier (tunnel-safe).
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[0]
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    return time_fn(fwd), time_fn(train)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=4)
+    parser.add_argument("--seq-len", type=int, default=2048)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--head-dim", type=int, default=64)
+    parser.add_argument("--block-q", type=int, default=256)
+    parser.add_argument("--block-k", type=int, default=2048)
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--sweep", action="store_true",
+                        help="sweep block_q x block_k instead of one config")
+    args = parser.parse_args()
+
+    if jax.default_backend() != "tpu":
+        print("warning: not on TPU — interpreter-mode timings are "
+              "meaningless, use for correctness only")
+
+    if args.sweep:
+        qs = [128, 256, 512]
+        ks = [256, 512, 1024, 2048]
+        configs = [(bq, bk) for bq, bk in itertools.product(qs, ks)
+                   if bq <= args.seq_len and bk <= args.seq_len]
+    else:
+        configs = [(args.block_q, args.block_k)]
+
+    # Report the EFFECTIVE blocks (the kernel clamps/halves requests that
+    # don't divide the sequence) and dedupe configs that clamp to the same
+    # kernel — a sweep must never record a config that was not actually run.
+    effective = {}
+    for bq, bk in configs:
+        eff = (_fit_block(bq, args.seq_len), _fit_block(bk, args.seq_len))
+        effective.setdefault(eff, (bq, bk))
+    if not effective:
+        sys.exit(f"no sweep block size fits --seq-len {args.seq_len}; "
+                 "pass explicit --block-q/--block-k")
+
+    best = None
+    for (bq, bk) in effective:
+        fwd_ms, train_ms = bench_config(
+            args.batch, args.seq_len, args.heads, args.head_dim, bq, bk,
+            args.iters)
+        rec = {"metric": "flash_fwd_ms", "B": args.batch, "S": args.seq_len,
+               "H": args.heads, "D": args.head_dim, "block_q": bq,
+               "block_k": bk, "fwd_ms": round(fwd_ms, 2),
+               "train_ms": round(train_ms, 2)}
+        print(json.dumps(rec), flush=True)
+        if best is None or fwd_ms < best[0]:
+            best = (fwd_ms, bq, bk)
+    if args.sweep:
+        print(f"best fwd: {best[0]:.2f} ms at block_q={best[1]} "
+              f"block_k={best[2]}")
+
+
+if __name__ == "__main__":
+    main()

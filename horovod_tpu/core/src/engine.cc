@@ -805,6 +805,18 @@ class Engine {
     it->second.in_place = true;
   }
 
+  // Timeline activity vocabulary mirrors the reference's op-specific names
+  // (common.h:30-51): say which data plane actually moved the bytes.
+  const char* data_plane_activity(bool hier_enabled) const {
+    if (hier_enabled && hier_.shm) return "SHM_CROSS_RING_COLLECTIVE";
+    if (hier_enabled && hier_.local_ring) return "HIER_RING_COLLECTIVE";
+    return "TCP_COLLECTIVE";
+  }
+
+  const char* allreduce_activity() const {
+    return data_plane_activity(hier_.allreduce);
+  }
+
   long long execute_allreduce(std::vector<Entry*>& entries,
                               const std::string& tname) {
     uint8_t dtype = entries[0]->request.dtype;
@@ -817,7 +829,7 @@ class Engine {
       // staging copies (the reference likewise reduces unfused entries in
       // place, mpi_operations.cc:40-49).
       Entry* e = entries[0];
-      if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
+      if (timeline_) timeline_->activity_start(tname, allreduce_activity());
       if (size_ > 1) {
         if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
           hier_ring_allreduce(e->user, (long)(total_bytes / esz), dtype);
@@ -851,7 +863,7 @@ class Engine {
     }
     if (timeline_) {
       timeline_->activity_end(tname);
-      timeline_->activity_start(tname, "TCP_COLLECTIVE");
+      timeline_->activity_start(tname, allreduce_activity());
     }
     if (size_ > 1) {
       if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
@@ -923,7 +935,8 @@ class Engine {
       total_elems += s * trailing;
     }
     std::vector<uint8_t> out((size_t)total_elems * esz);
-    if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
+    const char* gather_act = data_plane_activity(hier_.allgather);
+    if (timeline_) timeline_->activity_start(tname, gather_act);
     if (size_ > 1) {
       if (hier_.allgather && (hier_.local_ring || hier_.shm)) {
         // Two-level: gather inside the node (shm slots or TCP local ring),

@@ -215,3 +215,12 @@ def test_torch_synthetic_benchmark_two_ranks():
                 "--num-iters", "2", "--num-warmup", "1",
                 "--batch-size", "8", "--image-size", "32"])
     assert "total img/sec on 2 ranks" in out
+
+
+def test_flash_benchmark_smoke():
+    out = _run([sys.executable,
+                os.path.join(EX, "flash_attention_benchmark.py"),
+                "--batch", "1", "--seq-len", "128", "--heads", "2",
+                "--head-dim", "16", "--block-q", "64", "--block-k", "64",
+                "--iters", "2"])
+    assert '"metric": "flash_fwd_ms"' in out
