@@ -88,16 +88,15 @@ def main():
     # Report the EFFECTIVE blocks (the kernel clamps/halves requests that
     # don't divide the sequence) and dedupe configs that clamp to the same
     # kernel — a sweep must never record a config that was not actually run.
-    effective = {}
-    for bq, bk in configs:
-        eff = (_fit_block(bq, args.seq_len), _fit_block(bk, args.seq_len))
-        effective.setdefault(eff, (bq, bk))
+    effective = {(_fit_block(bq, args.seq_len),
+                  _fit_block(bk, args.seq_len))
+                 for bq, bk in configs}
     if not effective:
         sys.exit(f"no sweep block size fits --seq-len {args.seq_len}; "
                  "pass explicit --block-q/--block-k")
 
     best = None
-    for (bq, bk) in effective:
+    for (bq, bk) in sorted(effective):
         fwd_ms, train_ms = bench_config(
             args.batch, args.seq_len, args.heads, args.head_dim, bq, bk,
             args.iters)

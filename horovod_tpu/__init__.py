@@ -38,6 +38,8 @@ from .ops.collective_ops import (  # noqa: F401
     Average,
     allreduce,
     allreduce_async,
+    grouped_allreduce,
+    grouped_allreduce_async,
     allgather,
     allgather_async,
     broadcast,

@@ -164,6 +164,63 @@ def allreduce_async(tensor, average: Optional[bool] = None,
                                          wrap=_wrap_for(tensor))
 
 
+def grouped_allreduce(tensors, average: Optional[bool] = None,
+                      name: Optional[str] = None,
+                      op: Optional[str] = None, compression=None,
+                      axis_name: Optional[str] = None):
+    """Allreduce a LIST of tensors as one group, returning results in the
+    same order.
+
+    The pinned reference predates ``grouped_allreduce`` (it arrived in
+    later Horovod), but the machinery is the same one Tensor Fusion
+    provides: every member is enqueued in the same cycle, the coordinator
+    negotiates them together, and same-dtype members pack into one fused
+    buffer / one ring pass. On the SPMD tier this is a tree-wise
+    ``pmean``/``psum`` — XLA fuses the group itself."""
+    if not isinstance(tensors, (list, tuple)):
+        raise TypeError("grouped_allreduce expects a list/tuple of tensors")
+    avg = _resolve_average(average, op)
+    if tensors and _is_traced(tensors[0]):
+        return [
+            _traced_collective(
+                t, axis_name,
+                lambda t_, ax: lax.pmean(t_, ax) if avg else lax.psum(t_, ax))
+            for t in tensors
+        ]
+    handles = grouped_allreduce_async(tensors, average=avg, name=name,
+                                      compression=compression)
+    return [h.wait() for h in handles]
+
+
+def grouped_allreduce_async(tensors, average: Optional[bool] = None,
+                            name: Optional[str] = None,
+                            op: Optional[str] = None,
+                            compression=None) -> list:
+    """Async grouped allreduce: returns one handle per member (join with
+    ``synchronize``). Members are named ``{name}.{i}`` so the fusion
+    engine sees the whole group at once."""
+    if not isinstance(tensors, (list, tuple)):
+        raise TypeError(
+            "grouped_allreduce_async expects a list/tuple of tensors")
+    avg = _resolve_average(average, op)
+    if tensors and _is_traced(tensors[0]):
+        raise ValueError(
+            "grouped_allreduce_async is an eager-tier API; inside jit use "
+            "grouped_allreduce()")
+    st = basics.state()
+    if st.topology.size == 1:
+        return [handle_manager.completed(_wrap_value(t)) for t in tensors]
+    ctrl = _controller()
+    # Explicit name -> {name}.{i} per member; otherwise the controller's
+    # autonamer keeps concurrent anonymous groups collision-free.
+    return [
+        ctrl.allreduce_async(t, average=avg,
+                             name=None if name is None else f"{name}.{i}",
+                             compression=compression, wrap=_wrap_for(t))
+        for i, t in enumerate(tensors)
+    ]
+
+
 # ---------------------------------------------------------------------------
 # allgather
 

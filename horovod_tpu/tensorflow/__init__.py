@@ -83,6 +83,47 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     return compression.decompress(out, ctx)
 
 
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None,
+                      compression=Compression.none) -> list:
+    """Allreduce a list of tensors as one fusion group (later-Horovod API
+    surface; executed by the same enqueue-together + Tensor Fusion path).
+    In eager mode all members are enqueued before any is joined, so the
+    engine sees the whole group in one cycle; inside ``tf.function`` each
+    member rides its own py_function node (the executor schedules them
+    concurrently)."""
+    tensors = list(tensors)
+    # Consistent across tiers and BEFORE anything is enqueued: the sparse
+    # path is per-tensor allreduce() business.
+    if any(isinstance(t, tf.IndexedSlices) for t in tensors):
+        raise ValueError(
+            "grouped_allreduce does not take IndexedSlices; use "
+            "allreduce() for the sparse allgather path")
+    if tf.executing_eagerly() and size() > 1:
+        ctrl = _controller()
+        handles = []
+        for i, t in enumerate(tensors):
+            tt = tf.convert_to_tensor(t)
+            # Compress at the TF level (the controller's compression hooks
+            # are numpy-domain; the single-tensor allreduce does the same).
+            compressed, cctx = compression.compress(tt)
+            h = ctrl.allreduce_async(
+                compressed.numpy(), average=average,
+                name=None if name is None else f"{name}.{i}")
+            handles.append((compressed.dtype, cctx, h))
+        return [
+            compression.decompress(
+                tf.convert_to_tensor(np.asarray(h.wait()), dtype=cdt), cctx)
+            for cdt, cctx, h in handles
+        ]
+    return [
+        allreduce(t, average=average,
+                  name=None if name is None else f"{name}.{i}",
+                  compression=compression)
+        for i, t in enumerate(tensors)
+    ]
+
+
 def allgather(tensor, name: Optional[str] = None):
     tensor = tf.convert_to_tensor(tensor)
     if size() == 1:

@@ -159,6 +159,36 @@ def broadcast_async_(tensor: torch.Tensor, root_rank: int,
         _to_numpy(tensor), root_rank=root_rank, name=name, wrap=wrap)
 
 
+def grouped_allreduce(tensors, average: bool = True,
+                      name: Optional[str] = None) -> list:
+    """Allreduce a list of tensors as one fusion group (later-Horovod API;
+    the 0.16-era machinery — enqueue together, Tensor Fusion packs — is
+    what executes it). Returns new tensors in order."""
+    handles = grouped_allreduce_async(tensors, average=average, name=name)
+    return [h.wait() for h in handles]
+
+
+def grouped_allreduce_async(tensors, average: bool = True,
+                            name: Optional[str] = None) -> list:
+    return [
+        allreduce_async(t, average=average,
+                        name=None if name is None else f"{name}.{i}")
+        for i, t in enumerate(tensors)
+    ]
+
+
+def grouped_allreduce_(tensors, average: bool = True,
+                       name: Optional[str] = None) -> list:
+    """In-place grouped allreduce: each tensor's storage receives its
+    result (zero-copy for contiguous CPU tensors)."""
+    handles = [
+        allreduce_async_(t, average=average,
+                         name=None if name is None else f"{name}.{i}")
+        for i, t in enumerate(tensors)
+    ]
+    return [h.wait() for h in handles]
+
+
 def synchronize(handle: Handle):
     """Join an async op (reference ``synchronize``, torch/mpi_ops.py:422-433)."""
     return handle.wait()

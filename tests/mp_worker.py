@@ -99,6 +99,67 @@ def scenario_fusion(rank, size):
                                    rtol=1e-6)
 
 
+def scenario_grouped(rank, size):
+    # grouped_allreduce: whole list enqueued before any join — one fusion
+    # group; results in order; torch grouped + in-place variants.
+    outs = hvd.grouped_allreduce(
+        [np.ones(8, np.float32) * (i + rank) for i in range(6)],
+        average=False, name="grp")
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(
+            np.asarray(out), np.ones(8) * (size * i + sum(range(size))),
+            rtol=1e-6)
+
+    outs = hvd.grouped_allreduce(
+        [np.full(4, float(rank)), np.full(2, float(rank * 2))],
+        average=True)
+    mean_r = (size - 1) / 2
+    np.testing.assert_allclose(np.asarray(outs[0]), np.full(4, mean_r))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full(2, 2 * mean_r))
+
+    import torch
+
+    import horovod_tpu.torch as thvd
+
+    ts = [torch.ones(5) * (i + rank) for i in range(4)]
+    res = thvd.grouped_allreduce(ts, average=False, name="grp.t")
+    for i, r in enumerate(res):
+        np.testing.assert_allclose(
+            r.numpy(), np.ones(5) * (size * i + sum(range(size))), rtol=1e-6)
+    got = thvd.grouped_allreduce_(ts, average=False, name="grp.ti")
+    for i, (t, g) in enumerate(zip(ts, got)):
+        expect(g is t, "grouped_allreduce_ returned new tensors")
+        np.testing.assert_allclose(
+            t.numpy(), np.ones(5) * (size * i + sum(range(size))), rtol=1e-6)
+
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as tfhvd
+
+    tf_outs = tfhvd.grouped_allreduce(
+        [tf.constant([1.0, 2.0]) * (rank + 1), tf.constant([3.0])],
+        average=False, name="grp.tf")
+    scale_t = sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(tf_outs[0].numpy(), [scale_t, 2 * scale_t])
+    np.testing.assert_allclose(tf_outs[1].numpy(), [3 * size])
+
+    # TF grouped + fp16 wire compression (compressed at the TF level, the
+    # controller sees plain f16 numpy).
+    tf_c = tfhvd.grouped_allreduce(
+        [tf.constant([0.5, -1.5]) * (rank + 1)], average=True,
+        name="grp.tfc", compression=tfhvd.Compression.fp16)
+    mean_scale = sum(r + 1 for r in range(size)) / size
+    np.testing.assert_allclose(tf_c[0].numpy(),
+                               [0.5 * mean_scale, -1.5 * mean_scale],
+                               atol=1e-2)
+    import pytest
+
+    with pytest.raises(ValueError, match="IndexedSlices"):
+        tfhvd.grouped_allreduce([tf.IndexedSlices(
+            values=tf.constant([[1.0]]), indices=tf.constant([0]),
+            dense_shape=tf.constant([2, 1]))])
+
+
 def scenario_allgather(rank, size):
     # Rank-dependent first dims (reference allgather variable-dim tests).
     x = np.full((rank + 1, 3), rank, dtype=np.float32)
@@ -646,6 +707,7 @@ def scenario_shmbench(rank, size):
 
 SCENARIOS = {
     "inplace": scenario_inplace,
+    "grouped": scenario_grouped,
     "copybench": scenario_copybench,
     "shmbench": scenario_shmbench,
     "hierarchical": scenario_hierarchical,

@@ -162,3 +162,37 @@ def test_compression_skipped_on_unbound_axis():
     # bf16 would collapse 1.0000001 -> 1.0 and yield exactly -0.1.
     assert abs(got - full) < 1e-9, got
     hvd.shutdown()
+
+
+def test_grouped_allreduce_traced_and_size1():
+    hvd.init()
+    # Size-1 eager: identity values, fresh arrays, order preserved.
+    outs = hvd.grouped_allreduce([np.ones(3, np.float32),
+                                  np.arange(4, dtype=np.float32)],
+                                 average=True)
+    np.testing.assert_array_equal(np.asarray(outs[0]), np.ones(3))
+    np.testing.assert_array_equal(np.asarray(outs[1]), np.arange(4))
+
+    # Traced tier: tree of psums over the mesh axis.
+    from horovod_tpu.parallel import make_mesh
+
+    m = make_mesh({"data": jax.device_count()})
+
+    def body(xs):
+        return hvd.grouped_allreduce(list(xs), average=False,
+                                     axis_name="data")
+
+    f = jax.jit(jax.shard_map(
+        body, mesh=m, in_specs=(P("data"),), out_specs=P(),
+        check_vma=False))
+    n = jax.device_count()
+    xs = (jnp.ones((n, 2)), jnp.arange(float(n))[:, None])
+    got = f(xs)  # per-device (1, k) shards psum'd over the axis
+    np.testing.assert_allclose(np.asarray(got[0]).ravel(), np.full(2, n))
+    np.testing.assert_allclose(np.asarray(got[1]).ravel(),
+                               [sum(range(n))])
+
+    import pytest
+
+    with pytest.raises(TypeError, match="list/tuple"):
+        hvd.grouped_allreduce(np.ones(3))
