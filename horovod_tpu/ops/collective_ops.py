@@ -180,7 +180,10 @@ def grouped_allreduce(tensors, average: Optional[bool] = None,
     if not isinstance(tensors, (list, tuple)):
         raise TypeError("grouped_allreduce expects a list/tuple of tensors")
     avg = _resolve_average(average, op)
-    if tensors and _is_traced(tensors[0]):
+    # any(), not tensors[0]: a mixed list (constant first, traced gradient
+    # later) must take the traced tier, never hand a Tracer to the
+    # host-side controller.
+    if any(_is_traced(t) for t in tensors):
         return [
             _traced_collective(
                 t, axis_name,
@@ -203,7 +206,7 @@ def grouped_allreduce_async(tensors, average: Optional[bool] = None,
         raise TypeError(
             "grouped_allreduce_async expects a list/tuple of tensors")
     avg = _resolve_average(average, op)
-    if tensors and _is_traced(tensors[0]):
+    if any(_is_traced(t) for t in tensors):
         raise ValueError(
             "grouped_allreduce_async is an eager-tier API; inside jit use "
             "grouped_allreduce()")

@@ -20,6 +20,7 @@ from .pipeline import (  # noqa: F401
 from .mesh import (  # noqa: F401
     DATA_AXIS,
     make_mesh,
+    make_multislice_mesh,
     mesh,
     set_mesh,
     reset_mesh,

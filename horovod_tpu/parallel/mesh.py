@@ -64,6 +64,52 @@ def make_mesh(
     return Mesh(arr, names)
 
 
+def make_multislice_mesh(
+    n_slices: Optional[int] = None,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A 2-D ``(dcn, ici)`` mesh for multi-slice topologies: the outer axis
+    crosses slice boundaries (slow DCN), the inner axis stays within a
+    slice (fast ICI) — feed it to
+    ``hierarchical_allreduce(inner_axis=ici_axis, outer_axis=dcn_axis)``
+    (the ``NCCLHierarchicalAllreduce`` analogue; see docs/running.md).
+
+    On a real multi-slice runtime the grouping comes from each device's
+    ``slice_index``; elsewhere (virtual CPU devices, single slice split
+    for testing) pass ``n_slices`` to group contiguously.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) > 1:
+        by_slice = {}
+        for d in devices:
+            by_slice.setdefault(d.slice_index, []).append(d)
+        per = {len(v) for v in by_slice.values()}
+        if len(per) != 1:
+            raise ValueError(
+                f"unequal slice sizes {sorted(per)}: cannot build a "
+                "rectangular (dcn, ici) mesh")
+        if n_slices is not None and n_slices != len(by_slice):
+            raise ValueError(
+                f"n_slices={n_slices} but the runtime reports "
+                f"{len(by_slice)} slices")
+        arr = np.array([by_slice[s] for s in sorted(by_slice)])
+        return Mesh(arr, (dcn_axis, ici_axis))
+    if n_slices is None:
+        raise ValueError(
+            "n_slices is required when devices carry no slice_index "
+            "(single-slice or virtual platforms)")
+    if n % n_slices:
+        raise ValueError(f"{n} devices not divisible by {n_slices} slices")
+    arr = np.array(devices).reshape(n_slices, n // n_slices)
+    return Mesh(arr, (dcn_axis, ici_axis))
+
+
 def mesh() -> Mesh:
     """The process-global mesh, lazily a 1-D data mesh over all devices."""
     global _global_mesh

@@ -92,6 +92,8 @@ def grouped_allreduce(tensors, average: bool = True,
     engine sees the whole group in one cycle; inside ``tf.function`` each
     member rides its own py_function node (the executor schedules them
     concurrently)."""
+    if not isinstance(tensors, (list, tuple)):
+        raise TypeError("grouped_allreduce expects a list/tuple of tensors")
     tensors = list(tensors)
     # Consistent across tiers and BEFORE anything is enqueued: the sparse
     # path is per-tensor allreduce() business.

@@ -170,6 +170,11 @@ def grouped_allreduce(tensors, average: bool = True,
 
 def grouped_allreduce_async(tensors, average: bool = True,
                             name: Optional[str] = None) -> list:
+    # Explicit list check: a bare tensor is iterable along dim 0 and would
+    # silently become per-row allreduces.
+    if not isinstance(tensors, (list, tuple)):
+        raise TypeError(
+            "grouped_allreduce expects a list/tuple of tensors")
     return [
         allreduce_async(t, average=average,
                         name=None if name is None else f"{name}.{i}")
@@ -181,6 +186,9 @@ def grouped_allreduce_(tensors, average: bool = True,
                        name: Optional[str] = None) -> list:
     """In-place grouped allreduce: each tensor's storage receives its
     result (zero-copy for contiguous CPU tensors)."""
+    if not isinstance(tensors, (list, tuple)):
+        raise TypeError(
+            "grouped_allreduce_ expects a list/tuple of tensors")
     handles = [
         allreduce_async_(t, average=average,
                          name=None if name is None else f"{name}.{i}")

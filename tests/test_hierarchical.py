@@ -50,3 +50,19 @@ def test_hierarchical_allgather():
         check_vma=False))
     # (outer, inner) gather order == flat rank order for this mesh layout.
     np.testing.assert_array_equal(np.asarray(f(x)), np.asarray(x))
+
+
+def test_make_multislice_mesh_contiguous_grouping():
+    from horovod_tpu.parallel import make_multislice_mesh
+
+    m = make_multislice_mesh(n_slices=2)
+    assert m.axis_names == ("dcn", "ici")
+    assert m.devices.shape == (2, len(jax.devices()) // 2)
+    # Contiguous grouping: each row is a consecutive run of devices.
+    flat = [d.id for d in m.devices.ravel()]
+    assert flat == sorted(flat)
+
+    with pytest.raises(ValueError, match="n_slices is required"):
+        make_multislice_mesh()
+    with pytest.raises(ValueError, match="not divisible"):
+        make_multislice_mesh(n_slices=3)
