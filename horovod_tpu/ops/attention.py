@@ -316,7 +316,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 
 
 def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
-                    block_q, block_k, interpret):
+                    block_q, block_k, interpret, dlse=None):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
@@ -330,6 +330,13 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     # cheap elementwise XLA, fused into the surrounding graph.
     delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                     axis=-1).reshape(b * h, 1, sq)
+    if dlse is not None:
+        # A cotangent on the lse output (ring attention's cross-block
+        # merge differentiates through it) is EXACTLY a shift of delta:
+        # dL/ds_ij = p_ij (dp_ij - delta_i) + p_ij dlse_i
+        #          = p_ij (dp_ij - (delta_i - dlse_i)),
+        # since d lse_i / d s_ij = p_ij. dv is unaffected.
+        delta = delta - dlse.reshape(b * h, 1, sq).astype(jnp.float32)
 
     num_kb = sk // block_k
     num_qb = sq // block_q

@@ -102,8 +102,8 @@ def make_multislice_mesh(
         return Mesh(arr, (dcn_axis, ici_axis))
     if n_slices is None:
         raise ValueError(
-            "n_slices is required when devices carry no slice_index "
-            "(single-slice or virtual platforms)")
+            "n_slices is required to split these devices: they form a "
+            "single slice or carry no slice_index (virtual platforms)")
     if n % n_slices:
         raise ValueError(f"{n} devices not divisible by {n_slices} slices")
     arr = np.array(devices).reshape(n_slices, n // n_slices)

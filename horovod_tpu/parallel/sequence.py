@@ -167,11 +167,12 @@ def _zigzag_causal_block(q, k, v, sm_scale, my_idx, src, key_mask):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
 def _flash_block_pair(q, maskf, k_blk, v_blk, diag_causal, scale):
-    """(out, lse) of one ring block via the Pallas kernel. Forward only —
-    the backward recomputes the block densely (the lse output carries real
-    gradient through the cross-block merge, which the Pallas backward
-    kernels don't model; the dense block backward is exactly what the
-    non-flash ring differentiates anyway)."""
+    """(out, lse) of one ring block via the Pallas kernel, forward AND
+    backward: the lse output carries real gradient through the cross-block
+    merge, and the Pallas FA-2 backward models it exactly — a cotangent on
+    lse is a per-row shift of the delta term (see ``_flash_backward``'s
+    ``dlse``), so the backward streams K/V tiles too instead of
+    rematerializing the (S_local x S_local) dense block."""
     from ..ops.attention import (
         FLASH_DEFAULT_BLOCK_K,
         FLASH_DEFAULT_BLOCK_Q,
@@ -184,31 +185,25 @@ def _flash_block_pair(q, maskf, k_blk, v_blk, diag_causal, scale):
                           _auto_interpret())
 
 
-def _flash_block_pair_dense(q, maskf, k_blk, v_blk, diag_causal, scale):
-    """Dense twin producing the identical (out, lse) pair — the backward
-    rule differentiates this."""
-    s = q.shape[1]
-    pos = jnp.arange(s)
-    a, m, l = _block_attend(q, k_blk, v_blk, scale, pos, pos, diag_causal,
-                            maskf)
-    l_safe = jnp.maximum(l, 1e-30)
-    o = (a / l_safe).transpose(0, 2, 1, 3).astype(q.dtype)
-    lse = (m + jnp.log(l_safe))[..., 0]                   # (b, h, s)
-    bh, hh, sh = lse.shape
-    return o, lse.reshape(bh * hh, 1, sh)
-
-
 def _flash_block_pair_fwd(q, maskf, k_blk, v_blk, diag_causal, scale):
-    out = _flash_block_pair(q, maskf, k_blk, v_blk, diag_causal, scale)
-    return out, (q, maskf, k_blk, v_blk)
+    out, lse = _flash_block_pair(q, maskf, k_blk, v_blk, diag_causal, scale)
+    return (out, lse), (q, maskf, k_blk, v_blk, out, lse)
 
 
 def _flash_block_pair_bwd(diag_causal, scale, res, cts):
-    q, maskf, k_blk, v_blk = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _flash_block_pair_dense(
-            q_, maskf, k_, v_, diag_causal, scale), q, k_blk, v_blk)
-    dq, dk, dv = vjp(cts)
+    from ..ops.attention import (
+        FLASH_DEFAULT_BLOCK_K,
+        FLASH_DEFAULT_BLOCK_Q,
+        _auto_interpret,
+        _flash_backward,
+    )
+
+    q, maskf, k_blk, v_blk, out, lse = res
+    do, dlse = cts
+    dq, dk, dv = _flash_backward(
+        q, k_blk, v_blk, maskf, out, lse, do, diag_causal, scale,
+        FLASH_DEFAULT_BLOCK_Q, FLASH_DEFAULT_BLOCK_K, _auto_interpret(),
+        dlse=dlse)
     return dq, None, dk, dv
 
 

@@ -174,7 +174,7 @@ def grouped_allreduce_async(tensors, average: bool = True,
     # silently become per-row allreduces.
     if not isinstance(tensors, (list, tuple)):
         raise TypeError(
-            "grouped_allreduce expects a list/tuple of tensors")
+            "grouped_allreduce_async expects a list/tuple of tensors")
     return [
         allreduce_async(t, average=average,
                         name=None if name is None else f"{name}.{i}")
