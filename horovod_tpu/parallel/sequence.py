@@ -21,6 +21,7 @@ Both are shard_map-tier functions: call them inside
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -119,10 +120,13 @@ def _merge_contrib(a, b):
     return acc_a * alpha + acc_b * beta, m, l_a * alpha + l_b * beta
 
 
-def _zigzag_causal_block(q, k, v, sm_scale, my_idx, src, key_mask):
+def _zigzag_causal_cases(q, k, v, key_mask, my_idx, src, attend):
     """Causal zigzag step computing ONLY the allowed half-block products —
     each ring step costs half a dense block on every device (this is where
     the layout's load balancing becomes real FLOPs savings, not masking).
+    ``attend(qh, kh, vh, mask_h, tri)`` returns the (acc, m, l)
+    contribution of one half-block — the dense and flash paths share this
+    case analysis so the load-balancing invariant is encoded once.
 
     With q halves (block i, block 2N-1-i) and the source's K/V halves
     (block j, block 2N-1-j), causality reduces to three cases:
@@ -148,18 +152,16 @@ def _zigzag_causal_block(q, k, v, sm_scale, my_idx, src, key_mask):
                      for x, y in zip(lo, hi))
 
     def eq_case():
-        lo = _half_attend(qlo, klo, vlo, sm_scale, mlo, tri=True)
-        hi = _merge_contrib(
-            _half_attend(qhi, klo, vlo, sm_scale, mlo, tri=False),
-            _half_attend(qhi, khi, vhi, sm_scale, mhi, tri=True))
+        lo = attend(qlo, klo, vlo, mlo, True)
+        hi = _merge_contrib(attend(qhi, klo, vlo, mlo, False),
+                            attend(qhi, khi, vhi, mhi, True))
         return cat(lo, hi)
 
     def lt_case():  # src holds strictly earlier lo block
-        return _half_attend(q, klo, vlo, sm_scale, mlo, tri=False)
+        return attend(q, klo, vlo, mlo, False)
 
     def gt_case():  # only hi queries are late enough to see src's keys
-        return cat(none_rows(h),
-                   _half_attend(qhi, k, v, sm_scale, key_mask, tri=False))
+        return cat(none_rows(h), attend(qhi, k, v, key_mask, False))
 
     return lax.cond(src == my_idx, eq_case,
                     lambda: lax.cond(src < my_idx, lt_case, gt_case))
@@ -199,6 +201,23 @@ def _flash_block_pair_bwd(diag_causal, scale, res, cts):
     )
 
     q, maskf, k_blk, v_blk, out, lse = res
+    if os.environ.get("HOROVOD_FLASH_XLA_BWD"):
+        # Same escape hatch as flash_attention's backward: rematerialize
+        # the (out, lse) pair densely and differentiate through XLA
+        # (O(S_local^2) memory; trace-time switch).
+        def dense_pair(q_, k_, v_):
+            pos = jnp.arange(q_.shape[1])
+            a, m, l = _block_attend(q_, k_, v_, scale, pos, pos,
+                                    diag_causal, maskf)
+            l_safe = jnp.maximum(l, 1e-30)
+            o = (a / l_safe).transpose(0, 2, 1, 3).astype(q_.dtype)
+            lse = (m + jnp.log(l_safe))[..., 0]
+            bh, hh, sh = lse.shape
+            return o, lse.reshape(bh * hh, 1, sh)
+
+        _, vjp = jax.vjp(dense_pair, q, k_blk, v_blk)
+        dq, dk, dv = vjp(cts)
+        return dq, None, dk, dv
     do, dlse = cts
     dq, dk, dv = _flash_backward(
         q, k_blk, v_blk, maskf, out, lse, do, diag_causal, scale,
@@ -208,6 +227,22 @@ def _flash_block_pair_bwd(diag_causal, scale, res, cts):
 
 
 _flash_block_pair.defvjp(_flash_block_pair_fwd, _flash_block_pair_bwd)
+
+
+def _flash_contrib_triple(qh, kh, vh, mask_h, tri, scale):
+    """One block (or zigzag half-block) through the Pallas kernel, as an
+    online-softmax contribution triple (acc, m, l) for ``qh``'s rows: the
+    normalised (out, lse) pair re-enters the merge as acc=out, m=lse, l=1
+    (out_i carries weight exp(lse_i) in the cross-block merge). ``tri``:
+    block and queries share a global offset, so causality is the plain
+    within-block triangle — exactly the kernel's causal mode."""
+    b, _, hn, _ = qh.shape
+    if mask_h is None:
+        mask_h = jnp.ones((b, kh.shape[1]), bool)
+    o, lse = _flash_block_pair(qh, mask_h, kh, vh, tri, scale)
+    a = o.transpose(0, 2, 1, 3).astype(jnp.float32)
+    m = lse.reshape(b, hn, qh.shape[1])[..., None]
+    return a, m, jnp.ones_like(m)
 
 
 def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
@@ -226,9 +261,9 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
       use_flash: run each ring block through the Pallas flash kernel
         instead of materialising the (S_local x S_local) score matrix —
         the per-block (out, lse) pair merges into the online softmax as
-        (acc=out, m=lse, l=1). "auto" (default) enables it for the
-        contiguous layout once S_local >= FLASH_AUTO_MIN_SEQ; the zigzag
-        layout always uses the dense half-block path.
+        (acc=out, m=lse, l=1); zigzag streams each causal half-block the
+        same way. "auto" (default) enables it for either layout once
+        S_local >= FLASH_AUTO_MIN_SEQ.
     Returns: (B, S_local, H, D) — attention of local queries over the FULL
       global sequence, in the same layout as the inputs.
     """
@@ -243,12 +278,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
             f"zigzag layout needs an even local sequence (got {s_local})")
     if use_flash == "auto":
         from ..ops.attention import FLASH_AUTO_MIN_SEQ
-        use_flash = (layout == "contiguous"
-                     and s_local >= FLASH_AUTO_MIN_SEQ)
-    elif use_flash and layout != "contiguous":
-        raise ValueError(
-            "ring_attention flash inner kernel supports the contiguous "
-            "layout only")
+
+        # Causal zigzag streams HALF-blocks through the kernel, so the
+        # dense-vs-flash crossover applies at s_local/2.
+        flash_tokens = (s_local // 2 if causal and layout == "zigzag"
+                        else s_local)
+        use_flash = flash_tokens >= FLASH_AUTO_MIN_SEQ
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
@@ -264,36 +299,35 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
                 jnp.full((b, hn, s_local, 1), NEG_INF / 2, jnp.float32),
                 jnp.zeros((b, hn, s_local, 1), jnp.float32))
 
-    def flash_contrib(k_blk, v_blk, mask_blk, diag_causal):
-        """One ring block through the Pallas kernel: the (normalised out,
-        lse) pair is an online-softmax contribution with acc=out, m=lse,
-        l=1 (out_i carries weight exp(lse_i) in the cross-block merge)."""
-        if mask_blk is None:
-            mask_blk = jnp.ones((b, s_local), bool)
-        o, lse = _flash_block_pair(q, mask_blk, k_blk, v_blk, diag_causal,
-                                   scale)
-        a = o.transpose(0, 2, 1, 3).astype(jnp.float32)  # (b, hn, s, d)
-        bm = lse.reshape(b, hn, s_local)[..., None]      # -inf if fully masked
-        return a, bm, jnp.ones_like(bm)
+    def flash_half(qh, kh, vh, mh, tri):
+        return _flash_contrib_triple(qh, kh, vh, mh, tri, scale)
+
+    def dense_half(qh, kh, vh, mh, tri):
+        return _half_attend(qh, kh, vh, scale, mh, tri)
 
     def contributions(k_blk, v_blk, mask_blk, src):
         if use_flash:
             if not causal:
-                return flash_contrib(k_blk, v_blk, mask_blk, False)
+                return flash_half(q, k_blk, v_blk, mask_blk, False)
+            if layout == "zigzag":
+                # Same balanced three-case analysis as the dense path,
+                # each half-block streamed through the Pallas kernel.
+                return _zigzag_causal_cases(q, k_blk, v_blk, mask_blk,
+                                            my_idx, src, flash_half)
             # Contiguous causal: past blocks attend fully, the diagonal
             # block is standard intra-block causal, future blocks skip.
             return lax.cond(
                 src < my_idx,
-                lambda: flash_contrib(k_blk, v_blk, mask_blk, False),
+                lambda: flash_half(q, k_blk, v_blk, mask_blk, False),
                 lambda: lax.cond(
                     src == my_idx,
-                    lambda: flash_contrib(k_blk, v_blk, mask_blk, True),
+                    lambda: flash_half(q, k_blk, v_blk, mask_blk, True),
                     _empty_contrib))
         if causal and layout == "zigzag":
             # Only the allowed half-blocks are computed — balanced ~half a
             # dense block per device per step.
-            return _zigzag_causal_block(q, k_blk, v_blk, scale, my_idx, src,
-                                        mask_blk)
+            return _zigzag_causal_cases(q, k_blk, v_blk, mask_blk,
+                                        my_idx, src, dense_half)
         if causal and layout == "contiguous":
             # Blocks entirely in the future are skipped, not masked: device
             # i computes i+1 of the N steps (zigzag balances this).

@@ -373,16 +373,77 @@ def test_ring_attention_flash_inner_gradient():
                                    atol=1e-3, rtol=1e-3)
 
 
-def test_ring_attention_flash_zigzag_rejected():
-    mesh = make_mesh({"seq": 8})
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_flash_zigzag(causal):
+    # Zigzag + flash: each causal half-block streams through the Pallas
+    # kernel; results must match plain attention after the unshard.
+    from horovod_tpu.parallel.sequence import zigzag_shard, zigzag_unshard
+
     q, k, v = _qkv(15)
-    with pytest.raises(ValueError, match="contiguous"):
-        jax.shard_map(
-            lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
-                                           layout="zigzag", use_flash=True),
-            mesh=mesh,
-            in_specs=(P(None, "seq"),) * 3,
-            out_specs=P(None, "seq"), check_vma=False)(q, k, v)
+    mesh = make_mesh({"seq": 8})
+    ref = reference_attention(q, k, v, causal=causal)
+
+    qz, kz, vz = (zigzag_shard(x, 8) for x in (q, k, v))
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
+                                       causal=causal, layout="zigzag",
+                                       use_flash=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+    out = zigzag_unshard(f(qz, kz, vz), 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_flash_xla_bwd_escape_hatch(monkeypatch):
+    # HOROVOD_FLASH_XLA_BWD must cover the ring path too: the block pair's
+    # backward rematerializes densely and still matches the reference.
+    monkeypatch.setenv("HOROVOD_FLASH_XLA_BWD", "1")
+    q, k, v = _qkv(18)
+    mesh = make_mesh({"seq": 8})
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
+                                       causal=True, use_flash=True),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+
+    gf = jax.grad(lambda q, k, v: (f(q, k, v).astype(jnp.float32) ** 2)
+                  .sum(), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: (reference_attention(
+        q, k, v, causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
+def test_ring_attention_flash_zigzag_gradient():
+    from horovod_tpu.parallel.sequence import zigzag_shard, zigzag_unshard
+
+    q, k, v = _qkv(17)
+    mesh = make_mesh({"seq": 8})
+
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="seq",
+                                       causal=True, layout="zigzag",
+                                       use_flash=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False))
+
+    def loss_ring(q, k, v):
+        qz, kz, vz = (zigzag_shard(x, 8) for x in (q, k, v))
+        return (zigzag_unshard(f(qz, kz, vz), 8) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    gf = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
 
 
 def test_ulysses_auto_flash_long_seq():
