@@ -2,12 +2,15 @@
 
 Demonstrates sequences sharded across chips: each chip holds S/N tokens and
 K/V blocks rotate over ICI (``horovod_tpu.parallel.sequence.ring_attention``).
-From 512 local tokens each ring block runs through the Pallas flash kernel
-automatically — O(S_local) forward memory (the backward recomputes blocks
-densely, O(S_local^2) per block) — and max context scales linearly with
-the mesh.
+From 512 tokens per kernel call each ring block runs through the Pallas
+flash kernel automatically, forward AND backward (K/V tiles stream
+HBM→VMEM; no S_local x S_local matrix in either direction), so max context
+scales linearly with the mesh. ``--layout zigzag`` balances causal work
+across chips and streams its half-blocks through the same kernel (auto
+threshold 1024 local tokens there, since each call sees S_local/2).
 
     python examples/jax_long_context_ring_attention.py --seq-len 8192
+    python examples/jax_long_context_ring_attention.py --causal --layout zigzag
 """
 
 import argparse

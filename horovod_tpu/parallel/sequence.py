@@ -262,8 +262,10 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
         instead of materialising the (S_local x S_local) score matrix —
         the per-block (out, lse) pair merges into the online softmax as
         (acc=out, m=lse, l=1); zigzag streams each causal half-block the
-        same way. "auto" (default) enables it for either layout once
-        S_local >= FLASH_AUTO_MIN_SEQ.
+        same way. "auto" (default) enables it once the per-KERNEL-CALL
+        token count reaches FLASH_AUTO_MIN_SEQ: S_local for contiguous
+        (and non-causal zigzag), S_local/2 for causal zigzag, whose
+        calls run on half-blocks.
     Returns: (B, S_local, H, D) — attention of local queries over the FULL
       global sequence, in the same layout as the inputs.
     """

@@ -645,6 +645,17 @@ def scenario_inplace(rank, size):
     np.testing.assert_allclose(
         t.numpy(), size * np.arange(10) + sum(range(size)), rtol=1e-6)
 
+    # Non-contiguous torch tensor: no shared view exists, so the in-place
+    # variant must fall back to the copy-back path — same semantics, same
+    # object identity.
+    tnc = (torch.arange(16, dtype=torch.float32).reshape(4, 4) + rank).t()
+    expect(not tnc.is_contiguous(), "test setup: expected non-contiguous")
+    got = hvd_torch.allreduce_(tnc, average=False, name="inp.torch.nc")
+    expect(got is tnc, "non-contiguous allreduce_ returned a new tensor")
+    want_nc = (size * np.arange(16).reshape(4, 4).T
+               + sum(range(size)))
+    np.testing.assert_allclose(tnc.numpy(), want_nc, rtol=1e-6)
+
 
 def scenario_copybench(rank, size):
     # Micro-bench: unfused large-buffer allreduce, value path (1 defensive
