@@ -58,12 +58,18 @@ class RMSNorm(nn.Module):
         return (norm * scale).astype(self.dtype)
 
 
-def rotary_embedding(x, theta: float):
-    """Apply RoPE to (B, S, H, D)."""
+def rotary_embedding(x, theta: float, positions=None):
+    """Apply RoPE to (B, S, H, D). ``positions`` (shape (S,)) are the
+    GLOBAL token positions of the rows — defaults to 0..S-1, but under
+    sequence parallelism each shard must pass its own global offsets
+    (e.g. ``axis_index * S_local + arange(S_local)``) or every shard
+    would rotate as if it held the sequence start."""
     b, s, h, d = x.shape
     half = d // 2
     freqs = 1.0 / (theta ** (np.arange(0, half, dtype=np.float32) / half))
-    angles = jnp.arange(s, dtype=jnp.float32)[:, None] * freqs[None, :]
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.float32)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :]
     sin = jnp.sin(angles)[None, :, None, :]
     x1, x2 = x[..., :half], x[..., half:]
@@ -78,14 +84,16 @@ class LlamaAttention(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         cfg = self.config
         head_dim = cfg.dim // cfg.num_heads
         dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
             features=(heads, head_dim), axis=-1, use_bias=False,
             dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
-        q = rotary_embedding(dense(cfg.num_heads, "wq")(x), cfg.rope_theta)
-        k = rotary_embedding(dense(cfg.num_kv_heads, "wk")(x), cfg.rope_theta)
+        q = rotary_embedding(dense(cfg.num_heads, "wq")(x), cfg.rope_theta,
+                             positions)
+        k = rotary_embedding(dense(cfg.num_kv_heads, "wk")(x),
+                             cfg.rope_theta, positions)
         v = dense(cfg.num_kv_heads, "wv")(x)
         if cfg.num_kv_heads != cfg.num_heads:
             rep = cfg.num_heads // cfg.num_kv_heads
@@ -107,11 +115,12 @@ class LlamaBlock(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         cfg = self.config
         x = x + LlamaAttention(cfg, attention_fn=self.attention_fn,
                                name="attention")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x))
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x),
+            positions)
         h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
         dense = lambda f, name: nn.Dense(  # noqa: E731
             f, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -128,13 +137,16 @@ class LlamaLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, positions=None):
+        """``positions``: global token positions of the local rows, shape
+        (S,). Required under sequence parallelism (each shard passes its
+        global offsets so RoPE rotates correctly); defaults to 0..S-1."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
         for i in range(cfg.num_layers):
             x = LlamaBlock(cfg, attention_fn=self.attention_fn,
-                           name=f"layer_{i}")(x)
+                           name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="lm_head")(x)

@@ -130,11 +130,12 @@ class MoeBlock(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         cfg = self.config
         x = x + LlamaAttention(cfg.llama(), attention_fn=self.attention_fn,
                                name="attention")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x))
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x),
+            positions)
         h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
         return x + MoeFFN(cfg, expert_axis=self.expert_axis,
                           local_experts=self.local_experts,
@@ -160,7 +161,9 @@ class MoeLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, positions=None):
+        """``positions``: global token positions of the local rows (see
+        ``LlamaLM.__call__``) — required under sequence parallelism."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
@@ -171,10 +174,10 @@ class MoeLM(nn.Module):
                 x = MoeBlock(cfg, expert_axis=self.expert_axis,
                              local_experts=self.local_experts,
                              attention_fn=self.attention_fn,
-                             name=f"layer_{i}")(x)
+                             name=f"layer_{i}")(x, positions)
             else:
                 x = LlamaBlock(cfg.llama(), attention_fn=self.attention_fn,
-                               name=f"layer_{i}")(x)
+                               name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="lm_head")(x)
