@@ -6,6 +6,13 @@ at any size:
 
     python examples/jax_llama_training.py --model tiny --seq-len 256
     python examples/jax_llama_training.py --model 1b --seq-len 2048
+
+``--seq-parallel N`` shards the SEQUENCE over N chips (data x seq mesh):
+ring attention rotates K/V blocks over ICI, RoPE gets each shard's global
+positions, and the next-token loss shift crosses shard boundaries with one
+ppermute — max context scales linearly with N.
+
+    python examples/jax_llama_training.py --seq-len 8192 --seq-parallel 4
 """
 
 import argparse
@@ -15,12 +22,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import (LLAMA_1B, LLAMA_8B, LLAMA_300M, LLAMA_TINY,
-                                LlamaLM, causal_lm_loss)
+                                LlamaLM, causal_lm_loss, sp_causal_lm_loss)
 from horovod_tpu.ops.attention import make_attention_fn
+from horovod_tpu.parallel import make_mesh
+from horovod_tpu.parallel.sequence import ring_attention
 
 CONFIGS = {"tiny": LLAMA_TINY, "300m": LLAMA_300M,
            "1b": LLAMA_1B, "8b": LLAMA_8B}
@@ -34,42 +44,82 @@ def main():
                         help="per-chip batch")
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--no-flash", action="store_true")
+    parser.add_argument("--seq-parallel", type=int, default=1,
+                        help="shard the sequence over this many chips "
+                             "(ring attention + global RoPE positions)")
     args = parser.parse_args()
 
     hvd.init()
-    mesh = hvd.parallel.mesh()
     n = hvd.local_num_devices()
     cfg = CONFIGS[args.model]
+    sp = args.seq_parallel
+    if sp < 1 or n % sp or args.seq_len % sp:
+        raise SystemExit(f"--seq-parallel {sp} must be >= 1 and divide both "
+                         f"the device count ({n}) and --seq-len "
+                         f"({args.seq_len})")
+    dp = n // sp
 
-    # use_flash="auto": Pallas flash above FLASH_AUTO_MIN_SEQ, plain XLA
-    # softmax below (faster at short seq; measured on v5e).
-    attention_fn = None if args.no_flash else make_attention_fn(causal=True)
+    if sp > 1:
+        mesh = make_mesh({"data": dp, "seq": sp})
+        ring_flash = False if args.no_flash else "auto"
+        attention_fn = lambda q, k, v, m: ring_attention(  # noqa: E731
+            q, k, v, axis_name="seq", causal=True, use_flash=ring_flash)
+    else:
+        mesh = hvd.parallel.mesh()
+        # use_flash="auto": Pallas flash above FLASH_AUTO_MIN_SEQ, plain
+        # XLA softmax below (faster at short seq; measured on v5e).
+        attention_fn = None if args.no_flash else make_attention_fn(
+            causal=True)
     model = LlamaLM(cfg, attention_fn=attention_fn)
 
-    batch = args.batch_size * n
+    batch = args.batch_size * dp
+    s_local = args.seq_len // sp
     ids = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size,
                                          (batch, args.seq_len)), jnp.int32)
 
-    params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+    # Init with a plain twin: attention_fn contributes no params, and the
+    # ring kernel's axis name only exists inside the shard_map.
+    params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
+                               ids[:1, :s_local])["params"]
     tx = hvd.DistributedOptimizer(optax.adamw(3e-4), axis_name="data")
     opt_state = tx.init(params)
 
-    def loss_fn(p, ids):
-        return causal_lm_loss(model.apply({"params": p}, ids), ids)
+    if sp > 1:
+        def loss_fn(p, ids):
+            idx = lax.axis_index("seq")
+            positions = idx * s_local + jnp.arange(s_local)
+            logits = model.apply({"params": p}, ids, positions=positions)
+            return sp_causal_lm_loss(logits, ids, "seq")
 
-    def train_step(p, s, ids):
-        loss, grads = jax.value_and_grad(loss_fn)(p, ids)
-        updates, s = tx.update(grads, s, p)
-        return optax.apply_updates(p, updates), s, hvd.allreduce(loss)
+        def train_step(p, s, ids):
+            loss, grads = jax.value_and_grad(loss_fn)(p, ids)
+            # Each seq shard holds its contribution to d(global loss)/dp:
+            # sum over the axis; the optimizer then averages over data.
+            grads = jax.tree.map(lambda g: lax.psum(g, "seq"), grads)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, hvd.allreduce(loss)
+
+        in_specs = (P(), P(), P("data", "seq"))
+    else:
+        def loss_fn(p, ids):
+            return causal_lm_loss(model.apply({"params": p}, ids), ids)
+
+        def train_step(p, s, ids):
+            loss, grads = jax.value_and_grad(loss_fn)(p, ids)
+            updates, s = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), s, hvd.allreduce(loss)
+
+        in_specs = (P(), P(), P("data"))
 
     step = jax.jit(jax.shard_map(
         train_step, mesh=mesh,
-        in_specs=(P(), P(), P("data")), out_specs=(P(), P(), P()),
+        in_specs=in_specs, out_specs=(P(), P(), P()),
         check_vma=False,
     ), donate_argnums=(0, 1))
 
-    ids_s = hvd.parallel.shard_batch(ids, mesh)
+    ids_s = jax.device_put(
+        ids, hvd.parallel.data_sharding(mesh, *(("seq",) if sp > 1 else ())))
     params = hvd.parallel.replicate(params, mesh)
     opt_state = hvd.parallel.replicate(opt_state, mesh)
 

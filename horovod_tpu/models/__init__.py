@@ -17,6 +17,7 @@ from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaLM,
     causal_lm_loss,
+    sp_causal_lm_loss,
 )
 from .inception import InceptionV3  # noqa: F401
 from .moe_lm import (  # noqa: F401

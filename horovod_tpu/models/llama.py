@@ -15,6 +15,7 @@ import dataclasses
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -158,3 +159,25 @@ def causal_lm_loss(logits, input_ids):
     targets = input_ids[:, 1:]
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -ll.mean()
+
+
+def sp_causal_lm_loss(logits, input_ids, axis_name: str):
+    """Sequence-parallel twin of :func:`causal_lm_loss`: ``logits`` /
+    ``input_ids`` are the LOCAL (contiguous-layout) sequence shards inside
+    ``shard_map``. The next-token shift crosses shard boundaries, so each
+    shard fetches its right neighbor's first token over one ``ppermute``
+    (riding ICI) and the global final position is masked out; the result
+    is the same global mean on every shard — numerically identical to the
+    single-device loss on the gathered sequence."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    nxt = jax.lax.ppermute(
+        input_ids[:, :1], axis_name,
+        [(i, (i - 1) % n) for i in range(n)])
+    targets = jnp.concatenate([input_ids[:, 1:], nxt], axis=1)
+    logp = nn.log_softmax(logits.astype(jnp.float32))
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    valid = jnp.ones(input_ids.shape, bool).at[:, -1].set(idx != n - 1)
+    total = jax.lax.psum(jnp.where(valid, ll, 0.0).sum(), axis_name)
+    count = jax.lax.psum(valid.sum(), axis_name)
+    return -total / count

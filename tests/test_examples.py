@@ -224,3 +224,12 @@ def test_flash_benchmark_smoke():
                 "--head-dim", "16", "--block-q", "64", "--block-k", "64",
                 "--iters", "2"])
     assert '"metric": "flash_fwd_ms"' in out
+
+
+def test_llama_seq_parallel_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_llama_training.py"),
+                "--model", "tiny", "--seq-len", "64", "--batch-size", "1",
+                "--num-iters", "2", "--seq-parallel", "4"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=4"})
+    assert "tokens/sec" in out

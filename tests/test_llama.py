@@ -130,3 +130,23 @@ def test_sequence_parallel_rope_positions_matter():
         out_specs=P(None, "seq"), check_vma=False))
     out = np.asarray(f(variables, ids), np.float32)
     assert not np.allclose(out, ref, atol=5e-2, rtol=5e-2)
+
+
+def test_sp_causal_lm_loss_matches_single_device():
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.models import sp_causal_lm_loss
+    from horovod_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(7)
+    b, s, vocab = 2, 64, 50
+    logits = jnp.asarray(rng.randn(b, s, vocab), jnp.float32)
+    ids = jnp.asarray(rng.randint(0, vocab, (b, s)), jnp.int32)
+    full = causal_lm_loss(logits, ids)
+
+    mesh = make_mesh({"seq": 8})
+    sp = jax.jit(jax.shard_map(
+        lambda lg, i: sp_causal_lm_loss(lg, i, "seq"),
+        mesh=mesh, in_specs=(P(None, "seq"), P(None, "seq")),
+        out_specs=P(), check_vma=False))(logits, ids)
+    np.testing.assert_allclose(float(sp), float(full), rtol=1e-6)
