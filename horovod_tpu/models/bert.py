@@ -106,7 +106,15 @@ class BertEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, positions=None):
+        """``positions``: global token positions of the local rows, shape
+        (S,) — required under sequence parallelism (each shard passes its
+        global offsets so the learned position embedding indexes
+        correctly); defaults to 0..S-1. The GLOBAL sequence length must
+        stay within ``cfg.max_position_embeddings``: a learned position
+        table cannot extrapolate, and out-of-range indices would be
+        silently clamped by ``nn.Embed`` — unlike RoPE models
+        (``LlamaLM``), BERT's SP context is capped by its table size."""
         cfg = self.config
         b, s = input_ids.shape
         if attention_mask is None:
@@ -115,13 +123,15 @@ class BertEncoder(nn.Module):
             attention_mask = attention_mask.astype(bool)
         if token_type_ids is None:
             token_type_ids = jnp.zeros((b, s), dtype=jnp.int32)
+        if positions is None:
+            positions = jnp.arange(s)
 
         tok = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                        param_dtype=jnp.float32, name="token_embeddings")(
                            input_ids)
         pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
                        param_dtype=jnp.float32, name="position_embeddings")(
-                           jnp.arange(s)[None, :])
+                           positions[None, :])
         typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
                        param_dtype=jnp.float32, name="type_embeddings")(
                            token_type_ids)

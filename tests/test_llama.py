@@ -150,3 +150,39 @@ def test_sp_causal_lm_loss_matches_single_device():
         mesh=mesh, in_specs=(P(None, "seq"), P(None, "seq")),
         out_specs=P(), check_vma=False))(logits, ids)
     np.testing.assert_allclose(float(sp), float(full), rtol=1e-6)
+
+
+def test_sequence_parallel_ulysses():
+    """Ulysses all-to-all SP through the same seam: heads split over the
+    axis, full-sequence attention per shard, global RoPE positions."""
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.parallel import make_mesh
+    from horovod_tpu.parallel.sequence import ulysses_attention
+
+    n = 4  # must divide LLAMA_TINY's 4 heads
+    cfg = LLAMA_TINY
+    s = 64
+    ids = _ids((2, s), seed=5)
+    ref_model = LlamaLM(cfg)
+    variables = ref_model.init(jax.random.PRNGKey(0), ids)
+    ref = ref_model.apply(variables, ids)
+
+    sp_model = LlamaLM(cfg, attention_fn=lambda q, k, v, m:
+                       ulysses_attention(q, k, v, axis_name="seq",
+                                         causal=True))
+    mesh = make_mesh({"seq": n}, devices=jax.devices()[:n])
+    s_local = s // n
+
+    def body(params, ids_shard):
+        idx = jax.lax.axis_index("seq")
+        positions = idx * s_local + jnp.arange(s_local)
+        return sp_model.apply(params, ids_shard, positions=positions)
+
+    f = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(None, "seq")),
+        out_specs=P(None, "seq"), check_vma=False))
+    out = f(variables, ids)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=5e-2, rtol=5e-2)
