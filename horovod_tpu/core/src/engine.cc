@@ -52,8 +52,10 @@ int hvd_shm_allreduce_g(void* h, void* buf, long count, int dtype);
 int hvd_shm_broadcast_g(void* h, void* buf, long count, int dtype, int root);
 int hvd_shm_allgather_g(void* h, const void* in, const long* counts,
                         void* out, int dtype);
+void* hvd_shm_heartbeat_addr(void* h);
 void hvd_shm_destroy(void* h);
 const char* hvd_shm_last_error();
+void hvd_ring_set_progress_sink(void* addr);
 int hvd_ring_init(int rank, int size, const char* addrs, const uint8_t* secret,
                   int secret_len);
 int hvd_ring_allreduce(void* buf, long count, int dtype, int average);
@@ -405,7 +407,12 @@ class Engine {
     if (size_ > 1) hvd_ring_shutdown();
     if (hier_.local_ring) hvd_ringh_destroy(hier_.local_ring);
     if (hier_.cross_ring) hvd_ringh_destroy(hier_.cross_ring);
-    if (hier_.shm) hvd_shm_destroy(hier_.shm);
+    // Unregister the heartbeat sink BEFORE unmapping the segment it
+    // points into (all ring traffic has stopped; no racing writer).
+    if (hier_.shm) {
+      hvd_ring_set_progress_sink(nullptr);
+      hvd_shm_destroy(hier_.shm);
+    }
     hier_.local_ring = hier_.cross_ring = hier_.shm = nullptr;
     if (timeline_) timeline_->close();
   }
@@ -1154,6 +1161,11 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
                             "local ring";
         return -1;
       }
+      // Ring transfers stamp liveness into the shared heartbeat so barrier
+      // waiters in OTHER local processes can tell "leader busy on the
+      // cross phase" from "rank died" (idle timeout, see shm.cc).
+      hvd_ring_set_progress_sink(
+          hvd_shm_heartbeat_addr(hvd::g_hier.shm));
     } else {
       hvd::g_hier.local_ring = hvd_ringh_create(
           hvd::g_hier.local_rank, hvd::g_hier.local_size, local_addrs, secret,
@@ -1170,9 +1182,15 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
       if (!hvd::g_hier.cross_ring) {
         hvd::g_last_error = hvd_ring_last_error();
         // Don't leak the half-built pair (its bound listener would make a
-        // retry fail with EADDRINUSE forever).
+        // retry fail with EADDRINUSE forever). Unregister the heartbeat
+        // sink BEFORE unmapping the segment it points into — later ring
+        // traffic (retry handshakes) must not store through a stale
+        // pointer.
         if (hvd::g_hier.local_ring) hvd_ringh_destroy(hvd::g_hier.local_ring);
-        if (hvd::g_hier.shm) hvd_shm_destroy(hvd::g_hier.shm);
+        if (hvd::g_hier.shm) {
+          hvd_ring_set_progress_sink(nullptr);
+          hvd_shm_destroy(hvd::g_hier.shm);
+        }
         hvd::g_hier = hvd::HierState{};
         return -1;
       }

@@ -30,6 +30,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
@@ -257,6 +258,28 @@ bool wait_fd(int fd, short events) {
 }
 
 // Work on both blocking (handshake) and non-blocking (data phase) fds.
+// Last time any ring in this process moved bytes (monotonic seconds).
+// shm.cc's barrier reads this — and, crucially, a cross-PROCESS sink in
+// the shared segment (set via hvd_ring_set_progress_sink) — to turn its
+// timeout into an IDLE timeout: local ranks waiting at a barrier while
+// their group leader's cross-node phase moves a large payload observe the
+// leader's progress through the shared word and must not be killed.
+std::atomic<double> g_last_progress{0.0};
+std::atomic<std::atomic<double>*> g_progress_sink{nullptr};
+
+double prog_mono_s() {
+  return std::chrono::duration_cast<std::chrono::duration<double>>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void mark_progress() {
+  double now = prog_mono_s();
+  g_last_progress.store(now, std::memory_order_relaxed);
+  auto* sink = g_progress_sink.load(std::memory_order_acquire);
+  if (sink) sink->store(now, std::memory_order_relaxed);
+}
+
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = (const char*)buf;
   while (n > 0) {
@@ -272,6 +295,7 @@ bool send_all(int fd, const void* buf, size_t n) {
     }
     p += k;
     n -= (size_t)k;
+    mark_progress();
   }
   return true;
 }
@@ -295,6 +319,7 @@ bool recv_all(int fd, void* buf, size_t n) {
     }
     p += k;
     n -= (size_t)k;
+    mark_progress();
   }
   return true;
 }
@@ -335,7 +360,10 @@ bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn) {
         set_error(std::string("send: ") + strerror(errno));
         return false;
       }
-      if (k > 0) soff += (size_t)k;
+      if (k > 0) {
+        soff += (size_t)k;
+        mark_progress();
+      }
     }
     if (ri >= 0 && (fds[ri].revents & (POLLIN | POLLERR | POLLHUP))) {
       ssize_t k = recv(ring.left_fd, (char*)rbuf + roff, rn - roff, 0);
@@ -347,7 +375,10 @@ bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn) {
         set_error("recv: peer closed");
         return false;
       }
-      if (k > 0) roff += (size_t)k;
+      if (k > 0) {
+        roff += (size_t)k;
+        mark_progress();
+      }
     }
   }
   return true;
@@ -686,5 +717,20 @@ void hvd_dtype_accumulate(void* dst, const void* src, long count, int dtype) {
 }
 
 long hvd_dtype_size(int dtype) { return (long)dtype_size(dtype); }
+
+// Monotonic timestamp of the last byte any ring in this process moved
+// (0.0 before any traffic). shm.cc's barrier uses it as a liveness signal
+// so its timeout is idle-based, not a cap on a progressing cross phase.
+double hvd_ring_progress_mono_s() {
+  return g_last_progress.load(std::memory_order_relaxed);
+}
+
+// Register a shared-memory word that also receives progress timestamps —
+// making ring liveness visible ACROSS the local group's processes. Pass
+// nullptr to unregister (must happen before the segment unmaps).
+void hvd_ring_set_progress_sink(void* addr) {
+  g_progress_sink.store((std::atomic<double>*)addr,
+                        std::memory_order_release);
+}
 
 }  // extern "C"

@@ -16,27 +16,34 @@
 // spin on the ready flag, everyone meets in one attach barrier, then rank 0
 // shm_unlinks the name — the segment lives until the last munmap, and a
 // crashed job leaks nothing. A stale same-name segment from a killed job is
-// unlinked and recreated. Barriers mean a rank that dies mid-operation
-// hangs its peers (exactly like a peer dying mid-ring-exchange); the
-// engine's stall detection covers both the same way.
+// unlinked and recreated. Phase sync is a sense-reversal barrier with a
+// 60 s timeout (matching the TCP ring's socket-wait timeout, ring.cc
+// wait_fd): a local rank dying mid-operation surfaces as an engine error
+// on its peers instead of an unbounded hang.
 
 #include <fcntl.h>
-#include <pthread.h>
+#include <linux/futex.h>
+#include <sched.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <unistd.h>
 
 #include <atomic>
 #include <cerrno>
+#include <chrono>
+#include <climits>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <string>
 
 extern "C" {
-// ring.cc (shared dtype kernels + error sink)
+// ring.cc (shared dtype kernels + error sink + ring liveness signal)
 void hvd_dtype_accumulate(void* dst, const void* src, long count, int dtype);
 long hvd_dtype_size(int dtype);
 const char* hvd_ring_last_error();
+double hvd_ring_progress_mono_s();
 }
 
 namespace {
@@ -54,10 +61,21 @@ size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
 struct Header {
   uint32_t magic;
   std::atomic<uint32_t> ready;
-  pthread_barrier_t barrier;
+  // Sense-reversal barrier state: arrivals in the current phase, and the
+  // phase generation waiters spin on.
+  std::atomic<uint32_t> arrived;
+  std::atomic<uint32_t> generation;
+  // Cross-process liveness word: every local rank's ring layer stamps its
+  // transfer progress here (hvd_ring_set_progress_sink), so barrier
+  // waiters can tell "leader busy moving bytes" from "rank died". On its
+  // own cache line: the leader stores per socket chunk while peers spin
+  // on `generation` — sharing a line would ping-pong it every chunk.
+  alignas(64) std::atomic<double> heartbeat;
   long slot_bytes;
   int nslots;
 };
+
+constexpr double kBarrierTimeoutS = 60.0;  // == ring.cc wait_fd timeout
 
 struct Group {
   Header* hdr = nullptr;
@@ -71,13 +89,78 @@ struct Group {
   uint8_t* slot(int r) const { return slots + (size_t)r * slot_bytes; }
 };
 
+double mono_s() {
+  return std::chrono::duration_cast<std::chrono::duration<double>>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Process-shared futex on the generation word (plain FUTEX_WAIT/WAKE, not
+// the PRIVATE variant — the segment is mapped by several processes).
+long futex_wait(std::atomic<uint32_t>* addr, uint32_t expected,
+                double timeout_s) {
+  struct timespec ts;
+  ts.tv_sec = (time_t)timeout_s;
+  ts.tv_nsec = (long)((timeout_s - (double)ts.tv_sec) * 1e9);
+  return syscall(SYS_futex, (uint32_t*)addr, FUTEX_WAIT, expected, &ts,
+                 nullptr, 0);
+}
+
+void futex_wake_all(std::atomic<uint32_t>* addr) {
+  syscall(SYS_futex, (uint32_t*)addr, FUTEX_WAKE, INT_MAX, nullptr, nullptr,
+          0);
+}
+
 bool barrier(Group* g) {
-  int rc = pthread_barrier_wait(&g->hdr->barrier);
-  if (rc != 0 && rc != PTHREAD_BARRIER_SERIAL_THREAD) {
-    set_error(std::string("shm barrier failed: ") + strerror(rc));
-    return false;
+  Header* h = g->hdr;
+  uint32_t gen = h->generation.load(std::memory_order_acquire);
+  uint32_t pos = h->arrived.fetch_add(1, std::memory_order_acq_rel) + 1;
+  if (pos == (uint32_t)g->size) {
+    // Last arriver releases the phase. arrived resets BEFORE the
+    // generation bump: peers only proceed (and re-arrive for the next
+    // phase) after acquiring the new generation, which orders the reset
+    // before any next-phase increment.
+    h->arrived.store(0, std::memory_order_relaxed);
+    h->generation.fetch_add(1, std::memory_order_release);
+    futex_wake_all(&h->generation);
+    return true;
   }
-  return true;
+  // Brief yield phase first (covers the near-simultaneous-arrival case
+  // without a syscall round-trip), then block in the kernel with a bounded
+  // wait — a dead local rank surfaces as an error after kBarrierTimeoutS
+  // instead of hanging forever. Kept short: on a timeshared core long
+  // yield-spinning steals quanta from the very peers being waited on.
+  for (int i = 0; i < 8; i++) {
+    if (h->generation.load(std::memory_order_acquire) != gen) return true;
+    sched_yield();
+  }
+  // IDLE timeout, not a phase-duration cap: a peer may legitimately hold
+  // everyone at this barrier for a long time while its cross-node TCP
+  // phase moves a large payload (hier_ring_allreduce: non-root local
+  // ranks wait in the broadcast barrier during the leader's cross-ring
+  // exchange). Ring traffic in this process resets the deadline — only
+  // "nothing moved for kBarrierTimeoutS" is treated as a dead rank, the
+  // same semantics as the ring's per-poll 60 s (ring.cc wait_fd).
+  double start = mono_s();
+  for (;;) {
+    if (h->generation.load(std::memory_order_acquire) != gen) return true;
+    // Freshest liveness of the whole local group: this process's ring
+    // traffic OR any peer's (stamped into the shared heartbeat word).
+    double anchor = hvd_ring_progress_mono_s();
+    double hb = h->heartbeat.load(std::memory_order_relaxed);
+    if (hb > anchor) anchor = hb;
+    if (anchor < start) anchor = start;
+    double remain = anchor + kBarrierTimeoutS - mono_s();
+    if (remain <= 0) {
+      set_error("shm barrier timed out (60s idle) — a local rank died or "
+                "stalled mid-operation");
+      return false;
+    }
+    // Wake (or EAGAIN on a raced generation bump, or timeout slice) and
+    // re-check; 1 s slices keep the idle deadline honest across spurious
+    // wakes and refresh the ring-progress anchor.
+    futex_wait(&h->generation, gen, remain < 1.0 ? remain : 1.0);
+  }
 }
 
 }  // namespace
@@ -169,19 +252,9 @@ void* hvd_shm_create(int local_rank, int local_size, const char* name,
   g->slot_bytes = slot_bytes;
 
   if (local_rank == 0) {
-    pthread_barrierattr_t attr;
-    pthread_barrierattr_init(&attr);
-    pthread_barrierattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
-    if (pthread_barrier_init(&g->hdr->barrier, &attr,
-                             (unsigned)local_size) != 0) {
-      pthread_barrierattr_destroy(&attr);
-      set_error("pthread_barrier_init failed");
-      munmap(base, map_len);
-      shm_unlink(name);
-      delete g;
-      return nullptr;
-    }
-    pthread_barrierattr_destroy(&attr);
+    g->hdr->arrived.store(0, std::memory_order_relaxed);
+    g->hdr->generation.store(0, std::memory_order_relaxed);
+    g->hdr->heartbeat.store(0.0, std::memory_order_relaxed);
     g->hdr->magic = kMagic;
     g->hdr->slot_bytes = slot_bytes;
     g->hdr->nslots = local_size;
@@ -363,6 +436,12 @@ int hvd_shm_allgather_g(void* h, const void* in, const long* counts,
     if (!barrier(g)) return -1;
   }
   return 0;
+}
+
+// Address of the shared heartbeat word, for hvd_ring_set_progress_sink.
+void* hvd_shm_heartbeat_addr(void* h) {
+  Group* g = (Group*)h;
+  return g && g->hdr ? (void*)&g->hdr->heartbeat : nullptr;
 }
 
 void hvd_shm_destroy(void* h) {
