@@ -44,6 +44,8 @@ from .ops.collective_ops import (  # noqa: F401
     allgather_async,
     broadcast,
     broadcast_async,
+    broadcast_object,
+    allgather_object,
     reducescatter,
     alltoall,
     synchronize,

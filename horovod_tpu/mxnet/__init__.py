@@ -41,6 +41,10 @@ from .mpi_ops import (  # noqa: F401
     mpi_threads_supported,
 )
 from .mpi_ops import _controller
+from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
+    allgather_object,
+    broadcast_object,
+)
 
 
 class DistributedOptimizer(mx.optimizer.Optimizer):

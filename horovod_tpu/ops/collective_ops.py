@@ -295,6 +295,60 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handl
                                          name=name, wrap=_wrap_for(tensor))
 
 
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    """Broadcast an arbitrary picklable Python object from ``root_rank``
+    (later-Horovod API; eager tier only). Two collectives: the pickled
+    length first — shapes must match on every rank — then the payload.
+    The transport is the job's HMAC-authenticated channel; unpickling
+    trusts the job's own ranks, exactly like the launcher's wire format."""
+    import pickle
+
+    st = basics.state()
+    if st.topology.size == 1:
+        if root_rank != 0:
+            raise ValueError(f"root_rank {root_rank} out of range for size 1")
+        return pickle.loads(pickle.dumps(obj))
+    base = name or "broadcast_object"
+    rank = st.topology.rank
+    if rank == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        length = np.array([payload.size], np.int64)
+    else:
+        payload = None
+        length = np.zeros(1, np.int64)
+    ctrl = _controller()
+    n = int(np.asarray(ctrl.broadcast(length, root_rank=root_rank,
+                                      name=f"{base}.len"))[0])
+    if payload is None:
+        payload = np.zeros(n, np.uint8)
+    out = np.asarray(ctrl.broadcast(payload, root_rank=root_rank,
+                                    name=f"{base}.data"))
+    return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj, name: Optional[str] = None) -> list:
+    """Gather one arbitrary picklable object per rank, returned in rank
+    order (later-Horovod API; eager tier only). Rides the allgather's
+    variable-first-dim support: each rank contributes its pickled bytes,
+    lengths are gathered alongside to split the concatenation."""
+    import pickle
+
+    st = basics.state()
+    if st.topology.size == 1:
+        return [pickle.loads(pickle.dumps(obj))]
+    base = name or "allgather_object"
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    ctrl = _controller()
+    lengths = np.asarray(ctrl.allgather(
+        np.array([payload.size], np.int64), name=f"{base}.len"))
+    blob = np.asarray(ctrl.allgather(payload, name=f"{base}.data"))
+    out, off = [], 0
+    for n in lengths:
+        out.append(pickle.loads(blob[off:off + int(n)].tobytes()))
+        off += int(n)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # TPU extensions (no reference equivalent; documented as such).
 

@@ -35,6 +35,10 @@ from ..common.basics import (  # noqa: F401
     shutdown,
     size,
 )
+from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
+    allgather_object,
+    broadcast_object,
+)
 from .compression import Compression  # noqa: F401
 
 

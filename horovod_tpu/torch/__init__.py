@@ -44,6 +44,10 @@ from .mpi_ops import (  # noqa: F401
     poll,
     synchronize,
 )
+from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
+    allgather_object,
+    broadcast_object,
+)
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):

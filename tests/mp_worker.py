@@ -160,6 +160,20 @@ def scenario_grouped(rank, size):
             dense_shape=tf.constant([2, 1]))])
 
 
+def scenario_objects(rank, size):
+    # broadcast_object / allgather_object (later-Horovod API): arbitrary
+    # picklable payloads of rank-dependent size over the eager tier.
+    obj = {"rank": rank, "data": list(range(rank + 1)), "tag": "x" * rank}
+    got = hvd.broadcast_object(obj if rank == 1 % size else None,
+                               root_rank=1 % size, name="obj.bc")
+    expect(got["rank"] == 1 % size, f"wrong root object: {got}")
+    gathered = hvd.allgather_object(obj, name="obj.ag")
+    expect(len(gathered) == size, f"expected {size} objects")
+    for r, o in enumerate(gathered):
+        expect(o["rank"] == r and o["data"] == list(range(r + 1)),
+               f"rank {r} object corrupted: {o}")
+
+
 def scenario_allgather(rank, size):
     # Rank-dependent first dims (reference allgather variable-dim tests).
     x = np.full((rank + 1, 3), rank, dtype=np.float32)
@@ -719,6 +733,7 @@ def scenario_shmbench(rank, size):
 SCENARIOS = {
     "inplace": scenario_inplace,
     "grouped": scenario_grouped,
+    "objects": scenario_objects,
     "copybench": scenario_copybench,
     "shmbench": scenario_shmbench,
     "hierarchical": scenario_hierarchical,

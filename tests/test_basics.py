@@ -75,3 +75,12 @@ def test_shutdown_then_raise():
     hvd.shutdown()
     with pytest.raises(ValueError, match="not been initialized"):
         hvd.size()
+
+
+def test_object_collectives_size1():
+    hvd.init()
+    obj = {"a": [1, 2, 3], "b": "text"}
+    got = hvd.broadcast_object(obj)
+    assert got == obj and got is not obj
+    gathered = hvd.allgather_object(obj)
+    assert gathered == [obj]

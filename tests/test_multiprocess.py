@@ -73,7 +73,7 @@ def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "allgather", "broadcast", "cache",
     "error_mismatch", "duplicate_name", "optimizer", "torch", "tensorflow",
-    "mxnet", "inplace", "grouped",
+    "mxnet", "inplace", "grouped", "objects",
 ])
 def test_two_ranks(scenario):
     run_ranks(scenario, size=2)
