@@ -46,6 +46,7 @@ from .ops.collective_ops import (  # noqa: F401
     broadcast_async,
     broadcast_object,
     allgather_object,
+    barrier,
     reducescatter,
     alltoall,
     synchronize,

@@ -272,6 +272,14 @@ class Controller:
                         name: Optional[str] = None,
                         wrap: Optional[Callable] = None,
                         inplace: bool = False) -> Handle:
+        if not 0 <= root_rank < self.topo.size:
+            # Fail fast: an out-of-range root would pass validation on
+            # every rank (they all agree) and hang the data phase.
+            h = self.handles.allocate()
+            h.set_error(ValueError(
+                f"root_rank {root_rank} out of range for size "
+                f"{self.topo.size}"))
+            return h
         array = np.asarray(tensor)
         if inplace and (not array.flags.writeable
                         or not array.flags.c_contiguous):

@@ -326,6 +326,12 @@ class NativeController:
                         name: Optional[str] = None,
                         wrap: Optional[Callable] = None,
                         inplace: bool = False) -> NativeHandle:
+        if not 0 <= root_rank < self.topo.size:
+            # Fail fast: an out-of-range root would pass validation on
+            # every rank (they all agree) and hang the data phase.
+            return NativeHandle.failed(ValueError(
+                f"root_rank {root_rank} out of range for size "
+                f"{self.topo.size}"))
         return self._enqueue("broadcast", name, np.asarray(tensor),
                              root_rank=root_rank, postprocess=wrap,
                              inplace=inplace)

@@ -43,6 +43,7 @@ from .mpi_ops import (  # noqa: F401
 from .mpi_ops import _controller
 from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
     allgather_object,
+    barrier,
     broadcast_object,
 )
 

@@ -295,6 +295,18 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None) -> Handl
                                          name=name, wrap=_wrap_for(tensor))
 
 
+def barrier(name: Optional[str] = None) -> None:
+    """Block until every rank has reached the barrier (later-Horovod API;
+    eager tier only — inside a compiled SPMD program the lockstep schedule
+    IS the barrier). Implemented as a 1-byte allreduce: completion
+    requires every rank's participation by construction."""
+    st = basics.state()
+    if st.topology.size == 1:
+        return
+    _controller().allreduce(np.zeros(1, np.uint8), average=False,
+                            name=name or None)
+
+
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
     """Broadcast an arbitrary picklable Python object from ``root_rank``
     (later-Horovod API; eager tier only). Two collectives: the pickled

@@ -37,6 +37,7 @@ from ..common.basics import (  # noqa: F401
 )
 from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
     allgather_object,
+    barrier,
     broadcast_object,
 )
 from .compression import Compression  # noqa: F401

@@ -46,6 +46,7 @@ from .mpi_ops import (  # noqa: F401
 )
 from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
     allgather_object,
+    barrier,
     broadcast_object,
 )
 

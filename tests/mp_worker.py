@@ -172,6 +172,17 @@ def scenario_objects(rank, size):
     for r, o in enumerate(gathered):
         expect(o["rank"] == r and o["data"] == list(range(r + 1)),
                f"rank {r} object corrupted: {o}")
+    # barrier: all ranks must pass through together; a second barrier with
+    # a fresh name verifies reusability.
+    hvd.barrier()
+    hvd.barrier(name="obj.barrier2")
+    # Out-of-range root fails FAST on every rank (it would pass the
+    # cross-rank validation — all ranks agree — and hang the data phase).
+    try:
+        hvd.broadcast_object(obj, root_rank=size + 3, name="obj.badroot")
+        raise AssertionError("out-of-range root did not raise")
+    except ValueError as exc:
+        expect("out of range" in str(exc), f"wrong error: {exc}")
 
 
 def scenario_allgather(rank, size):

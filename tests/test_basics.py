@@ -84,3 +84,8 @@ def test_object_collectives_size1():
     assert got == obj and got is not obj
     gathered = hvd.allgather_object(obj)
     assert gathered == [obj]
+
+
+def test_barrier_size1():
+    hvd.init()
+    hvd.barrier()  # no-op at size 1, must not raise
