@@ -22,8 +22,11 @@ from ..tensorflow import (  # noqa: F401
     Compression,
     DistributedOptimizer,
     allgather,
+    allgather_object,
     allreduce,
+    barrier,
     broadcast,
+    broadcast_object,
     broadcast_variables,
 )
 from . import callbacks  # noqa: F401

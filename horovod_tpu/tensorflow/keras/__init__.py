@@ -6,9 +6,12 @@ from ...keras import (  # noqa: F401
     Compression,
     DistributedOptimizer,
     allgather,
+    allgather_object,
     allreduce,
+    barrier,
     broadcast,
     broadcast_global_variables,
+    broadcast_object,
     broadcast_variables,
     callbacks,
     cross_rank,
