@@ -152,7 +152,7 @@ def test_star_data_plane(scenario):
 
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "cache", "error_mismatch", "duplicate_name",
-    "inplace",
+    "inplace", "grouped", "objects",
 ])
 def test_python_engine(scenario):
     # The Python controller (TCP star control plane) remains selectable via
