@@ -467,3 +467,33 @@ def test_ulysses_auto_flash_long_seq():
     out = f(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=1e-3)
+
+
+def test_ring_attention_flash_zigzag_key_mask():
+    # Zigzag + flash + key mask: the mask halves must follow the zigzag
+    # shard order alongside K/V. Non-fully-masked batch checked against
+    # the reference (flash defines fully-masked rows as zeros).
+    from horovod_tpu.parallel.sequence import zigzag_shard, zigzag_unshard
+
+    q, k, v = _qkv(19)
+    mask_np = np.random.RandomState(21).rand(B, S) > 0.3
+    # Key 0 visible everywhere: under causal masking row i sees keys 0..i,
+    # so this guarantees no fully-masked row — where flash (zeros) and the
+    # reference (uniform softmax over all -inf) deliberately differ.
+    mask_np[:, 0] = True
+    mask = jnp.asarray(mask_np)
+    mesh = make_mesh({"seq": 8})
+    ref = reference_attention(q, k, v, key_mask=mask, causal=True)
+
+    qz, kz, vz = (zigzag_shard(x, 8) for x in (q, k, v))
+    mz = zigzag_shard(mask, 8, axis=1)
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v, m: ring_attention(q, k, v, axis_name="seq",
+                                          causal=True, layout="zigzag",
+                                          key_mask=m, use_flash=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3 + (P(None, "seq"),),
+        out_specs=P(None, "seq"), check_vma=False))
+    out = zigzag_unshard(f(qz, kz, vz, mz), 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
