@@ -741,9 +741,33 @@ def scenario_shmbench(rank, size):
           flush=True)
 
 
+def scenario_shmgather(rank, size):
+    # Variable-count hierarchical allgather with per-rank blocks LARGER
+    # than the shm slot: exercises hvd_shm_allgather_g's multi-pass loop
+    # (each pass moves up to slot_bytes of each rank's block). Run with
+    # HOROVOD_SHM_SLOT_BYTES=4096 by the parent test.
+    from horovod_tpu.common import basics
+
+    ctrl = basics.state().controller
+    expect(getattr(ctrl, "hierarchical_active", False),
+           "hierarchical data plane not active")
+    n = (rank + 1) * 1500  # 6..24 KB of f32 per rank, uneven
+    x = (np.arange(n, dtype=np.float32) % 97) + rank
+    out = np.asarray(hvd.allgather(x, name="shg.var"))
+    parts = [(np.arange((r + 1) * 1500, dtype=np.float32) % 97) + r
+             for r in range(size)]
+    np.testing.assert_array_equal(out, np.concatenate(parts))
+    # And an allreduce larger than the slot through the same group.
+    big = np.ones(3000, np.float32) * (rank + 1)
+    tot = np.asarray(hvd.allreduce(big, average=False, name="shg.sum"))
+    np.testing.assert_allclose(tot, np.ones(3000) * sum(
+        r + 1 for r in range(size)), rtol=1e-6)
+
+
 SCENARIOS = {
     "inplace": scenario_inplace,
     "grouped": scenario_grouped,
+    "shmgather": scenario_shmgather,
     "objects": scenario_objects,
     "copybench": scenario_copybench,
     "shmbench": scenario_shmbench,

@@ -184,6 +184,28 @@ def test_hierarchical_two_level(engine):
         assert f"worker rank={r} scenario=hierarchical: OK" in res.stdout
 
 
+def test_shm_allgather_multipass_uneven_counts():
+    """Per-rank blocks larger than a tiny 4 KiB shm slot force the
+    chunked multi-pass allgather/allreduce paths with uneven counts."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
+    env["HOROVOD_ENGINE"] = "native"
+    env["HOROVOD_SHM_SLOT_BYTES"] = "4096"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+         "-H", "localhost:2,localhost:2",
+         sys.executable, WORKER, "shmgather"],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(4):
+        assert f"worker rank={r} scenario=shmgather: OK" in res.stdout
+
+
 def _run_shmbench(shm_disable):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
