@@ -44,27 +44,41 @@ def spmd_mode(args):
 
 
 def eager_mode(args):
+    from horovod_tpu.common import basics
+
     elems = args.size_mb * (1 << 20) // 4
     x = np.ones(elems, np.float32) * hvd.rank()
     # warmup + correctness
     out = np.asarray(hvd.allreduce(x, average=False, name="bw.warm"))
     expected = sum(range(hvd.size()))
     assert abs(float(out[0]) - expected) < 1e-3, out[0]
+    ctrl = basics.controller()
     t0 = time.perf_counter()
     for i in range(args.iters):
-        hvd.allreduce(x, average=False, name=f"bw.{i}")
+        if args.inplace:
+            # Zero-copy path: the engine reduces directly in x's memory
+            # (x accumulates across iters; only bandwidth is measured).
+            ctrl.allreduce_async(x, average=False, name=f"bw.{i}",
+                                 inplace=True).wait()
+        else:
+            hvd.allreduce(x, average=False, name=f"bw.{i}")
     dt = (time.perf_counter() - t0) / args.iters
     n = hvd.size()
     bus = 2 * (n - 1) / n * elems * 4 / dt
     if hvd.rank() == 0:
-        print(f"eager ring allreduce {args.size_mb} MiB over {n} ranks: "
-              f"{dt * 1e3:.2f} ms, bus bandwidth {bus / 1e9:.2f} GB/s")
+        mode = "in-place (zero-copy)" if args.inplace else "value (1 copy)"
+        print(f"eager ring allreduce {args.size_mb} MiB over {n} ranks, "
+              f"{mode}: {dt * 1e3:.2f} ms, "
+              f"bus bandwidth {bus / 1e9:.2f} GB/s")
 
 
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--size-mb", type=int, default=64)
     parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--inplace", action="store_true",
+                        help="eager mode: reduce in place on the caller "
+                             "buffer (zero host copies)")
     args = parser.parse_args()
     hvd.init()
     if hvd.size() > 1:
