@@ -184,6 +184,29 @@ def test_hierarchical_two_level(engine):
         assert f"worker rank={r} scenario=hierarchical: OK" in res.stdout
 
 
+def test_timeline_names_shm_data_plane(tmp_path):
+    """With the shm local plane active, timeline activities must say which
+    plane moved the bytes (SHM_CROSS_RING_COLLECTIVE, docs/timeline.md)."""
+    tl_file = tmp_path / "timeline.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    env["HOROVOD_ENGINE"] = "native"
+    env["HOROVOD_TIMELINE"] = str(tl_file)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
+         "-H", "localhost:2,localhost:2",
+         sys.executable, WORKER, "hierarchical"],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+    assert res.returncode == 0, res.stdout + res.stderr
+    content = tl_file.read_text()
+    assert "SHM_CROSS_RING_COLLECTIVE" in content
+    assert "NEGOTIATE_ALLREDUCE" in content
+
+
 def test_shm_allgather_multipass_uneven_counts():
     """Per-rank blocks larger than a tiny 4 KiB shm slot force the
     chunked multi-pass allgather/allreduce paths with uneven counts."""
