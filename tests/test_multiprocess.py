@@ -25,27 +25,38 @@ def _free_port() -> int:
     return port
 
 
+def _launcher_env(**extra):
+    """Env for tests that go through ``python -m horovod_tpu.run``: repo on
+    PYTHONPATH, CPU-only ranks (must not contend for the TPU the pytest
+    parent holds — the axon sitecustomize blocks minutes on the grant), fast
+    cycle time. ``extra`` values override; a value of ``None`` unsets."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_CYCLE_TIME"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for key, value in extra.items():
+        if value is None:
+            env.pop(key, None)
+        else:
+            env[key] = value
+    return env
+
+
 def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
               extra_env=None):
     addr = f"127.0.0.1:{_free_port()}"
     ring_addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(size))
     procs = []
     for rank in range(size):
-        env = dict(os.environ)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(size),
-            "HOROVOD_CONTROLLER_ADDR": addr,
-            "HOROVOD_RING_ADDRS": ring_addrs,
-            "HOROVOD_CYCLE_TIME": "1",
-            "JAX_PLATFORMS": "cpu",
-            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
-        })
-        # CPU-only rank processes must not contend for the TPU the pytest
-        # parent holds (axon sitecustomize blocks minutes on the grant).
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env = _launcher_env(
+            HOROVOD_RANK=str(rank),
+            HOROVOD_SIZE=str(size),
+            HOROVOD_LOCAL_RANK=str(rank),
+            HOROVOD_LOCAL_SIZE=str(size),
+            HOROVOD_CONTROLLER_ADDR=addr,
+            HOROVOD_RING_ADDRS=ring_addrs,
+        )
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, WORKER, scenario],
@@ -166,14 +177,9 @@ def test_hierarchical_two_level(engine):
     # 4 ranks as 2 simulated nodes x 2 ranks via the launcher's -H grouping;
     # the reference's HOROVOD_HIERARCHICAL_* env vars flip on the two-level
     # data plane (local ring + cross ring of local roots) in both engines.
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
-    env["HOROVOD_ENGINE"] = engine
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _launcher_env(HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                        HOROVOD_HIERARCHICAL_ALLGATHER="1",
+                        HOROVOD_ENGINE=engine)
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
          "-H", "localhost:2,localhost:2",
@@ -188,14 +194,9 @@ def test_timeline_names_shm_data_plane(tmp_path):
     """With the shm local plane active, timeline activities must say which
     plane moved the bytes (SHM_CROSS_RING_COLLECTIVE, docs/timeline.md)."""
     tl_file = tmp_path / "timeline.json"
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
-    env["HOROVOD_ENGINE"] = "native"
-    env["HOROVOD_TIMELINE"] = str(tl_file)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _launcher_env(HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                        HOROVOD_ENGINE="native",
+                        HOROVOD_TIMELINE=str(tl_file))
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
          "-H", "localhost:2,localhost:2",
@@ -210,15 +211,10 @@ def test_timeline_names_shm_data_plane(tmp_path):
 def test_shm_allgather_multipass_uneven_counts():
     """Per-rank blocks larger than a tiny 4 KiB shm slot force the
     chunked multi-pass allgather/allreduce paths with uneven counts."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLGATHER"] = "1"
-    env["HOROVOD_ENGINE"] = "native"
-    env["HOROVOD_SHM_SLOT_BYTES"] = "4096"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _launcher_env(HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                        HOROVOD_HIERARCHICAL_ALLGATHER="1",
+                        HOROVOD_ENGINE="native",
+                        HOROVOD_SHM_SLOT_BYTES="4096")
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
          "-H", "localhost:2,localhost:2",
@@ -230,17 +226,9 @@ def test_shm_allgather_multipass_uneven_counts():
 
 
 def _run_shmbench(shm_disable):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
-    env["HOROVOD_ENGINE"] = "native"
-    if shm_disable:
-        env["HOROVOD_SHM_DISABLE"] = "1"
-    else:
-        env.pop("HOROVOD_SHM_DISABLE", None)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _launcher_env(HOROVOD_HIERARCHICAL_ALLREDUCE="1",
+                        HOROVOD_ENGINE="native",
+                        HOROVOD_SHM_DISABLE="1" if shm_disable else None)
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
          "-H", "localhost:2,localhost:2",
@@ -278,13 +266,7 @@ def test_autotune_categorical_hierarchical_stays_correct():
     # Autotune on a 2x2-node layout (rings available, hierarchical flag OFF)
     # may flip the two-level path mid-run via the synced reply; results must
     # stay correct throughout.
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["HOROVOD_AUTOTUNE"] = "1"
-    env["HOROVOD_ENGINE"] = "python"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _launcher_env(HOROVOD_AUTOTUNE="1", HOROVOD_ENGINE="python")
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
          "-H", "localhost:2,localhost:2",
@@ -300,12 +282,7 @@ def test_hierarchical_flags_heterogeneous_layout_falls_back():
     # launcher must NOT export group rings (mixed sizes would diverge the
     # per-rank path choice) and the job must still produce correct results
     # on the flat data plane.
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HOROVOD_CYCLE_TIME"] = "1"
-    env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env = _launcher_env(HOROVOD_HIERARCHICAL_ALLREDUCE="1")
     res = subprocess.run(
         [sys.executable, "-m", "horovod_tpu.run", "-np", "3",
          "-H", "localhost:2,localhost:2",
