@@ -145,7 +145,9 @@ class BertEncoder(nn.Module):
                                  name=f"layer_{i}")(
                                      x, attention_mask, deterministic)
 
-        logits = nn.Dense(cfg.vocab_size, dtype=jnp.float32,
+        # Head matmul in the model compute dtype (MXU accumulates f32
+        # internally); mlm_loss upcasts to f32 before the softmax.
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
                           param_dtype=jnp.float32, name="mlm_head")(x)
         return logits
 

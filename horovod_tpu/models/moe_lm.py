@@ -179,5 +179,7 @@ class MoeLM(nn.Module):
                 x = LlamaBlock(cfg.llama(), attention_fn=self.attention_fn,
                                name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
-        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+        # Head matmul in the model compute dtype, matching LlamaLM (MXU
+        # accumulates f32 internally; the loss upcasts before the softmax).
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="lm_head")(x)
