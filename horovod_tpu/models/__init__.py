@@ -18,6 +18,7 @@ from .llama import (  # noqa: F401
     LlamaLM,
     causal_lm_loss,
     sp_causal_lm_loss,
+    token_nll,
 )
 from .inception import InceptionV3  # noqa: F401
 from .moe_lm import (  # noqa: F401

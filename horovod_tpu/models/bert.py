@@ -18,6 +18,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from .llama import token_nll
+
 
 @dataclasses.dataclass(frozen=True)
 class BertConfig:
@@ -153,8 +155,11 @@ class BertEncoder(nn.Module):
 
 
 def mlm_loss(logits, labels, label_mask):
-    """Masked-LM cross entropy over positions where label_mask is 1."""
-    logp = nn.log_softmax(logits.astype(jnp.float32))
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    """Masked-LM cross entropy over positions where label_mask is 1.
+
+    Uses the lse formulation (``lse(logits) - logits[label]``) so no
+    (B, S, V) f32 array is materialized — see
+    ``horovod_tpu.models.llama.token_nll``."""
+    nll = token_nll(logits, labels)
     label_mask = label_mask.astype(jnp.float32)
-    return -(ll * label_mask).sum() / jnp.maximum(label_mask.sum(), 1.0)
+    return (nll * label_mask).sum() / jnp.maximum(label_mask.sum(), 1.0)

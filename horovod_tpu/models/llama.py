@@ -157,12 +157,25 @@ class LlamaLM(nn.Module):
                         param_dtype=jnp.float32, name="lm_head")(x)
 
 
+def token_nll(logits, targets):
+    """Per-token negative log-likelihood via the lse formulation:
+    ``lse(logits) - logits[target]``. Unlike ``log_softmax`` +
+    ``take_along_axis`` this never materializes a (..., V) f32 array —
+    the f32 upcast fuses into the logsumexp reduction and the target
+    logit is a gather — which cuts ~1 GiB of peak HBM at
+    (B=8, S=1024, V=32000) and is what lets larger batches fit."""
+    # Gather BEFORE the upcast: astype-then-gather would force the f32
+    # copy this formulation exists to avoid (the upcast inside logsumexp
+    # fuses into the reduction; a gather consumer would not).
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    return lse - target_logit
+
+
 def causal_lm_loss(logits, input_ids):
     """Next-token cross entropy (shifted)."""
-    logp = nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-    targets = input_ids[:, 1:]
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -ll.mean()
+    return token_nll(logits[:, :-1], input_ids[:, 1:]).mean()
 
 
 def sp_causal_lm_loss(logits, input_ids, axis_name: str):
@@ -179,9 +192,8 @@ def sp_causal_lm_loss(logits, input_ids, axis_name: str):
         input_ids[:, :1], axis_name,
         [(i, (i - 1) % n) for i in range(n)])
     targets = jnp.concatenate([input_ids[:, 1:], nxt], axis=1)
-    logp = nn.log_softmax(logits.astype(jnp.float32))
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    nll = token_nll(logits, targets)
     valid = jnp.ones(input_ids.shape, bool).at[:, -1].set(idx != n - 1)
-    total = jax.lax.psum(jnp.where(valid, ll, 0.0).sum(), axis_name)
+    total = jax.lax.psum(jnp.where(valid, nll, 0.0).sum(), axis_name)
     count = jax.lax.psum(valid.sum(), axis_name)
-    return -total / count
+    return total / count
