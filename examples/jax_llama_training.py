@@ -16,6 +16,7 @@ ppermute — max context scales linearly with N.
 """
 
 import argparse
+import dataclasses
 import time
 
 import jax
@@ -27,7 +28,8 @@ from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import (LLAMA_1B, LLAMA_8B, LLAMA_300M, LLAMA_TINY,
-                                LlamaLM, causal_lm_loss, sp_causal_lm_loss)
+                                LlamaLM, causal_lm_loss,
+                                chunked_causal_lm_loss, sp_causal_lm_loss)
 from horovod_tpu.ops.attention import make_attention_fn
 from horovod_tpu.parallel import make_mesh
 from horovod_tpu.parallel.sequence import ring_attention
@@ -47,17 +49,33 @@ def main():
     parser.add_argument("--seq-parallel", type=int, default=1,
                         help="shard the sequence over this many chips "
                              "(ring attention + global RoPE positions)")
+    parser.add_argument("--remat", action="store_true",
+                        help="jax.checkpoint each block: O(1)-layers live "
+                             "activations for ~1/3 extra FLOPs (long "
+                             "sequences past the no-remat HBM ceiling)")
+    parser.add_argument("--chunked-loss", type=int, default=0, metavar="K",
+                        help="split the sequence into K chunks and apply "
+                             "the lm_head + loss per chunk (LARGER K = "
+                             "less peak HBM): the (B,S,V) logits never "
+                             "materialize (pairs with --remat for the "
+                             "longest single-chip sequences)")
     args = parser.parse_args()
 
     hvd.init()
     n = hvd.local_num_devices()
     cfg = CONFIGS[args.model]
+    if args.remat:
+        cfg = dataclasses.replace(cfg, remat=True)
     sp = args.seq_parallel
     if sp < 1 or n % sp or args.seq_len % sp:
         raise SystemExit(f"--seq-parallel {sp} must be >= 1 and divide both "
                          f"the device count ({n}) and --seq-len "
                          f"({args.seq_len})")
     dp = n // sp
+    if args.chunked_loss and sp > 1:
+        raise SystemExit("--chunked-loss applies to the single-sequence "
+                         "path; under --seq-parallel the logits are already "
+                         "sequence-sharded")
 
     if sp > 1:
         mesh = make_mesh({"data": dp, "seq": sp})
@@ -79,9 +97,13 @@ def main():
                                          (batch, args.seq_len)), jnp.int32)
 
     # Init with a plain twin: attention_fn contributes no params, and the
-    # ring kernel's axis name only exists inside the shard_map.
+    # ring kernel's axis name only exists inside the shard_map. Init at a
+    # SHORT length — params are length-independent, and the twin's XLA
+    # attention would materialize S^2 logits at full length (16 GiB at
+    # S=16k: the init, not the train step, was the single-chip ceiling).
+    init_len = min(s_local, 512)
     params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
-                               ids[:1, :s_local])["params"]
+                               ids[:1, :init_len])["params"]
     tx = hvd.DistributedOptimizer(optax.adamw(3e-4), axis_name="data")
     opt_state = tx.init(params)
 
@@ -102,8 +124,15 @@ def main():
 
         in_specs = (P(), P(), P("data", "seq"))
     else:
-        def loss_fn(p, ids):
-            return causal_lm_loss(model.apply({"params": p}, ids), ids)
+        if args.chunked_loss:
+            def loss_fn(p, ids):
+                hidden = model.apply({"params": p}, ids, return_hidden=True)
+                return chunked_causal_lm_loss(
+                    hidden, p["lm_head"]["kernel"], ids,
+                    num_chunks=args.chunked_loss)
+        else:
+            def loss_fn(p, ids):
+                return causal_lm_loss(model.apply({"params": p}, ids), ids)
 
         def train_step(p, s, ids):
             loss, grads = jax.value_and_grad(loss_fn)(p, ids)

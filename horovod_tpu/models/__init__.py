@@ -17,6 +17,7 @@ from .llama import (  # noqa: F401
     LlamaConfig,
     LlamaLM,
     causal_lm_loss,
+    chunked_causal_lm_loss,
     sp_causal_lm_loss,
     token_nll,
 )

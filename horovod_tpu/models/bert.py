@@ -32,6 +32,9 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
+    # jax.checkpoint each transformer block in the backward pass (see
+    # LlamaConfig.remat).
+    remat: bool = False
 
 
 BERT_BASE = BertConfig()
@@ -142,10 +145,12 @@ class BertEncoder(nn.Module):
                          name="embed_norm")(x)
         x = nn.Dropout(cfg.dropout_rate)(x, deterministic=deterministic)
 
+        block_cls = (nn.remat(TransformerBlock, static_argnums=(3,))
+                     if cfg.remat else TransformerBlock)
         for i in range(cfg.num_layers):
-            x = TransformerBlock(cfg, attention_fn=self.attention_fn,
-                                 name=f"layer_{i}")(
-                                     x, attention_mask, deterministic)
+            x = block_cls(cfg, attention_fn=self.attention_fn,
+                          name=f"layer_{i}")(
+                              x, attention_mask, deterministic)
 
         # Head matmul in the model compute dtype (MXU accumulates f32
         # internally); mlm_loss upcasts to f32 before the softmax.

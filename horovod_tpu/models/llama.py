@@ -32,6 +32,11 @@ class LlamaConfig:
     max_seq_len: int = 8192
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # Rematerialize each block's activations in the backward pass
+    # (jax.checkpoint): live activations drop from O(layers) to O(1)
+    # layers' worth at ~1/3 extra FLOPs — the knob that lets sequence
+    # length scale past what HBM holds at remat=False.
+    remat: bool = False
 
 
 LLAMA_8B = LlamaConfig()
@@ -138,17 +143,25 @@ class LlamaLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, return_hidden=False):
         """``positions``: global token positions of the local rows, shape
         (S,). Required under sequence parallelism (each shard passes its
-        global offsets so RoPE rotates correctly); defaults to 0..S-1."""
+        global offsets so RoPE rotates correctly); defaults to 0..S-1.
+        ``return_hidden``: skip the lm_head and return the final-norm
+        hidden states (B, S, dim) — pair with
+        :func:`chunked_causal_lm_loss`."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
+        block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
-            x = LlamaBlock(cfg, attention_fn=self.attention_fn,
-                           name=f"layer_{i}")(x, positions)
+            x = block_cls(cfg, attention_fn=self.attention_fn,
+                          name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            # For chunked_causal_lm_loss: the caller applies the lm_head
+            # chunk-by-chunk so the (B, S, V) logits never materialize.
+            return x
         # Head matmul in the model compute dtype (MXU accumulates f32
         # internally); the loss upcasts to f32 before the softmax. Measured
         # v5e (LLAMA_300M, B=8 S=1024): 215.4 vs 222.0 ms/step for an f32
@@ -176,6 +189,47 @@ def token_nll(logits, targets):
 def causal_lm_loss(logits, input_ids):
     """Next-token cross entropy (shifted)."""
     return token_nll(logits[:, :-1], input_ids[:, 1:]).mean()
+
+
+def chunked_causal_lm_loss(hidden, head_kernel, input_ids,
+                           num_chunks: int = 8):
+    """:func:`causal_lm_loss` with the lm_head fused in, applied one
+    sequence chunk at a time under ``jax.checkpoint``: the full (B, S, V)
+    logits — and, in the backward pass, their same-sized cotangent — never
+    exist; peak extra HBM is O(B * S/num_chunks * V). At Llama-300M
+    S=16384 that's the ~2 GiB that makes single-chip training fit where
+    the fused-head path OOMs.
+
+    ``hidden``: final-norm hidden states from
+    ``model.apply(..., return_hidden=True)``, shape (B, S, dim);
+    ``head_kernel``: ``params["lm_head"]["kernel"]`` (dim, V).
+    The LOSS matches ``causal_lm_loss`` on the full logits exactly (each
+    logit row is the same dot product; the mean is reassembled exactly).
+    Head/hidden GRADIENTS agree up to bf16 rounding at chunk boundaries:
+    each chunk's dW partial quantizes to bf16 before the cross-chunk sum,
+    where the fused head quantizes once (measured ~0.7% grad-norm delta —
+    bf16-training noise level)."""
+    b, s, d = hidden.shape
+    if s % num_chunks:
+        raise ValueError(
+            f"chunked_causal_lm_loss: seq len {s} must be divisible by "
+            f"num_chunks {num_chunks}")
+    c = s // num_chunks
+    # Shifted targets over the FULL sequence; the final position has no
+    # next token — it wraps to a garbage value and is masked out below.
+    targets = jnp.concatenate([input_ids[:, 1:], input_ids[:, :1]], axis=1)
+    h = hidden.reshape(b, num_chunks, c, d).transpose(1, 0, 2, 3)
+    t = targets.reshape(b, num_chunks, c).transpose(1, 0, 2)
+    w = head_kernel.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def chunk_nll(h_c, t_c):
+        # Same matmul dtype as the in-model lm_head (MXU f32 accumulate).
+        return token_nll(h_c @ w, t_c)
+
+    nll = jax.lax.map(lambda args: chunk_nll(*args), (h, t))
+    nll = nll.transpose(1, 0, 2).reshape(b, s)
+    return nll[:, :-1].mean()
 
 
 def sp_causal_lm_loss(logits, input_ids, axis_name: str):

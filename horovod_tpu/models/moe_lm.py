@@ -53,6 +53,9 @@ class MoeConfig:
     norm_eps: float = 1e-5
     rope_theta: float = 1e4
     dtype: Any = jnp.bfloat16
+    # jax.checkpoint each block in the backward pass (see
+    # LlamaConfig.remat).
+    remat: bool = False
 
     def llama(self) -> LlamaConfig:
         return LlamaConfig(
@@ -167,17 +170,19 @@ class MoeLM(nn.Module):
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
+        moe_cls = nn.remat(MoeBlock) if cfg.remat else MoeBlock
+        dense_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
             # Every moe_every-th layer is routed (moe_every=1: all layers);
             # the rest are plain LlamaBlocks (shared implementation).
             if i % cfg.moe_every == cfg.moe_every - 1:
-                x = MoeBlock(cfg, expert_axis=self.expert_axis,
-                             local_experts=self.local_experts,
-                             attention_fn=self.attention_fn,
-                             name=f"layer_{i}")(x, positions)
+                x = moe_cls(cfg, expert_axis=self.expert_axis,
+                            local_experts=self.local_experts,
+                            attention_fn=self.attention_fn,
+                            name=f"layer_{i}")(x, positions)
             else:
-                x = LlamaBlock(cfg.llama(), attention_fn=self.attention_fn,
-                               name=f"layer_{i}")(x, positions)
+                x = dense_cls(cfg.llama(), attention_fn=self.attention_fn,
+                              name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         # Head matmul in the model compute dtype, matching LlamaLM (MXU
         # accumulates f32 internally; the loss upcasts before the softmax).

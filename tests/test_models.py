@@ -149,3 +149,34 @@ def test_bert_sequence_parallel_positions():
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                atol=5e-2, rtol=5e-2)
+
+
+def test_bert_remat_matches_no_remat():
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from horovod_tpu.models import BERT_TINY, BertEncoder, mlm_loss
+
+    cfg = BERT_TINY
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    mask = jnp.asarray(np.random.RandomState(1).rand(2, 16) < 0.3)
+    base = BertEncoder(cfg)
+    remat = BertEncoder(dataclasses.replace(cfg, remat=True))
+    variables = base.init(jax.random.PRNGKey(0), ids, deterministic=True)
+
+    def loss_fn(model):
+        def f(params):
+            logits = model.apply({"params": params}, ids, deterministic=True)
+            return mlm_loss(logits, ids, mask)
+        return f
+
+    l0, g0 = jax.value_and_grad(loss_fn(base))(variables["params"])
+    l1, g1 = jax.value_and_grad(loss_fn(remat))(variables["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0, g1)

@@ -109,3 +109,29 @@ def test_moe_lm_flash_attention_fn():
     out = flash_model.apply({"params": variables["params"]}, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=5e-2, rtol=5e-2)
+
+
+def test_moe_remat_matches_no_remat():
+    import dataclasses
+
+    ids = _ids()
+    base = MoeLM(MOE_TINY)
+    remat = MoeLM(dataclasses.replace(MOE_TINY, remat=True))
+    variables = base.init(jax.random.PRNGKey(0), ids)
+
+    def loss_fn(model):
+        def f(params):
+            logits, col = model.apply({"params": params}, ids,
+                                      mutable=["aux_loss"])
+            return (causal_lm_loss(logits, ids)
+                    + sum(jax.tree.leaves(col["aux_loss"])))
+        return f
+
+    # remat must preserve the math INCLUDING the sow'd aux-loss collection
+    # (nn.remat lifts mutable collections through the checkpoint).
+    l0, g0 = jax.value_and_grad(loss_fn(base))(variables["params"])
+    l1, g1 = jax.value_and_grad(loss_fn(remat))(variables["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0, g1)
