@@ -5,8 +5,9 @@ No reference-repo equivalent (2019-era); required by the rebuild's target
 workloads (BASELINE.json config "Llama-3-8B — stress fused allreduce at LLM
 gradient sizes"). TPU-first: bf16 activations / fp32 params, einsum
 attention with the same ``attention_fn`` seam as BERT (flash / ring
-attention plug in), static shapes, GQA K/V repeated to full heads before the
-kernel (cheap under XLA fusion).
+attention plug in), static shapes. GQA K/V stay at ``num_kv_heads`` through
+attention fns that declare ``supports_gqa`` (the flash kernel routes query
+heads to their K/V group in the grid — no repeat); others get repeated K/V.
 """
 
 from __future__ import annotations
@@ -101,7 +102,14 @@ class LlamaAttention(nn.Module):
         k = rotary_embedding(dense(cfg.num_kv_heads, "wk")(x),
                              cfg.rope_theta, positions)
         v = dense(cfg.num_kv_heads, "wv")(x)
-        if cfg.num_kv_heads != cfg.num_heads:
+        # flash_attention / reference_attention handle grouped K/V heads
+        # natively (the flash grid routes each query head to its group's
+        # K/V row — no repeat, Hkv/H the HBM traffic). Repeat only for
+        # attention_fns that don't declare GQA support (e.g. ring/Ulysses
+        # sequence parallelism, which shard or exchange heads).
+        gqa_native = (self.attention_fn is None
+                      or getattr(self.attention_fn, "supports_gqa", False))
+        if cfg.num_kv_heads != cfg.num_heads and not gqa_native:
             rep = cfg.num_heads // cfg.num_kv_heads
             k = jnp.repeat(k, rep, axis=2)
             v = jnp.repeat(v, rep, axis=2)

@@ -56,8 +56,18 @@ def reference_attention(q, k, v, key_mask=None, causal=False,
                         sm_scale: Optional[float] = None):
     """Plain XLA attention; also the backward-path recompute.
 
-    Shapes: q (B, Sq, H, D); k/v (B, Sk, H, D); key_mask (B, Sk) bool."""
+    Shapes: q (B, Sq, H, D); k/v (B, Sk, Hkv, D) with H % Hkv == 0
+    (grouped-query attention: K/V repeat across each group of
+    H // Hkv query heads); key_mask (B, Sk) bool."""
     d = q.shape[-1]
+    if (v.shape[2] != k.shape[2]) or (q.shape[2] % k.shape[2]):
+        raise ValueError(
+            f"reference_attention: query heads ({q.shape[2]}) must be a "
+            f"multiple of K/V heads ({k.shape[2]}, v {v.shape[2]})")
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if key_mask is not None:
@@ -141,22 +151,40 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
 
 def _fold_heads(q, k, v, key_mask):
-    """Fold heads into batch: (B, S, H, D) -> (B*H, S, D) contiguous MXU
-    tiles, plus the mask as (B*H, 1, Sk) int32 (TPU block shapes must tile
-    (8,128) or equal the array dims; the singleton row dim satisfies the
-    equality escape). Shared by the forward and backward pallas_calls so
-    their layouts cannot drift apart."""
+    """Fold heads into batch: q (B, Sq, H, D) -> (B*H, Sq, D) and k/v
+    (B, Sk, Hkv, D) -> (B*Hkv, Sk, D) contiguous MXU tiles, plus the mask
+    as (B, 1, Sk) int32 (TPU block shapes must tile (8,128) or equal the
+    array dims; the singleton row dim satisfies the equality escape).
+    Under GQA (Hkv < H) the K/V tiles are NOT repeated — the pallas
+    index_maps route each query head's grid row to its group's K/V row,
+    so K/V HBM traffic and footprint stay at Hkv/H of the repeated form.
+    Shared by the forward and backward pallas_calls so their layouts
+    cannot drift apart."""
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hkv = k.shape[1], k.shape[2]
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
     if key_mask is None:
-        maskf = jnp.ones((b * h, 1, sk), dtype=jnp.int32)
+        maskf = jnp.ones((b, 1, sk), dtype=jnp.int32)
     else:
-        maskf = jnp.repeat(key_mask.astype(jnp.int32), h,
-                           axis=0).reshape(b * h, 1, sk)
+        maskf = key_mask.astype(jnp.int32).reshape(b, 1, sk)
     return qf, kf, vf, maskf
+
+
+def _gqa_index_maps(h: int, hkv: int):
+    """Index maps routing a (b*h) grid row to its K/V row (b*hkv) and its
+    mask row (b). ``bh = b*h + head``; the head's K/V group is
+    ``head // (h // hkv)``."""
+    group = h // hkv
+
+    def kv(bh):
+        return (bh // h) * hkv + (bh % h) // group
+
+    def mask(bh):
+        return bh // h
+
+    return kv, mask
 
 
 def _fit_block(block: int, seq: int) -> int:
@@ -172,7 +200,7 @@ def _fit_block(block: int, seq: int) -> int:
 def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
                    interpret):
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hkv = k.shape[1], k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
@@ -182,6 +210,7 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
             f"blocks ({block_q},{block_k}); pad to a block multiple")
 
     qf, kf, vf, maskf = _fold_heads(q, k, v, key_mask)
+    kv_row, mask_row = _gqa_index_maps(h, hkv)
     num_kb = sk // block_k
     # kb innermost: K/V tiles stream HBM→VMEM one per step; q block and the
     # o/lse output blocks are revisited (their index_maps ignore kb), so
@@ -193,9 +222,12 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh, 0, j)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, i, j: (mask_row(bh), 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -323,12 +355,13 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
                     block_q, block_k, interpret, dlse=None):
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hkv = k.shape[1], k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
 
     qf, kf, vf, maskf = _fold_heads(q, k, v, key_mask)
+    kv_row, mask_row = _gqa_index_maps(h, hkv)
     dof = g.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     outf = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     # delta_i = sum_d dO_i O_i — the softmax-normalizer correction term;
@@ -352,9 +385,12 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
         grid=(b * h, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda bh, i, j: (bh, 0, j)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, i, j: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, i, j: (mask_row(bh), 0, j)),
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda bh, i, j: (bh, 0, i)),
@@ -372,9 +408,12 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
         grid=(b * h, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda bh, j, i: (bh, 0, j)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, j, i: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, j, i: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bh, j, i: (mask_row(bh), 0, j)),
             pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i)),
@@ -395,8 +434,19 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     )(qf, kf, vf, maskf, dof, lse, delta)
 
     dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
-    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    if hkv != h:
+        # The dkdv kernel writes one partial per QUERY head (it streams
+        # that head's Q/dO); a K/V head's gradient is the sum over its
+        # group of query heads (heads are group-contiguous: query head h
+        # reads K/V head h // group, matching jnp.repeat semantics).
+        group = h // hkv
+        dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2)
+        dk = dk.transpose(0, 2, 1, 3)
+        dv = dv.transpose(0, 2, 1, 3)
+    else:
+        dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+        dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 
@@ -447,6 +497,11 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     """Flash attention forward. ``interpret=None`` auto-selects Pallas
     interpreter mode off-TPU (hermetic CPU tests run the same kernel).
 
+    Grouped-query attention is native: pass k/v with Hkv < H heads
+    (H % Hkv == 0) and each group of H/Hkv query heads reads one K/V
+    head via the grid index_maps — K/V are never repeated, so their HBM
+    traffic and footprint stay at Hkv/H of the MHA form.
+
     ``block_q``/``block_k`` set the VMEM working set AND the HBM→VMEM
     streaming granule: per grid step one (block_k, d) K and V tile is DMAed
     in (double-buffered by Pallas), so peak VMEM is
@@ -457,10 +512,16 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     if interpret is None:
         interpret = _auto_interpret()
     b, sk = k.shape[0], k.shape[1]
-    maskf = (jnp.ones((b, sk), jnp.float32) if key_mask is None
-             else key_mask.astype(jnp.float32))
-    return _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k,
-                  interpret)
+    if (v.shape[2] != k.shape[2]) or (q.shape[2] % k.shape[2]):
+        raise ValueError(
+            f"flash_attention: query heads ({q.shape[2]}) must be a "
+            f"multiple of K/V heads ({k.shape[2]}, v {v.shape[2]}) — "
+            "grouped-query attention folds each group of H/Hkv query "
+            "heads onto one K/V head")
+    return _flash(q, k, v,
+                  (jnp.ones((b, sk), jnp.float32) if key_mask is None
+                   else key_mask.astype(jnp.float32)),
+                  causal, sm_scale, block_q, block_k, interpret)
 
 
 
@@ -477,7 +538,11 @@ def make_attention_fn(causal: bool = False, use_flash="auto",
     (measured on v5e: BERT-base seq=128 runs 1240 vs 934 seq/s — the
     O(S^2) memory flash avoids is tiny there and the kernel overhead
     isn't); at long S flash's O(S) memory and blocking win. Pass
-    True/False to force."""
+    True/False to force.
+
+    The returned fn carries ``supports_gqa = True``: both paths accept
+    k/v with fewer (grouped) heads than q, so GQA models can skip the
+    K/V repeat entirely (``LlamaAttention`` checks this attribute)."""
 
     def fn(q, k, v, mask):
         flash = use_flash
@@ -490,4 +555,5 @@ def make_attention_fn(causal: bool = False, use_flash="auto",
         return reference_attention(q, k, v, key_mask=mask, causal=causal,
                                    sm_scale=sm_scale)
 
+    fn.supports_gqa = True
     return fn
