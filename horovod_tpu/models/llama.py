@@ -104,7 +104,7 @@ class LlamaAttention(nn.Module):
         v = dense(cfg.num_kv_heads, "wv")(x)
         # flash_attention / reference_attention handle grouped K/V heads
         # natively (the flash grid routes each query head to its group's
-        # K/V row — no repeat, Hkv/H the HBM traffic). Repeat only for
+        # K/V row — no repeated K/V copy in HBM). Repeat only for
         # attention_fns that don't declare GQA support (e.g. ring/Ulysses
         # sequence parallelism, which shard or exchange heads).
         gqa_native = (self.attention_fn is None

@@ -34,6 +34,15 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+
+def _check_gqa_heads(q, k, v, name: str) -> None:
+    if (v.shape[2] != k.shape[2]) or (q.shape[2] % k.shape[2]):
+        raise ValueError(
+            f"{name}: query heads ({q.shape[2]}) must be a multiple of "
+            f"K/V heads ({k.shape[2]}, v {v.shape[2]}) — grouped-query "
+            "attention folds each group of H/Hkv query heads onto one "
+            "K/V head")
+
 FLASH_AUTO_MIN_SEQ = 512
 # v5e-tuned default inner tiles (see flash_attention docstring). Swept on
 # hardware with dispatch-amortized, DCE-proof, baseline-subtracted timing
@@ -60,10 +69,7 @@ def reference_attention(q, k, v, key_mask=None, causal=False,
     (grouped-query attention: K/V repeat across each group of
     H // Hkv query heads); key_mask (B, Sk) bool."""
     d = q.shape[-1]
-    if (v.shape[2] != k.shape[2]) or (q.shape[2] % k.shape[2]):
-        raise ValueError(
-            f"reference_attention: query heads ({q.shape[2]}) must be a "
-            f"multiple of K/V heads ({k.shape[2]}, v {v.shape[2]})")
+    _check_gqa_heads(q, k, v, "reference_attention")
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
@@ -157,7 +163,8 @@ def _fold_heads(q, k, v, key_mask):
     array dims; the singleton row dim satisfies the equality escape).
     Under GQA (Hkv < H) the K/V tiles are NOT repeated — the pallas
     index_maps route each query head's grid row to its group's K/V row,
-    so K/V HBM traffic and footprint stay at Hkv/H of the repeated form.
+    so the K/V HBM footprint stays at Hkv/H of the repeated form (DMA
+    traffic is unchanged: tiles are re-fetched per query-head row).
     Shared by the forward and backward pallas_calls so their layouts
     cannot drift apart."""
     b, sq, h, d = q.shape
@@ -499,8 +506,12 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
 
     Grouped-query attention is native: pass k/v with Hkv < H heads
     (H % Hkv == 0) and each group of H/Hkv query heads reads one K/V
-    head via the grid index_maps — K/V are never repeated, so their HBM
-    traffic and footprint stay at Hkv/H of the MHA form.
+    head via the grid index_maps. This keeps the FORWARD-path K/V
+    footprint at Hkv/H (no repeated copy in HBM; under remat, no
+    repeated copy per recompute either). Streaming DMA traffic is
+    unchanged — each query-head row still fetches its K/V tiles — and
+    the backward pass materializes full-H dk/dv partials before the
+    group-sum, so expect a memory win, not a bandwidth win.
 
     ``block_q``/``block_k`` set the VMEM working set AND the HBM→VMEM
     streaming granule: per grid step one (block_k, d) K and V tile is DMAed
@@ -512,12 +523,7 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     if interpret is None:
         interpret = _auto_interpret()
     b, sk = k.shape[0], k.shape[1]
-    if (v.shape[2] != k.shape[2]) or (q.shape[2] % k.shape[2]):
-        raise ValueError(
-            f"flash_attention: query heads ({q.shape[2]}) must be a "
-            f"multiple of K/V heads ({k.shape[2]}, v {v.shape[2]}) — "
-            "grouped-query attention folds each group of H/Hkv query "
-            "heads onto one K/V head")
+    _check_gqa_heads(q, k, v, "flash_attention")
     return _flash(q, k, v,
                   (jnp.ones((b, sk), jnp.float32) if key_mask is None
                    else key_mask.astype(jnp.float32)),

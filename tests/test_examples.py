@@ -233,3 +233,25 @@ def test_llama_seq_parallel_smoke():
                extra_env={"XLA_FLAGS":
                           "--xla_force_host_platform_device_count=4"})
     assert "tokens/sec" in out
+
+
+def test_llama_remat_chunked_loss_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_llama_training.py"),
+                "--model", "tiny", "--seq-len", "64", "--batch-size", "1",
+                "--num-iters", "2", "--remat", "--chunked-loss", "4"])
+    assert "tokens/sec" in out
+
+
+def test_llama_chunked_loss_rejects_seq_parallel():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(EX, "jax_llama_training.py"),
+         "--model", "tiny", "--seq-len", "64", "--seq-parallel", "4",
+         "--chunked-loss", "4"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert res.returncode != 0
+    assert "chunked-loss" in res.stderr
