@@ -82,6 +82,9 @@ def main():
         ring_flash = False if args.no_flash else "auto"
         attention_fn = lambda q, k, v, m: ring_attention(  # noqa: E731
             q, k, v, axis_name="seq", causal=True, use_flash=ring_flash)
+        # ring_attention takes grouped K/V directly: the ring rotates K/V
+        # blocks, so GQA cuts the per-step ICI bytes to Hkv/H.
+        attention_fn.supports_gqa = True
     else:
         mesh = hvd.parallel.mesh()
         # use_flash="auto": Pallas flash above FLASH_AUTO_MIN_SEQ, plain

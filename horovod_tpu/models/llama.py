@@ -102,11 +102,12 @@ class LlamaAttention(nn.Module):
         k = rotary_embedding(dense(cfg.num_kv_heads, "wk")(x),
                              cfg.rope_theta, positions)
         v = dense(cfg.num_kv_heads, "wv")(x)
-        # flash_attention / reference_attention handle grouped K/V heads
-        # natively (the flash grid routes each query head to its group's
-        # K/V row — no repeated K/V copy in HBM). Repeat only for
-        # attention_fns that don't declare GQA support (e.g. ring/Ulysses
-        # sequence parallelism, which shard or exchange heads).
+        # flash_attention / reference_attention / ring_attention handle
+        # grouped K/V heads natively (the flash grid routes each query
+        # head to its group's K/V row — no repeated K/V copy in HBM; the
+        # ring rotates Hkv-head blocks, Hkv/H the ICI bytes). Repeat only
+        # for attention_fns that don't declare GQA support via a
+        # ``supports_gqa`` attribute.
         gqa_native = (self.attention_fn is None
                       or getattr(self.attention_fn, "supports_gqa", False))
         if cfg.num_kv_heads != cfg.num_heads and not gqa_native:

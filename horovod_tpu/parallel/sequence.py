@@ -35,7 +35,14 @@ def _block_attend(q, k, v, sm_scale, q_pos, k_pos, causal, key_mask):
     """One (Sq_local x Sk_block) attention block in f32: returns
     (unnormalized acc, running max, running sum) contributions. ``q_pos`` /
     ``k_pos`` are the GLOBAL positions of the local rows/keys (vectors), so
-    any sequence layout — contiguous or zigzag — uses the same math."""
+    any sequence layout — contiguous or zigzag — uses the same math.
+    Grouped K/V heads (Hkv < H) are repeated here — the dense path runs at
+    short S where the extra copy is cheap; the flash path routes groups in
+    its grid instead."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if key_mask is not None:
         s = jnp.where(key_mask[:, None, None, :], s, NEG_INF)
@@ -252,7 +259,11 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
 
     Args (local shards, inside shard_map):
       q, k, v: (B, S_local, H, D); global sequence = concat over the axis in
-        rank order. key_mask: optional (B, S_local) bool for local keys.
+        rank order. k/v may carry FEWER (grouped) heads — Hkv with
+        H % Hkv == 0: since the ring rotates K/V (not Q), GQA cuts the
+        per-step ICI bytes to Hkv/H, and the flash inner kernel routes
+        query-head groups natively (the dense path repeats locally).
+        key_mask: optional (B, S_local) bool for local keys.
       layout: "contiguous" (shard i holds positions [i*S_local, ...)) or
         "zigzag" (shard i holds blocks (i, 2N-1-i) — see ``zigzag_shard``;
         balances causal work across devices, since with contiguous layout
@@ -269,6 +280,9 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     Returns: (B, S_local, H, D) — attention of local queries over the FULL
       global sequence, in the same layout as the inputs.
     """
+    from ..ops.attention import _check_gqa_heads
+
+    _check_gqa_heads(q, k, v, "ring_attention")
     axis_size = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, hn, d = q.shape
@@ -378,10 +392,11 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     ICI replace N-1 ring hops."""
     axis_size = lax.psum(1, axis_name)
     hn = q.shape[2]
-    if hn % axis_size:
+    if hn % axis_size or k.shape[2] % axis_size:
         raise ValueError(
-            f"ulysses_attention: heads ({hn}) must divide by axis size "
-            f"({axis_size}); use ring_attention instead")
+            f"ulysses_attention: query heads ({hn}) and K/V heads "
+            f"({k.shape[2]}) must both divide by axis size ({axis_size}); "
+            "use ring_attention instead")
 
     def scatter_heads(x):
         # (B, S_local, H, D) -> (B, S_global, H/N, D)

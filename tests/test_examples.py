@@ -10,7 +10,7 @@ REPO = os.path.dirname(HERE)
 EX = os.path.join(REPO, "examples")
 
 
-def _run(cmd, timeout=300, extra_env=None):
+def _run(cmd, timeout=300, extra_env=None, expect_failure=False):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
@@ -22,6 +22,9 @@ def _run(cmd, timeout=300, extra_env=None):
     env.update(extra_env or {})
     res = subprocess.run(cmd, env=env, capture_output=True, text=True,
                          timeout=timeout, cwd=REPO)
+    if expect_failure:
+        assert res.returncode != 0, res.stdout + res.stderr
+        return res.stderr
     assert res.returncode == 0, res.stdout + res.stderr
     return res.stdout
 
@@ -243,15 +246,10 @@ def test_llama_remat_chunked_loss_smoke():
 
 
 def test_llama_chunked_loss_rejects_seq_parallel():
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    res = subprocess.run(
-        [sys.executable, os.path.join(EX, "jax_llama_training.py"),
-         "--model", "tiny", "--seq-len", "64", "--seq-parallel", "4",
-         "--chunked-loss", "4"],
-        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
-    assert res.returncode != 0
-    assert "chunked-loss" in res.stderr
+    err = _run([sys.executable, os.path.join(EX, "jax_llama_training.py"),
+                "--model", "tiny", "--seq-len", "64", "--seq-parallel", "4",
+                "--chunked-loss", "4"],
+               extra_env={"XLA_FLAGS":
+                          "--xla_force_host_platform_device_count=4"},
+               expect_failure=True)
+    assert "chunked-loss" in err
