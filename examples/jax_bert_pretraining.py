@@ -30,12 +30,19 @@ def main():
                         help="per-chip batch")
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--no-flash", action="store_true")
+    parser.add_argument("--remat", action="store_true",
+                        help="jax.checkpoint each block (long sequences "
+                             "past the no-remat HBM ceiling)")
     args = parser.parse_args()
 
     hvd.init()
     mesh = hvd.parallel.mesh()
     n = hvd.local_num_devices()
     cfg = CONFIGS[args.model]
+    if args.remat:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, remat=True)
 
     # use_flash="auto": Pallas flash above FLASH_AUTO_MIN_SEQ, plain XLA
     # softmax below (faster at short seq; measured on v5e).

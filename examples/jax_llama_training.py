@@ -53,6 +53,12 @@ def main():
                         help="jax.checkpoint each block: O(1)-layers live "
                              "activations for ~1/3 extra FLOPs (long "
                              "sequences past the no-remat HBM ceiling)")
+    parser.add_argument("--optimizer", choices=["adamw", "sgd", "adafactor"],
+                        default="adamw",
+                        help="adafactor (factored second moments, the "
+                             "classic TPU memory-lean optimizer) fits "
+                             "models whose f32 Adam moments alone would "
+                             "blow HBM — e.g. Llama-1B on one 16 GiB chip")
     parser.add_argument("--chunked-loss", type=int, default=0, metavar="K",
                         help="split the sequence into K chunks and apply "
                              "the lm_head + loss per chunk (LARGER K = "
@@ -107,7 +113,12 @@ def main():
     init_len = min(s_local, 512)
     params = LlamaLM(cfg).init(jax.random.PRNGKey(0),
                                ids[:1, :init_len])["params"]
-    tx = hvd.DistributedOptimizer(optax.adamw(3e-4), axis_name="data")
+    inner_tx = {
+        "adamw": lambda: optax.adamw(3e-4),
+        "sgd": lambda: optax.sgd(0.1, momentum=0.9),
+        "adafactor": lambda: optax.adafactor(3e-4),
+    }[args.optimizer]()
+    tx = hvd.DistributedOptimizer(inner_tx, axis_name="data")
     opt_state = tx.init(params)
 
     if sp > 1:

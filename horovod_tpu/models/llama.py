@@ -44,7 +44,10 @@ LLAMA_8B = LlamaConfig()
 LLAMA_1B = LlamaConfig(dim=2048, num_layers=16, num_heads=32, num_kv_heads=8,
                        ffn_hidden=8192)
 # ~320M params: fits one 16 GB chip WITH f32 Adam state — the single-chip
-# benchmark config (1B+ needs sharded optimizer state across chips).
+# benchmark config. LLAMA_1B also trains single-chip by swapping the
+# memory: adafactor (factored second moments) + chunked_causal_lm_loss
+# runs 12.0k tok/s on a v5e (Adam moments alone would need ~8.8 GiB);
+# Adam-state sharding across chips is the ZeRO-1 wrapper's job.
 LLAMA_300M = LlamaConfig(vocab_size=32000, dim=1024, num_layers=16,
                          num_heads=16, num_kv_heads=8, ffn_hidden=4096)
 LLAMA_TINY = LlamaConfig(vocab_size=512, dim=64, num_layers=2, num_heads=4,
@@ -110,10 +113,10 @@ class LlamaAttention(nn.Module):
         # ``supports_gqa`` attribute.
         gqa_native = (self.attention_fn is None
                       or getattr(self.attention_fn, "supports_gqa", False))
-        if cfg.num_kv_heads != cfg.num_heads and not gqa_native:
-            rep = cfg.num_heads // cfg.num_kv_heads
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if not gqa_native:
+            from ..ops.attention import repeat_kv
+
+            k, v = repeat_kv(q, k, v)
         if self.attention_fn is not None:
             ctx = self.attention_fn(q, k, v, None)
         else:

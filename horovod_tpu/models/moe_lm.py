@@ -164,9 +164,12 @@ class MoeLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids, positions=None):
+    def __call__(self, input_ids, positions=None, return_hidden=False):
         """``positions``: global token positions of the local rows (see
-        ``LlamaLM.__call__``) — required under sequence parallelism."""
+        ``LlamaLM.__call__``) — required under sequence parallelism.
+        ``return_hidden``: skip the lm_head and return the final-norm
+        hidden states — pair with ``models.chunked_causal_lm_loss``
+        (same contract as ``LlamaLM``)."""
         cfg = self.config
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
@@ -184,6 +187,8 @@ class MoeLM(nn.Module):
                 x = dense_cls(cfg.llama(), attention_fn=self.attention_fn,
                               name=f"layer_{i}")(x, positions)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            return x
         # Head matmul in the model compute dtype, matching LlamaLM (MXU
         # accumulates f32 internally; the loss upcasts before the softmax).
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,

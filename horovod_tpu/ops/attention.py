@@ -43,12 +43,25 @@ def _check_gqa_heads(q, k, v, name: str) -> None:
             "attention folds each group of H/Hkv query heads onto one "
             "K/V head")
 
+
+def repeat_kv(q, k, v):
+    """Repeat grouped K/V heads (axis 2) up to q's head count — the ONE
+    place the GQA head-ordering convention (group-contiguous, query head
+    h reads K/V head h // group) is materialized as data; the flash grid
+    encodes the same convention as index maps instead."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
 FLASH_AUTO_MIN_SEQ = 512
 # v5e-tuned default inner tiles (see flash_attention docstring). Swept on
 # hardware with dispatch-amortized, DCE-proof, baseline-subtracted timing
 # (examples/flash_attention_benchmark.py): at B=4 S=2048 H=8 D=64 bf16
 # causal, (512, 1024) is the sweep's best fwd at 1.27 ms and ~best
-# fwd+bwd at ~3.7-4.0 ms, vs ~1.3-1.6 / ~5.4 for the XLA softmax path;
+# fwd+bwd at ~3.7-4.0 ms, vs 1.26-1.6 / ~5.4 for the XLA softmax path
+# (forward is a wash; the wins are fwd+bwd and O(S) memory);
 # the next size up (block_q=1024) exceeds the 16 MiB scoped-VMEM limit.
 FLASH_DEFAULT_BLOCK_Q = 512
 FLASH_DEFAULT_BLOCK_K = 1024
@@ -70,10 +83,7 @@ def reference_attention(q, k, v, key_mask=None, causal=False,
     H // Hkv query heads); key_mask (B, Sk) bool."""
     d = q.shape[-1]
     _check_gqa_heads(q, k, v, "reference_attention")
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = repeat_kv(q, k, v)
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if key_mask is not None:
@@ -441,19 +451,14 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     )(qf, kf, vf, maskf, dof, lse, delta)
 
     dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    if hkv != h:
-        # The dkdv kernel writes one partial per QUERY head (it streams
-        # that head's Q/dO); a K/V head's gradient is the sum over its
-        # group of query heads (heads are group-contiguous: query head h
-        # reads K/V head h // group, matching jnp.repeat semantics).
-        group = h // hkv
-        dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2)
-        dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2)
-        dk = dk.transpose(0, 2, 1, 3)
-        dv = dv.transpose(0, 2, 1, 3)
-    else:
-        dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
-        dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    # The dkdv kernel writes one partial per QUERY head (it streams that
+    # head's Q/dO); a K/V head's gradient is the sum over its group of
+    # query heads (group-contiguous: query head h reads K/V head
+    # h // group, matching repeat_kv). MHA is the group == 1 case — the
+    # size-1 sum axis is free.
+    group = h // hkv
+    dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2).transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 

@@ -39,10 +39,9 @@ def _block_attend(q, k, v, sm_scale, q_pos, k_pos, causal, key_mask):
     Grouped K/V heads (Hkv < H) are repeated here — the dense path runs at
     short S where the extra copy is cheap; the flash path routes groups in
     its grid instead."""
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    from ..ops.attention import repeat_kv
+
+    k, v = repeat_kv(q, k, v)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if key_mask is not None:
         s = jnp.where(key_mask[:, None, None, :], s, NEG_INF)

@@ -704,3 +704,26 @@ def test_ring_attention_rejects_bad_gqa_heads():
         out_specs=P(None, "seq"), check_vma=False)
     with pytest.raises(ValueError, match="multiple of K/V heads"):
         f(q, k, k)
+
+
+def test_ulysses_gqa_matches_reference():
+    """Ulysses with grouped K/V: both head counts divide the axis; the
+    full-sequence inner attention routes the groups."""
+    rng = np.random.RandomState(8)
+    b, s, h, hkv, d = 1, 64, 4, 2, 8
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32)) * 0.3
+    ref = reference_attention(q, k, v, causal=True)
+
+    mesh = make_mesh({"data": 4, "seq": 2})
+    f = jax.jit(jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq",
+                                          causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq"),
+        check_vma=False,
+    ))
+    out = f(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)

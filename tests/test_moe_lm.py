@@ -135,3 +135,19 @@ def test_moe_remat_matches_no_remat():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
         g0, g1)
+
+
+def test_moe_chunked_loss_matches_full():
+    from horovod_tpu.models import chunked_causal_lm_loss
+
+    model = MoeLM(MOE_TINY)
+    ids = _ids()
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    p = variables["params"]
+    logits, _ = model.apply({"params": p}, ids, mutable=["aux_loss"])
+    hidden, _ = model.apply({"params": p}, ids, return_hidden=True,
+                            mutable=["aux_loss"])
+    l_full = causal_lm_loss(logits, ids)
+    l_chunk = chunked_causal_lm_loss(hidden, p["lm_head"]["kernel"], ids,
+                                     num_chunks=4)
+    np.testing.assert_allclose(float(l_full), float(l_chunk), rtol=1e-6)
