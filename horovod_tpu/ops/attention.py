@@ -55,6 +55,7 @@ def repeat_kv(q, k, v):
         v = jnp.repeat(v, rep, axis=2)
     return k, v
 
+
 FLASH_AUTO_MIN_SEQ = 512
 # v5e-tuned default inner tiles (see flash_attention docstring). Swept on
 # hardware with dispatch-amortized, DCE-proof, baseline-subtracted timing

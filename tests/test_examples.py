@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 EX = os.path.join(REPO, "examples")
@@ -253,3 +255,11 @@ def test_llama_chunked_loss_rejects_seq_parallel():
                           "--xla_force_host_platform_device_count=4"},
                expect_failure=True)
     assert "chunked-loss" in err
+
+
+@pytest.mark.parametrize("model,size", [("vgg16", "64"), ("inception3", "96")])
+def test_jax_synthetic_benchmark_model_families(model, size):
+    out = _run([sys.executable, os.path.join(EX, "jax_synthetic_benchmark.py"),
+                "--model", model, "--batch-size", "2", "--num-iters", "2",
+                "--num-batches", "1", "--image-size", size], timeout=560)
+    assert "Img/sec per chip" in out
