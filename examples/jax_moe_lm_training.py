@@ -1,0 +1,87 @@
+"""MoE causal-LM training throughput (models.MoeLM).
+
+Single host: the dense twin (every device computes all experts).
+For expert parallelism over an ``expert`` mesh axis see
+``examples/jax_moe_training.py`` (gate-level demo) and
+``docs/parallelism.md``.
+
+    python examples/jax_moe_lm_training.py --model small --seq-len 1024
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MOE_SMALL, MOE_TINY, MoeLM, causal_lm_loss
+
+CONFIGS = {"tiny": MOE_TINY, "small": MOE_SMALL}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", choices=list(CONFIGS), default="tiny")
+    parser.add_argument("--seq-len", type=int, default=256)
+    parser.add_argument("--batch-size", type=int, default=4,
+                        help="per-chip batch")
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--aux-weight", type=float, default=0.01)
+    args = parser.parse_args()
+
+    hvd.init()
+    n = hvd.local_num_devices()
+    mesh = hvd.parallel.mesh()
+    cfg = CONFIGS[args.model]
+
+    model = MoeLM(cfg)
+    batch = args.batch_size * n
+    ids = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, args.seq_len)), jnp.int32)
+    init_len = min(args.seq_len, 512)
+    params = model.init(jax.random.PRNGKey(0),
+                        ids[:1, :init_len])["params"]
+    tx = hvd.DistributedOptimizer(optax.adamw(3e-4), axis_name="data")
+    opt_state = tx.init(params)
+
+    def loss_fn(p, ids):
+        logits, col = model.apply({"params": p}, ids, mutable=["aux_loss"])
+        aux = sum(jax.tree.leaves(col["aux_loss"]))
+        return causal_lm_loss(logits, ids) + args.aux_weight * aux
+
+    def train_step(p, s, ids):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids)
+        updates, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, hvd.allreduce(loss)
+
+    step = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P(), P(), P("data")), out_specs=(P(), P(), P()),
+        check_vma=False,
+    ), donate_argnums=(0, 1))
+
+    ids_s = hvd.parallel.shard_batch(ids, mesh)
+    params = hvd.parallel.replicate(params, mesh)
+    opt_state = hvd.parallel.replicate(opt_state, mesh)
+
+    params, opt_state, loss = step(params, opt_state, ids_s)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, loss = step(params, opt_state, ids_s)
+    float(loss)
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        tok_per_sec = batch * args.seq_len * args.num_iters / dt
+        print(f"moe-{args.model} seq={args.seq_len}: "
+              f"{tok_per_sec:.0f} tokens/sec ({tok_per_sec / n:.0f}/chip), "
+              f"loss={float(loss):.3f}")
+
+
+if __name__ == "__main__":
+    main()

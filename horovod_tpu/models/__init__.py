@@ -23,6 +23,7 @@ from .llama import (  # noqa: F401
 )
 from .inception import InceptionV3  # noqa: F401
 from .moe_lm import (  # noqa: F401
+    MOE_SMALL,
     MOE_TINY,
     MoeConfig,
     MoeLM,

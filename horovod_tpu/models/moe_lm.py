@@ -69,6 +69,12 @@ class MoeConfig:
 MOE_TINY = MoeConfig(vocab_size=512, dim=64, num_layers=2, num_heads=4,
                      num_kv_heads=2, ffn_hidden=128, num_experts=4,
                      expert_hidden=128, moe_every=2)
+# 249.7M params (151M routed across 8 experts + 98.7M dense, counted from
+# the init tree): a single-chip MoE benchmark config (top-2 of 8 experts,
+# every other layer routed).
+MOE_SMALL = MoeConfig(vocab_size=32000, dim=768, num_layers=12,
+                      num_heads=12, num_kv_heads=6, ffn_hidden=2048,
+                      num_experts=8, expert_hidden=2048, moe_every=2)
 
 
 class MoeFFN(nn.Module):

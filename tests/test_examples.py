@@ -263,3 +263,10 @@ def test_jax_synthetic_benchmark_model_families(model, size):
                 "--model", model, "--batch-size", "2", "--num-iters", "2",
                 "--num-batches", "1", "--image-size", size], timeout=560)
     assert "Img/sec per chip" in out
+
+
+def test_jax_moe_lm_training_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_moe_lm_training.py"),
+                "--model", "tiny", "--seq-len", "64", "--batch-size", "1",
+                "--num-iters", "2"])
+    assert "tokens/sec" in out
