@@ -182,6 +182,49 @@ class LlamaLM(nn.Module):
                         param_dtype=jnp.float32, name="lm_head")(x)
 
 
+def llama_tp_param_specs(params, axis: str = "model"):
+    """Megatron-style tensor-parallel ``PartitionSpec`` tree for
+    ``LlamaLM`` params, for the GSPMD path: ``device_put`` params with
+    ``NamedSharding(mesh, spec)`` and ``jax.jit`` the step — XLA derives
+    the activation collectives from the shardings (no shard_map needed).
+
+    Layout (the classic column→row pairing, so each block needs ONE
+    psum after attention and one after the FFN):
+      wq/wk/wv  (dim, heads, head_dim)  — heads sharded (column-parallel)
+      wo        (heads, head_dim, dim)  — heads sharded (row-parallel)
+      w_gate/up (dim, ffn_hidden)       — hidden sharded (column)
+      w_down    (ffn_hidden, dim)       — hidden sharded (row)
+      lm_head   (dim, vocab)            — vocab sharded (column; the loss's
+                                          lse reduces over vocab via psum)
+      tok_embeddings (vocab, dim)       — vocab sharded
+      norms / scales                    — replicated
+
+    Requires num_heads, num_kv_heads, ffn_hidden and vocab_size divisible
+    by the axis size. Compose with a ``data`` axis for dp x tp."""
+    from jax.sharding import PartitionSpec as P
+
+    rules = {
+        "wq": P(None, axis, None),
+        "wk": P(None, axis, None),
+        "wv": P(None, axis, None),
+        "wo": P(axis, None, None),
+        "w_gate": P(None, axis),
+        "w_up": P(None, axis),
+        "w_down": P(axis, None),
+        "lm_head": P(None, axis),
+        "tok_embeddings": P(axis, None),
+    }
+
+    def spec(path, x):
+        names = {getattr(k, "key", str(k)) for k in path}
+        for name, s in rules.items():
+            if name in names:
+                return s
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
 def token_nll(logits, targets):
     """Per-token negative log-likelihood via the lse formulation:
     ``lse(logits) - logits[target]``. Unlike ``log_softmax`` +
