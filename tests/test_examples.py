@@ -270,3 +270,10 @@ def test_jax_moe_lm_training_smoke():
                 "--model", "tiny", "--seq-len", "64", "--batch-size", "1",
                 "--num-iters", "2"])
     assert "tokens/sec" in out
+
+
+def test_llama_adafactor_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_llama_training.py"),
+                "--model", "tiny", "--seq-len", "64", "--batch-size", "1",
+                "--num-iters", "2", "--optimizer", "adafactor"])
+    assert "tokens/sec" in out
