@@ -105,11 +105,15 @@ _STATE_LANES = 128
 
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr, *, block_k: int, sm_scale: float,
-                  causal: bool, num_kb: int, block_q: int):
+                  causal: bool, num_kb: int, block_q: int, q_offset: int):
     # Grid (bh, qb, kb), kb innermost. Block shapes: q (1, block_q, d)
     # (constant across kb — fetched once), k/v (1, block_k, d) (a NEW tile
     # streams in from HBM each kb step), mask (1, 1, block_k). Running
     # softmax state persists in VMEM scratch across the kb loop.
+    # ``q_offset = sk - sq``: under the decode convention the sq query rows
+    # are the LAST sq positions of the sk-long key axis, so query row i sits
+    # on the causal diagonal at key column i + q_offset (matches
+    # reference_attention's ``qi = arange(sq) + (sk - sq)``).
     qb, kb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -121,7 +125,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
     # Causal: K blocks strictly above the diagonal touch no allowed entry;
     # skip their compute entirely (the DMA still runs — grid fetches are
     # static — but the MXU work, the dominant cost, is elided).
-    live = (kb * block_k <= qb * block_q + block_q - 1) if causal else True
+    live = ((kb * block_k <= qb * block_q + block_q - 1 + q_offset)
+            if causal else True)
 
     @pl.when(live)
     def _body():
@@ -136,7 +141,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
                                    (block_q, block_k))
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -236,7 +241,8 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
     grid = (b * h, sq // block_q, num_kb)
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, sm_scale=scale,
-                          causal=causal, num_kb=num_kb, block_q=block_q),
+                          causal=causal, num_kb=num_kb, block_q=block_q,
+                          q_offset=sk - sq),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -268,17 +274,19 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, dq_scr, *, block_k: int,
                          sm_scale: float, causal: bool, num_kb: int,
-                         block_q: int):
+                         block_q: int, q_offset: int):
     # Grid (bh, qb, kb), kb innermost: K/V tiles stream from HBM while
     # q/do/lse/delta stay resident. Recompute p block-by-block from q, k and
     # the saved lse; no S x S materialization (FA-2 backward, dq pass).
+    # q_offset: see _flash_kernel — decode-convention diagonal shift.
     qb, kb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(kb == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (kb * block_k <= qb * block_q + block_q - 1) if causal else True
+    live = ((kb * block_k <= qb * block_q + block_q - 1 + q_offset)
+            if causal else True)
 
     @pl.when(live)
     def _body():
@@ -294,7 +302,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
                                    (block_q, block_k))
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -318,10 +326,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                            delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                            block_q: int, sm_scale: float, causal: bool,
-                           num_qb: int, block_k: int):
+                           num_qb: int, block_k: int, q_offset: int):
     # Grid (bh, kb, qb), qb innermost: Q/dO/lse/delta tiles stream from HBM
     # while this program's K/V block stays resident. dk/dv accumulate in
     # VMEM scratch across the qb sweep.
+    # q_offset: see _flash_kernel — decode-convention diagonal shift.
     kb, qb = pl.program_id(1), pl.program_id(2)
 
     @pl.when(qb == 0)
@@ -329,7 +338,8 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    live = (kb * block_k <= qb * block_q + block_q - 1) if causal else True
+    live = ((kb * block_k <= qb * block_q + block_q - 1 + q_offset)
+            if causal else True)
 
     @pl.when(live)
     def _body():
@@ -345,7 +355,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)  # (block_q, block_k)
         allowed = jnp.broadcast_to(kmask[None, :], (block_q, block_k))
         if causal:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
@@ -399,7 +409,7 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                           sm_scale=scale, causal=causal, num_kb=num_kb,
-                          block_q=block_q),
+                          block_q=block_q, q_offset=sk - sq),
         grid=(b * h, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -422,7 +432,7 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
                           sm_scale=scale, causal=causal, num_qb=num_qb,
-                          block_k=block_k),
+                          block_k=block_k, q_offset=sk - sq),
         grid=(b * h, num_kb, num_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
@@ -488,8 +498,21 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
         # time — set it before the train step is first compiled; already-
         # compiled executables keep the backward they were traced with.
         def f(q, k, v):
-            return reference_attention(q, k, v, key_mask=maskf != 0,
-                                       causal=causal, sm_scale=sm_scale)
+            out = reference_attention(q, k, v, key_mask=maskf != 0,
+                                      causal=causal, sm_scale=sm_scale)
+            # Match the flash forward exactly: rows with NO allowed key
+            # emit zeros in the kernel, but reference_attention softmaxes
+            # their constant NEG_INF logits into uniform probs (mean(v)).
+            # Differentiating the unzeroed form would leak those dead
+            # rows' cotangents into dv/dk. O(S^2) bools — this whole
+            # branch is the O(S^2) path already.
+            sq, sk = q.shape[1], k.shape[1]
+            allowed = (maskf != 0)[:, None, :]
+            if causal:
+                qi = jnp.arange(sq)[:, None] + (sk - sq)
+                allowed = allowed & (jnp.arange(sk)[None, :] <= qi)[None]
+            row_valid = allowed.any(-1)  # (b, sq)
+            return jnp.where(row_valid[:, :, None, None], out, 0.0)
 
         _, vjp = jax.vjp(f, q, k, v)
         dq, dk, dv = vjp(g)
@@ -509,6 +532,12 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
                     interpret: Optional[bool] = None):
     """Flash attention forward. ``interpret=None`` auto-selects Pallas
     interpreter mode off-TPU (hermetic CPU tests run the same kernel).
+
+    ``causal`` with ``sq != sk`` follows the decode convention (matching
+    ``reference_attention``): the sq query rows are the LAST sq positions
+    of the key axis, i.e. query row i attends keys ``<= i + (sk - sq)``.
+    For sq > sk, rows before key position 0 are fully masked and emit
+    zeros (reference_attention degenerates to uniform probs there).
 
     Grouped-query attention is native: pass k/v with Hkv < H heads
     (H % Hkv == 0) and each group of H/Hkv query heads reads one K/V
