@@ -25,12 +25,20 @@ import optax
 from ..common import basics
 from ..compression import Compression
 from .zero import zero_sharded_optimizer  # noqa: F401
+from .fsdp import (  # noqa: F401
+    fsdp_param_specs,
+    fsdp_shardings,
+    fsdp_state_specs,
+)
 from ..ops import collective_ops as C
 
 __all__ = [
     "DistributedOptimizer",
     "distributed_value_and_grad",
     "zero_sharded_optimizer",
+    "fsdp_param_specs",
+    "fsdp_state_specs",
+    "fsdp_shardings",
     "broadcast_parameters",
     "broadcast_optimizer_state",
 ]

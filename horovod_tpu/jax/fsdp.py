@@ -1,0 +1,195 @@
+"""ZeRO-3 / FSDP-style parameter+gradient sharding on the GSPMD path.
+
+The reference's LLM-era stress workload — "Llama-3-8B (PyTorch FSDP +
+hvd.allreduce)", BASELINE.json configs[4] — shards parameters, gradients
+and optimizer state 1/N across the data-parallel group and all-gathers
+parameters on use. On TPU the whole mechanism is a *sharding annotation*:
+give every parameter leaf a ``PartitionSpec`` that splits one of its axes
+over the data axis, ``jax.device_put`` accordingly, and ``jax.jit`` the
+ordinary train step. XLA's SPMD partitioner then derives exactly the
+ZeRO-3 schedule — all-gather each layer's parameters just before use,
+reduce-scatter its gradient back to the 1/N owner, update sharded
+optimizer state locally — with no hand-written hooks, hand-rolled
+prefetch, or wrapper modules (the machinery
+``torch.distributed.fsdp.FullyShardedDataParallel`` implements by
+intercepting ``nn.Module`` forward/backward).
+
+Composition: pass ``base_specs`` (e.g. ``llama_tp_param_specs(params)``)
+and FSDP picks a *free* axis of each leaf, giving dp×tp (2-D "hybrid
+sharded") layouts; compose ``zero_sharded_optimizer`` instead when you
+want replicated params with only optimizer state sharded (ZeRO-1).
+
+Usage (see also ``_dryrun_fsdp`` in ``__graft_entry__.py``)::
+
+    specs  = fsdp_param_specs(params, num_shards=mesh.shape["data"])
+    sspecs = fsdp_state_specs(tx, params, specs)
+    params = jax.device_put(params, fsdp_shardings(mesh, specs))
+    opt_state = jax.jit(
+        tx.init, out_shardings=fsdp_shardings(mesh, sspecs))(params)
+
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1),
+        out_shardings=(fsdp_shardings(mesh, specs),
+                       fsdp_shardings(mesh, sspecs), None))
+    def step(params, opt_state, batch):
+        ...ordinary value_and_grad + tx.update...
+
+Pinning ``out_shardings`` matters: it is what forces the partitioner to
+keep gradients/moments in the 1/N layout (reduce-scatter, not
+all-reduce) instead of materializing full-size replicas.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = [
+    "fsdp_param_specs",
+    "fsdp_state_specs",
+    "fsdp_shardings",
+]
+
+# Leaves smaller than this many elements stay at their base spec: sharding
+# a (dim,) norm scale saves nothing and costs a gather. 2**16 f32 elements
+# = 256 KiB — far below any matrix worth splitting in an FSDP-scale model.
+FSDP_MIN_LEAF_ELEMS = 2 ** 16
+
+# State leaves that match no parameter (adafactor's factored row/col
+# moments, schedule tables) are replicated if at most this many elements,
+# refused otherwise — silently replicating something param-sized would
+# void the memory win the user asked for.
+_STATE_REPLICATE_MAX_ELEMS = 2 ** 20
+
+
+def _spec_entries(spec, ndim: int):
+    """PartitionSpec as a length-``ndim`` list of entries (None-padded)."""
+    entries = list(spec) if spec is not None else []
+    return entries + [None] * (ndim - len(entries))
+
+
+def fsdp_param_specs(params, num_shards: int, axis: str = "data",
+                     base_specs=None,
+                     min_leaf_elems: int = FSDP_MIN_LEAF_ELEMS):
+    """``PartitionSpec`` tree sharding each parameter leaf 1/``num_shards``
+    over mesh axis ``axis`` (ZeRO-3 / FSDP layout).
+
+    Per leaf, the largest dimension that (a) is divisible by
+    ``num_shards`` and (b) is free in ``base_specs`` gets ``axis`` added
+    (ties break toward the leading dim). Leaves below ``min_leaf_elems``
+    elements, and leaves with no qualifying dim, keep their base spec —
+    they stay replicated over ``axis``, which is correct, just not
+    memory-saving (refusing would reject every model with an odd-sized
+    bias somewhere).
+
+    ``base_specs``: an existing spec tree (e.g. Megatron TP specs from
+    ``llama_tp_param_specs``) to compose with — FSDP only claims axes the
+    base left free, yielding the 2-D dp×tp "hybrid sharded" layout.
+    """
+    if num_shards < 1:
+        raise ValueError(f"fsdp_param_specs: num_shards={num_shards} < 1")
+
+    def used_axes(entries):
+        used = set()
+        for e in entries:
+            if e is None:
+                continue
+            for a in (e if isinstance(e, tuple) else (e,)):
+                used.add(a)
+        return used
+
+    def spec_for(p, base):
+        entries = _spec_entries(base, p.ndim)
+        if axis in used_axes(entries):
+            raise ValueError(
+                f"fsdp_param_specs: base spec {base} already uses axis "
+                f"{axis!r}; pick a distinct FSDP axis")
+        if num_shards == 1 or p.size < min_leaf_elems:
+            return base if base is not None else PartitionSpec()
+        best = None
+        for d in range(p.ndim):
+            if entries[d] is not None or p.shape[d] % num_shards:
+                continue
+            if best is None or p.shape[d] > p.shape[best]:
+                best = d
+        if best is None:
+            return base if base is not None else PartitionSpec()
+        entries[best] = axis
+        return PartitionSpec(*entries)
+
+    if base_specs is None:
+        return jax.tree.map(lambda p: spec_for(p, None), params)
+    return jax.tree.map(spec_for, params, base_specs)
+
+
+def fsdp_state_specs(optimizer: optax.GradientTransformation, params,
+                     param_specs):
+    """``PartitionSpec`` tree for ``optimizer``'s state mirroring
+    ``param_specs`` — per-parameter moments (Adam mu/nu, momentum, ...)
+    shard exactly like their parameter, scalars replicate.
+
+    Matching is structural, not by shape: optax state leaves that derive
+    from a parameter carry that parameter's tree path as a *suffix* of
+    their own path (``ScaleByAdamState.mu`` IS the param tree), so each
+    state leaf is resolved to the unique parameter whose path suffix and
+    shape both match. Leaves matching no parameter (adafactor's factored
+    row/col moments, schedule tables) replicate when small and raise when
+    param-sized — a silent full-size replica would void ZeRO-3's memory
+    win. (This is the structural upgrade of ``zero_state_specs``'s
+    by-shape classification, which round-1 review flagged for shape
+    collisions.)
+    """
+    param_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    spec_leaves = jax.tree_util.tree_leaves(param_specs)
+    by_path = {
+        tuple(path): (leaf.shape, spec)
+        for (path, leaf), spec in zip(param_leaves, spec_leaves)
+    }
+    abstract = jax.eval_shape(optimizer.init, params)
+
+    def classify(path, leaf):
+        if leaf.ndim == 0:
+            return PartitionSpec()
+        path = tuple(path)
+        for start in range(len(path)):
+            hit = by_path.get(path[start:])
+            if hit is not None and hit[0] == leaf.shape:
+                return hit[1]
+        if leaf.size <= _STATE_REPLICATE_MAX_ELEMS:
+            return PartitionSpec()
+        raise ValueError(
+            f"fsdp_state_specs: state leaf at {jax.tree_util.keystr(path)} "
+            f"(shape {leaf.shape}) matches no parameter path/shape and is "
+            "too large to replicate silently. Shard it explicitly, or "
+            "compose that transformation outside the FSDP step.")
+
+    return jax.tree_util.tree_map_with_path(classify, abstract)
+
+
+def fsdp_shardings(mesh: Mesh, specs):
+    """``NamedSharding`` tree from a ``PartitionSpec`` tree — feed to
+    ``jax.device_put`` / ``jit(out_shardings=...)``."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
+def sharded_size_bytes(tree, specs, num_shards_by_axis) -> int:
+    """Per-device bytes of ``tree`` under ``specs`` — the HBM-budget
+    arithmetic (exact: every spec'd axis is divisible by construction).
+    ``num_shards_by_axis`` maps axis name -> mesh axis size (e.g.
+    ``dict(mesh.shape)``)."""
+    leaves = jax.tree.leaves(tree)
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+    total = 0
+    for leaf, spec in zip(leaves, spec_leaves):
+        denom = 1
+        for e in spec or ():
+            if e is None:
+                continue
+            for a in (e if isinstance(e, tuple) else (e,)):
+                denom *= num_shards_by_axis[a]
+        total += leaf.size * leaf.dtype.itemsize // denom
+    return total
