@@ -94,7 +94,7 @@ def main(argv=None):
                   "fsdp_state_specs over jax.eval_shape(model.init); "
                   "grads = param bytes (reduce-scatter output is the 1/N "
                   "slice). Activations/temporaries excluded — they depend "
-                  "on batch/seq/remat; see docs/zero.md.",
+                  "on batch/seq/remat; see docs/parallelism.md.",
         "optimizer": "adam (f32 mu+nu)",
         "v5e_hbm_gib": V5E_HBM_GIB,
         "rows": rows,

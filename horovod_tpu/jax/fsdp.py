@@ -183,6 +183,11 @@ def sharded_size_bytes(tree, specs, num_shards_by_axis) -> int:
     leaves = jax.tree.leaves(tree)
     spec_leaves = jax.tree.leaves(
         specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+    if len(leaves) != len(spec_leaves):
+        raise ValueError(
+            f"sharded_size_bytes: {len(leaves)} tree leaves vs "
+            f"{len(spec_leaves)} spec leaves — mismatched trees would "
+            "silently corrupt the budget")
     total = 0
     for leaf, spec in zip(leaves, spec_leaves):
         denom = 1

@@ -326,14 +326,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                            delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                            block_q: int, sm_scale: float, causal: bool,
-                           num_qb: int, block_k: int, q_offset: int):
-    # Grid (bh, kb, qb), qb innermost: Q/dO/lse/delta tiles stream from HBM
-    # while this program's K/V block stays resident. dk/dv accumulate in
-    # VMEM scratch across the qb sweep.
+                           num_qb: int, block_k: int, q_offset: int,
+                           inner_steps: int):
+    # GQA-native grid (b*hkv, kb, t), t innermost sweeping the query GROUP
+    # x q blocks (t = g * num_qb + qb): this program's K/V-head block stays
+    # resident while Q/dO/lse/delta tiles stream from HBM for every query
+    # head in the group, and dk/dv accumulate in VMEM scratch across the
+    # whole sweep — the K/V-head gradient is written ONCE per (b*hkv, kb),
+    # i.e. Hkv/H of the HBM writes of a per-query-head grid, with no
+    # full-H partial in HBM and no XLA group-sum afterwards. MHA is the
+    # group == 1 case (inner_steps == num_qb).
     # q_offset: see _flash_kernel — decode-convention diagonal shift.
-    kb, qb = pl.program_id(1), pl.program_id(2)
+    kb, t = pl.program_id(1), pl.program_id(2)
+    qb = t % num_qb
 
-    @pl.when(qb == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -374,7 +381,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
             ds, q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qb == num_qb - 1)
+    @pl.when(t == inner_steps - 1)
     def _finalize():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -429,30 +436,44 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
         interpret=interpret,
     )(qf, kf, vf, maskf, dof, lse, delta)
 
+    # GQA-native dkdv: grid rows are K/V heads (b*hkv), the query group is
+    # swept in-kernel (t = g * num_qb + qb, innermost), so dk/dv come out
+    # at (b*hkv, sk, d) directly — no full-H partials in HBM, no XLA
+    # group-sum. Q/dO/lse/delta index maps route the t step to query head
+    # kvh * group + t // num_qb (group-contiguous, matching repeat_kv).
+    group = h // hkv
+    inner = group * num_qb
+
+    def q_row(bh, t):
+        return (bh // hkv) * h + (bh % hkv) * group + t // num_qb
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
                           sm_scale=scale, causal=causal, num_qb=num_qb,
-                          block_k=block_k, q_offset=sk - sq),
-        grid=(b * h, num_kb, num_qb),
+                          block_k=block_k, q_offset=sk - sq,
+                          inner_steps=inner),
+        grid=(b * hkv, num_kb, inner),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda bh, j, i: (kv_row(bh), j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda bh, j, i: (kv_row(bh), j, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, j, t: (q_row(bh, t), t % num_qb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, t: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, t: (bh, j, 0)),
             pl.BlockSpec((1, 1, block_k),
-                         lambda bh, j, i: (mask_row(bh), 0, j)),
-            pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda bh, j, i: (bh, 0, i)),
+                         lambda bh, j, t: (bh // hkv, 0, j)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda bh, j, t: (q_row(bh, t), t % num_qb, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bh, j, t: (q_row(bh, t), 0, t % num_qb)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bh, j, t: (q_row(bh, t), 0, t % num_qb)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, t: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j, t: (bh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hkv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -462,14 +483,8 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     )(qf, kf, vf, maskf, dof, lse, delta)
 
     dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    # The dkdv kernel writes one partial per QUERY head (it streams that
-    # head's Q/dO); a K/V head's gradient is the sum over its group of
-    # query heads (group-contiguous: query head h reads K/V head
-    # h // group, matching repeat_kv). MHA is the group == 1 case — the
-    # size-1 sum axis is free.
-    group = h // hkv
-    dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2).transpose(0, 2, 1, 3)
-    dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, hkv, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, hkv, sk, d).transpose(0, 2, 1, 3)
     return dq, dk, dv
 
 
@@ -541,12 +556,14 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
 
     Grouped-query attention is native: pass k/v with Hkv < H heads
     (H % Hkv == 0) and each group of H/Hkv query heads reads one K/V
-    head via the grid index_maps. This keeps the FORWARD-path K/V
-    footprint at Hkv/H (no repeated copy in HBM; under remat, no
-    repeated copy per recompute either). Streaming DMA traffic is
-    unchanged — each query-head row still fetches its K/V tiles — and
-    the backward pass materializes full-H dk/dv partials before the
-    group-sum, so expect a memory win, not a bandwidth win.
+    head via the grid index_maps. This keeps the K/V footprint at
+    Hkv/H on BOTH passes (no repeated copy in HBM; under remat, no
+    repeated copy per recompute), and the backward dkdv kernel
+    accumulates each K/V head's gradient in VMEM across its query
+    group — dk/dv are written once per K/V head (Hkv/H the HBM
+    writes), never materialized at full H. Streaming DMA traffic for
+    K/V tiles is unchanged: each query head still reads its group's
+    tiles.
 
     ``block_q``/``block_k`` set the VMEM working set AND the HBM→VMEM
     streaming granule: per grid step one (block_k, d) K and V tile is DMAed

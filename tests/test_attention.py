@@ -633,6 +633,24 @@ class TestGroupedQueryAttention:
         with pytest.raises(ValueError, match="multiple"):
             flash_attention(q, k, v)
 
+    def test_grads_causal_sq_ne_sk(self):
+        # GQA grid (b*hkv rows, group swept in-kernel) combined with the
+        # sq != sk decode-convention diagonal offset.
+        q, k, v = self._qkv(sq=16, sk=32)
+
+        def loss(fn):
+            return lambda q, k, v: (
+                fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+        flash = lambda q, k, v: flash_attention(  # noqa: E731
+            q, k, v, causal=True, block_q=8, block_k=8)
+        ref = lambda q, k, v: reference_attention(q, k, v, causal=True)  # noqa: E731
+        g0 = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+        g1 = jax.grad(loss(ref), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g0, g1):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4)
+
     def test_llama_gqa_no_repeat_matches_repeat_path(self):
         """LlamaAttention with a supports_gqa fn must equal the repeated
         twin (same params; only the K/V routing differs). The twin's fn
