@@ -179,6 +179,10 @@ def main():
             msg = str(e)
             rec["error"] = ("vmem_oom" if "vmem" in msg.lower() else
                             type(e).__name__)
+            # Raw (truncated) message too: the "vmem" substring match
+            # would silently reclassify if Mosaic/Pallas reword the OOM
+            # error — keep the sweep output diagnosable either way.
+            rec["error_detail"] = msg[:200]
             print(json.dumps(rec), flush=True)
             continue
         rec.update(fwd_ms=round(fwd_ms, 3), train_ms=round(train_ms, 3))

@@ -19,6 +19,7 @@ from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import MOE_SMALL, MOE_TINY, MoeLM, causal_lm_loss
+from horovod_tpu.ops.attention import make_attention_fn
 
 CONFIGS = {"tiny": MOE_TINY, "small": MOE_SMALL}
 
@@ -31,6 +32,7 @@ def main():
                         help="per-chip batch")
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--aux-weight", type=float, default=0.01)
+    parser.add_argument("--no-flash", action="store_true")
     args = parser.parse_args()
 
     hvd.init()
@@ -38,7 +40,12 @@ def main():
     mesh = hvd.parallel.mesh()
     cfg = CONFIGS[args.model]
 
-    model = MoeLM(cfg)
+    # use_flash="auto": Pallas flash above FLASH_AUTO_MIN_SEQ, plain XLA
+    # softmax below — same wiring as the dense Llama example (round 2
+    # left this at reference attention, whose O(S^2) logits dominated
+    # the step time at seq>=1024 and depressed the measured MoE MFU).
+    attention_fn = None if args.no_flash else make_attention_fn(causal=True)
+    model = MoeLM(cfg, attention_fn=attention_fn)
     batch = args.batch_size * n
     ids = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size,

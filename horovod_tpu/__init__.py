@@ -62,3 +62,4 @@ from .jax import (  # noqa: F401
     broadcast_optimizer_state,
 )
 from . import parallel  # noqa: F401
+from .common import profiler  # noqa: F401

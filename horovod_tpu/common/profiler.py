@@ -1,0 +1,94 @@
+"""SPMD-tier observability: the jit-tier counterpart of the eager
+timeline.
+
+The reference's flagship observability subsystem is the timeline
+(``horovod/common/timeline.cc:120`` — per-tensor activity spans written
+by rank 0, viewed in chrome://tracing). Our eager tier reproduces it
+(``common/timeline.py``/``core/src/timeline.h``); on the tier that
+actually runs on TPU (jit/GSPMD), collectives are XLA ops inside one
+compiled program, so the equivalent record is the XLA profiler trace —
+this module wires it up:
+
+* Every traced collective in ``horovod_tpu.ops.collective_ops`` runs
+  under ``jax.named_scope("hvd.<op>[.<name>]")``, so its spans show up
+  in profiler traces — and its ops carry the scope in lowered HLO
+  metadata — under the same user-visible names the eager timeline
+  records (``hvd.allreduce.DistributedOptimizer.3``, ...).
+* ``trace(log_dir)`` / ``start_trace``/``stop_trace`` wrap
+  ``jax.profiler`` with the reference's HOROVOD_TIMELINE-style
+  env-var activation (``HOROVOD_PROFILE_DIR``).
+* ``annotate(name)`` / ``step(n)`` label host-side regions and training
+  steps in the same trace.
+
+View traces with TensorBoard's profile plugin or Perfetto
+(``docs/timeline.md``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+__all__ = ["trace", "start_trace", "stop_trace", "annotate", "step",
+           "named_scope", "PROFILE_DIR_ENV"]
+
+PROFILE_DIR_ENV = "HOROVOD_PROFILE_DIR"
+
+# Re-export: model code can label its own regions with the same mechanism
+# the collectives use; the labels land in HLO metadata (survive
+# compilation), unlike TraceAnnotation which is host-side only.
+named_scope = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Capture a profiler trace of the enclosed block::
+
+        with hvd.profiler.trace("/tmp/prof"):
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state, batch)
+            jax.block_until_ready(loss)
+
+    ``log_dir`` defaults to ``$HOROVOD_PROFILE_DIR`` (the reference
+    activates its timeline with the HOROVOD_TIMELINE env var the same
+    way); with neither set, the block runs unprofiled — safe to leave in
+    production code. Remember to block on the last output: dispatch is
+    async and an un-synced trace records only enqueues."""
+    log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV)
+    if not log_dir:
+        yield
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def start_trace(log_dir: Optional[str] = None) -> None:
+    """Non-context form of :func:`trace` (pair with :func:`stop_trace`)."""
+    log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV)
+    if not log_dir:
+        raise ValueError(
+            f"start_trace: pass log_dir or set ${PROFILE_DIR_ENV}")
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Host-side trace span (``jax.profiler.TraceAnnotation``): labels the
+    time between dispatching ops, e.g. data loading. For device-side
+    labels that survive compilation use :func:`named_scope`."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step(step_num: int):
+    """Label one training step in the trace
+    (``jax.profiler.StepTraceAnnotation``) — TensorBoard's profile
+    plugin groups device activity by these."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step_num)

@@ -68,10 +68,14 @@ def _allreduce_tree(tree, average: bool, axis_name: Optional[str],
             except NameError:
                 compress_traced = False
         reduced = []
-        for g in leaves:
+        for i, g in enumerate(leaves):
             if compress_traced:
                 g, ctx = compression.compress(g)
-            r = C.allreduce(g, average=average, axis_name=axis_name)
+            # Named like the eager tier names its timeline activities:
+            # the hvd.allreduce.<prefix>.<i> scope lands in HLO metadata
+            # and profiler traces (see common/profiler.py).
+            r = C.allreduce(g, average=average, axis_name=axis_name,
+                            name=f"{name_prefix}.{i}")
             if compress_traced:
                 r = compression.decompress(r, ctx)
             reduced.append(r)

@@ -32,6 +32,10 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
+    # mlm_head compute dtype; None = model dtype (see
+    # LlamaConfig.head_dtype — set jnp.float32 for full-precision raw
+    # logits).
+    head_dtype: Any = None
     # jax.checkpoint each transformer block in the backward pass (see
     # LlamaConfig.remat).
     remat: bool = False
@@ -152,9 +156,11 @@ class BertEncoder(nn.Module):
                           name=f"layer_{i}")(
                               x, attention_mask, deterministic)
 
-        # Head matmul in the model compute dtype (MXU accumulates f32
-        # internally); mlm_loss upcasts to f32 before the softmax.
-        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+        # Head matmul in head_dtype (default: model compute dtype; MXU
+        # accumulates f32 internally); mlm_loss upcasts to f32 before the
+        # softmax.
+        logits = nn.Dense(cfg.vocab_size,
+                          dtype=cfg.head_dtype or cfg.dtype,
                           param_dtype=jnp.float32, name="mlm_head")(x)
         return logits
 

@@ -53,6 +53,9 @@ class MoeConfig:
     norm_eps: float = 1e-5
     rope_theta: float = 1e4
     dtype: Any = jnp.bfloat16
+    # lm_head compute dtype; None = model dtype (see
+    # LlamaConfig.head_dtype).
+    head_dtype: Any = None
     # jax.checkpoint each block in the backward pass (see
     # LlamaConfig.remat).
     remat: bool = False
@@ -63,7 +66,7 @@ class MoeConfig:
             num_layers=self.num_layers, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, ffn_hidden=self.ffn_hidden,
             norm_eps=self.norm_eps, rope_theta=self.rope_theta,
-            dtype=self.dtype)
+            dtype=self.dtype, head_dtype=self.head_dtype)
 
 
 MOE_TINY = MoeConfig(vocab_size=512, dim=64, num_layers=2, num_heads=4,
@@ -195,7 +198,8 @@ class MoeLM(nn.Module):
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         if return_hidden:
             return x
-        # Head matmul in the model compute dtype, matching LlamaLM (MXU
-        # accumulates f32 internally; the loss upcasts before the softmax).
-        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+        # Head matmul in head_dtype (default: model compute dtype),
+        # matching LlamaLM — see LlamaConfig.head_dtype.
+        return nn.Dense(cfg.vocab_size, use_bias=False,
+                        dtype=cfg.head_dtype or cfg.dtype,
                         param_dtype=jnp.float32, name="lm_head")(x)

@@ -65,8 +65,15 @@ def _is_traced(tensor) -> bool:
     return isinstance(tensor, jax.core.Tracer)
 
 
-def _traced_collective(tensor, axis_name, fn):
+def _traced_collective(tensor, axis_name, fn, opname: str = "collective",
+                       name: Optional[str] = None):
     """Run a lax collective on a traced value.
+
+    The op is traced under ``jax.named_scope("hvd.<opname>[.<name>]")``,
+    so profiler traces and lowered HLO metadata carry the same
+    user-visible names the eager timeline records — the jit-tier
+    counterpart of the reference's timeline activity names
+    (``horovod/common/timeline.cc:120``); see ``horovod_tpu.profiler``.
 
     If the axis name is not bound (plain ``jit``/pjit tracing rather than
     ``shard_map``), fall back to identity: under pjit-style automatic
@@ -74,8 +81,10 @@ def _traced_collective(tensor, axis_name, fn):
     sharding annotations — and under single-process tracing (e.g. inside
     ``optax.MultiSteps``' ``lax.cond``) identity is the size-1 semantics."""
     ax = _resolve_axis(axis_name)
+    scope = f"hvd.{opname}" + (f".{name}" if name else "")
     try:
-        return fn(tensor, ax)
+        with jax.named_scope(scope):
+            return fn(tensor, ax)
     except NameError:
         from ..common import hvd_logging as logging
 
@@ -135,7 +144,8 @@ def allreduce(tensor, average: Optional[bool] = None, name: Optional[str] = None
     if _is_traced(tensor):
         return _traced_collective(
             tensor, axis_name,
-            lambda t, ax: lax.pmean(t, ax) if avg else lax.psum(t, ax))
+            lambda t, ax: lax.pmean(t, ax) if avg else lax.psum(t, ax),
+            opname="allreduce", name=name)
     st = basics.state()
     if st.topology.size == 1:
         return _wrap_value(tensor)
@@ -187,8 +197,10 @@ def grouped_allreduce(tensors, average: Optional[bool] = None,
         return [
             _traced_collective(
                 t, axis_name,
-                lambda t_, ax: lax.pmean(t_, ax) if avg else lax.psum(t_, ax))
-            for t in tensors
+                lambda t_, ax: lax.pmean(t_, ax) if avg else lax.psum(t_, ax),
+                opname="grouped_allreduce",
+                name=f"{name}.{i}" if name else str(i))
+            for i, t in enumerate(tensors)
         ]
     handles = grouped_allreduce_async(tensors, average=avg, name=name,
                                       compression=compression)
@@ -239,7 +251,9 @@ def allgather(tensor, name: Optional[str] = None,
     requires equal shard shapes, as XLA demands static shapes."""
     if _is_traced(tensor):
         return _traced_collective(
-            tensor, axis_name, lambda t, ax: lax.all_gather(t, ax, tiled=True))
+            tensor, axis_name,
+            lambda t, ax: lax.all_gather(t, ax, tiled=True),
+            opname="allgather", name=name)
     st = basics.state()
     if st.topology.size == 1:
         return _wrap_value(tensor)
@@ -273,7 +287,8 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
             masked = jnp.where(idx == root_rank, t, jnp.zeros_like(t))
             return lax.psum(masked, ax)
 
-        return _traced_collective(tensor, axis_name, _bcast)
+        return _traced_collective(tensor, axis_name, _bcast,
+                                  opname="broadcast", name=name)
     st = basics.state()
     if st.topology.size == 1:
         if root_rank != 0:
@@ -379,7 +394,8 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[str] = No
                 out = out / lax.psum(1, ax)
             return out
 
-        return _traced_collective(tensor, axis_name, _rs)
+        return _traced_collective(tensor, axis_name, _rs,
+                                  opname="reducescatter")
     st = basics.state()
     if st.topology.size == 1:
         return _wrap_value(tensor)
@@ -398,7 +414,8 @@ def alltoall(tensor, axis_name: Optional[str] = None):
                                  tiled=False)
             return out.reshape((-1,) + tuple(t.shape[1:]))
 
-        return _traced_collective(tensor, axis_name, _a2a)
+        return _traced_collective(tensor, axis_name, _a2a,
+                                  opname="alltoall")
     st = basics.state()
     if st.topology.size == 1:
         return _wrap_value(tensor)

@@ -389,8 +389,14 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", causal: bool = False,
     (B, S_local, H, D) -> (B, S_global, H_local, D), attend over the full
     sequence with 1/N of the heads, reshard back. Two ``lax.all_to_all``s on
     ICI replace N-1 ring hops."""
+    from ..ops.attention import _check_gqa_heads
+
     axis_size = lax.psum(1, axis_name)
     hn = q.shape[2]
+    # GQA invariants up front (v heads == k heads, H % Hkv == 0): a bad v
+    # shape would otherwise surface later as a confusing inner-attention
+    # or collective error.
+    _check_gqa_heads(q, k, v, "ulysses_attention")
     if hn % axis_size or k.shape[2] % axis_size:
         raise ValueError(
             f"ulysses_attention: query heads ({hn}) and K/V heads "

@@ -751,6 +751,22 @@ def test_ulysses_gqa_heads_validation():
         f(q, k, k)
 
 
+def test_ulysses_rejects_mismatched_v_heads():
+    # Advisor round-2: a bad v shape must fail the GQA invariant check at
+    # entry, not as a confusing inner-attention/collective error.
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 64, 8, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 64, 8, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 64, 4, 8).astype(np.float32))
+    mesh = make_mesh({"seq": 8})
+    f = jax.shard_map(
+        lambda q, k, v: ulysses_attention(q, k, v, axis_name="seq"),
+        mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+        out_specs=P(None, "seq"), check_vma=False)
+    with pytest.raises(ValueError, match="ulysses_attention"):
+        f(q, k, v)
+
+
 @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
 def test_ring_attention_gqa_flash_inner(layout):
     """GQA through the Pallas inner kernel (use_flash=True forces it at

@@ -24,6 +24,20 @@ def test_forward_and_loss():
         2 * np.log(LLAMA_TINY.vocab_size)
 
 
+def test_head_dtype_knob():
+    # Default: logits in the model compute dtype (bf16). head_dtype=f32
+    # opts raw-logit consumers back into full precision (advisor round-2).
+    import dataclasses
+
+    ids = _ids((1, 8))
+    model = LlamaLM(LLAMA_TINY)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+    assert model.apply(variables, ids).dtype == LLAMA_TINY.dtype
+    f32_model = LlamaLM(
+        dataclasses.replace(LLAMA_TINY, head_dtype=jnp.float32))
+    assert f32_model.apply(variables, ids).dtype == jnp.float32
+
+
 def test_causality():
     model = LlamaLM(LLAMA_TINY)
     ids = _ids((1, 12))
