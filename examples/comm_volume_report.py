@@ -1,0 +1,187 @@
+"""Per-mode communication-volume report from compiled HLO.
+
+Compiles each parallel mode on the virtual 8-device CPU mesh, extracts
+the XLA collectives + payload bytes (utils/comm_accounting.py), and
+writes ``artifacts/comm_volume_r3.json`` — the hardware-free scaling
+evidence that replaces a 1-core wall-clock curve (the bytes a step
+moves are static properties of the compiled program; the ring model
+converts them to wire bytes/device). ``tests/test_comm_volume.py``
+asserts the same numbers against theory.
+
+Run: JAX_PLATFORMS=cpu python examples/comm_volume_report.py
+(needs --xla_force_host_platform_device_count=8; set automatically).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.parallel import make_mesh  # noqa: E402
+from horovod_tpu.utils.comm_accounting import (  # noqa: E402
+    collectives,
+    count_by_op,
+    payload_by_op,
+    wire_bytes_per_device,
+)
+
+N = 8
+
+
+def report(name, compiled, default_n, note=""):
+    colls = collectives(compiled)
+    row = {
+        "mode": name,
+        "collective_counts": count_by_op(colls),
+        "payload_bytes_by_op": payload_by_op(colls),
+        "ring_wire_bytes_per_device": wire_bytes_per_device(
+            colls, default_n=default_n),
+        "note": note,
+    }
+    print(json.dumps(row))
+    return row
+
+
+def main():
+    rows = []
+
+    # --- DP: DistributedOptimizer gradient allreduce.
+    mesh = make_mesh({"data": N})
+    params = {"w": jnp.zeros((64, 32)), "b": jnp.zeros((32,))}
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="data")
+    x = jnp.ones((N * 4, 64))
+
+    def dp_body(p, x):
+        def loss(p):
+            return ((x @ p["w"] + p["b"]) ** 2).mean()
+        g = jax.grad(loss)(p)
+        u, _ = tx.update(g, tx.init(p), p)
+        return sum(a.sum() for a in jax.tree.leaves(
+            optax.apply_updates(p, u)))
+
+    f = jax.shard_map(dp_body, mesh=mesh, in_specs=(P(), P("data")),
+                      out_specs=P(), check_vma=False)
+    gbytes = sum(a.size * a.dtype.itemsize for a in jax.tree.leaves(params))
+    rows.append(report(
+        "dp-allreduce", jax.jit(f).lower(params, x).compile(), N,
+        note=f"grad bytes {gbytes}; ring theory 2(N-1)/N*grads = "
+             f"{2 * (N - 1) / N * gbytes:.0f} wire bytes/device"))
+
+    # --- ZeRO-1: reduce-scatter grads + all-gather updates.
+    from horovod_tpu.jax import zero_sharded_optimizer
+    from horovod_tpu.jax.zero import zero_state_specs
+
+    inner = optax.sgd(0.1)
+    ztx = zero_sharded_optimizer(inner, axis_name="data")
+    specs = zero_state_specs(inner, params, "data", N)
+    state = jax.jit(jax.shard_map(ztx.init, mesh=mesh, in_specs=P(),
+                                  out_specs=specs, check_vma=False))(params)
+
+    def z_body(p, s, x):
+        def loss(p):
+            return ((x @ p["w"] + p["b"]) ** 2).mean()
+        g = jax.grad(loss)(p)
+        u, s = ztx.update(g, s, p)
+        return sum(a.sum() for a in jax.tree.leaves(
+            optax.apply_updates(p, u)))
+
+    f = jax.shard_map(z_body, mesh=mesh, in_specs=(P(), specs, P("data")),
+                      out_specs=P(), check_vma=False)
+    rows.append(report(
+        "dp-zero1", jax.jit(f).lower(params, state, x).compile(), N,
+        note="same wire bytes as one ring allreduce, split into its "
+             "reduce-scatter + all-gather halves; moments stay sharded"))
+
+    # --- FSDP / ZeRO-3 (GSPMD): params gathered on use.
+    from horovod_tpu.jax.fsdp import (
+        fsdp_param_specs,
+        fsdp_shardings,
+        fsdp_state_specs,
+    )
+
+    fparams = {"w": jnp.zeros((256, 128)), "v": jnp.zeros((128, 256))}
+    ftx = optax.sgd(0.1)
+    fspecs = fsdp_param_specs(fparams, num_shards=N, min_leaf_elems=1)
+    fss = fsdp_state_specs(ftx, fparams, fspecs)
+    psh, ssh = fsdp_shardings(mesh, fspecs), fsdp_shardings(mesh, fss)
+    fx = jax.device_put(jnp.ones((N * 4, 256)),
+                        NamedSharding(mesh, P("data")))
+    p_sh = jax.device_put(fparams, psh)
+    s_sh = jax.jit(ftx.init, out_shardings=ssh)(p_sh)
+
+    def fsdp_step(p, s, x):
+        def loss(p):
+            return ((jnp.tanh(x @ p["w"]) @ p["v"]) ** 2).mean()
+        loss_v, g = jax.value_and_grad(loss)(p)
+        u, s = ftx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss_v
+
+    rows.append(report(
+        "dp-zero3-fsdp",
+        jax.jit(fsdp_step, out_shardings=(psh, ssh, None)).lower(
+            p_sh, s_sh, fx).compile(), N,
+        note="all-gather params on use; grad reduction as reduce-scatter "
+             "(TPU partitioner) or all-reduce+slice (CPU backend)"))
+
+    # --- Hierarchical 2-level (dcn x ici).
+    from horovod_tpu.parallel.hierarchical import hierarchical_allreduce
+
+    hmesh = make_mesh({"dcn": 2, "ici": 4})
+    g = jnp.zeros((1024,))
+    f = jax.shard_map(
+        lambda g: hierarchical_allreduce(g, inner_axis="ici",
+                                         outer_axis="dcn", average=False),
+        mesh=hmesh, in_specs=P(), out_specs=P(), check_vma=False)
+    rows.append(report(
+        "hierarchical-dcn-ici", jax.jit(f).lower(g).compile(), 4,
+        note="dcn all-reduce carries exactly 1/|ici| of the payload"))
+
+    # --- SP ring, GQA: per-hop K/V bytes scale Hkv/H.
+    from horovod_tpu.parallel.sequence import ring_attention
+
+    smesh = make_mesh({"seq": N})
+    for hkv in (4, 1):
+        b, s, h, d = 1, N * 8, 4, 8
+        q = jnp.zeros((b, s, h, d))
+        k = jnp.zeros((b, s, hkv, d))
+        v = jnp.zeros((b, s, hkv, d))
+        f = jax.shard_map(
+            lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
+            mesh=smesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq"), check_vma=False)
+        rows.append(report(
+            f"sp-ring-hkv{hkv}", jax.jit(f).lower(q, k, v).compile(), N,
+            note="collective-permute payload = per-hop K/V block "
+                 f"(hkv={hkv}/{h}); executed N-1 times inside the scan"))
+
+    out = {
+        "what": "Communication-volume accounting per parallel mode, from "
+                "compiled HLO on the virtual 8-device mesh (round-3 "
+                "verdict item #6a). Counts/payloads are static program "
+                "properties; wire bytes use the ring model.",
+        "rows": rows,
+    }
+    path = os.path.join(os.path.dirname(__file__), "..", "artifacts",
+                        "comm_volume_r3.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

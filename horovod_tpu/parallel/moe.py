@@ -4,11 +4,11 @@ The reference has no MoE (2019 CNN-era, SURVEY.md §2.3); this is a TPU
 extension on the same substrate: experts live along an ``"expert"`` mesh
 axis, and token dispatch/return ride ``jax.lax.all_to_all`` over ICI — the
 canonical TPU MoE layout (GShard/Switch): tokens are packed into
-``[experts, capacity, d_model]`` buffers by sort-based routing (stable
-argsort by expert id + one row scatter — see ``_route``; the one-hot
-mask einsums this replaces cost more FLOPs than the experts at LM
-scale), exchanged all-to-all so each device holds its expert's tokens
-from every peer, transformed, and exchanged back.
+``[experts, capacity, d_model]`` buffers by index-based routing (int32
+cumsum capacity slots + per-round row scatter/gather — see ``_route``;
+the one-hot mask einsums this replaces cost more FLOPs than the experts
+at LM scale), exchanged all-to-all so each device holds its expert's
+tokens from every peer, transformed, and exchanged back.
 
 Routing is top-k with capacity dropping (Switch for ``k=1``, GShard for
 ``k=2``): per expert at most ``capacity = ceil(k*T/E * capacity_factor)``

@@ -1,0 +1,156 @@
+"""Communication-volume accounting from compiled HLO.
+
+The reference anchors its scaling story on measured allreduce bus
+bandwidth (``/root/reference/docs/benchmarks.md:5-34``). On one real chip
+we cannot measure multi-chip wire time, but the compiled program tells us
+exactly WHAT will move: every XLA collective and its payload bytes are
+static in the HLO. This module parses them and provides the ring-model
+theory to pin them against — the hardware-free scaling evidence that
+replaces a meaningless 1-core wall-clock curve
+(``tests/test_comm_volume.py``, ``artifacts/comm_volume_r3.json``).
+
+Wire-byte model (ring algorithms, the ICI/NCCL standard):
+
+* all-reduce of ``B`` bytes over ``n`` devices: each device sends (and
+  receives) ``2 (n-1)/n * B`` — reduce-scatter half + all-gather half.
+* reduce-scatter / all-gather alone: ``(n-1)/n * B`` each (``B`` = the
+  FULL pre-scatter / post-gather payload).
+* collective-permute (ring hop): each device sends its shard once.
+* all-to-all of ``B`` bytes: ``(n-1)/n * B`` leaves each device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# e.g. "f32[1024,8]" or "bf16[8]{0}" inside an HLO op signature.
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                  "collective-permute", "all-to-all")
+
+
+@dataclasses.dataclass
+class Collective:
+    op: str             # HLO opcode (all-reduce, ...)
+    payload_bytes: int  # summed result-shape bytes (full logical payload)
+    group_size: int     # devices per replica group (1 = unknown/whole)
+
+
+def _shape_entries(sig: str) -> List[int]:
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(sig):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * _DTYPE_BYTES[dtype])
+    return out
+
+
+# "{{0,1,2,3},{4,5,6,7}}" (explicit) or "[2,4]<=[8]" (iota: 2 groups x 4).
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=")
+
+
+def _group_size(line: str) -> int:
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return int(m.group(2))
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        return len(m.group(1).split(","))
+    return 1
+
+
+def collectives(compiled) -> List[Collective]:
+    """Parse a ``jax`` compiled object (``jit(f).lower(...).compile()``)
+    into its collective ops. Payload = the op's RESULT shape bytes (for
+    reduce-scatter: the scattered shard; for all-gather: the gathered
+    full array; for all-reduce: the reduced array — matching each op's
+    logical output). Each op carries its replica-group size parsed from
+    the HLO, so multi-axis programs (dcn x ici) bill each collective at
+    its own ring length."""
+    out = []
+    for line in compiled.as_text().splitlines():
+        s = line.strip()
+        # "%name = f32[...] all-reduce(...)" — opcode follows the result
+        # signature; skip -start/-done pairs' duplicate (count -start).
+        m = re.match(r"(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\(?[^=]*?)\s*"
+                     r"(all-reduce|reduce-scatter|all-gather|"
+                     r"collective-permute|all-to-all)"
+                     r"(-start|-done)?\(", s)
+        if not m:
+            continue
+        if m.group(3) == "-done":
+            continue
+        entries = _shape_entries(m.group(1))
+        if m.group(3) == "-start" and len(entries) % 2 == 0:
+            # Async form: the result tuple is (operands..., results...) —
+            # keep the result half only, or every async collective's
+            # payload double-counts.
+            entries = entries[len(entries) // 2:]
+        out.append(Collective(m.group(2), sum(entries), _group_size(s)))
+    return out
+
+
+def count_by_op(colls: List[Collective]) -> Dict[str, int]:
+    c: Dict[str, int] = {}
+    for x in colls:
+        c[x.op] = c.get(x.op, 0) + 1
+    return c
+
+
+def payload_by_op(colls: List[Collective]) -> Dict[str, int]:
+    c: Dict[str, int] = {}
+    for x in colls:
+        c[x.op] = c.get(x.op, 0) + x.payload_bytes
+    return c
+
+
+# ---------------------------------------------------------------------------
+# Ring-model wire bytes (per device, send direction).
+
+
+def ring_allreduce_bytes(n: int, payload: int) -> float:
+    return 2 * (n - 1) / n * payload
+
+
+def ring_reduce_scatter_bytes(n: int, payload: int) -> float:
+    return (n - 1) / n * payload
+
+
+def ring_all_gather_bytes(n: int, payload: int) -> float:
+    return (n - 1) / n * payload
+
+
+def wire_bytes_per_device(colls: List[Collective],
+                          default_n: int) -> float:
+    """Ring-model send bytes per device for a compiled step. Each
+    collective is billed at its own parsed replica-group size;
+    ``default_n`` covers ops whose groups could not be parsed."""
+    total = 0.0
+    for x in colls:
+        n = x.group_size if x.group_size > 1 else default_n
+        if x.op == "all-reduce":
+            total += ring_allreduce_bytes(n, x.payload_bytes)
+        elif x.op == "reduce-scatter":
+            # Result is the shard: full payload = shard * n.
+            total += ring_reduce_scatter_bytes(n, x.payload_bytes * n)
+        elif x.op == "all-gather":
+            total += ring_all_gather_bytes(n, x.payload_bytes)
+        elif x.op == "collective-permute":
+            total += x.payload_bytes
+        elif x.op == "all-to-all":
+            total += (n - 1) / n * x.payload_bytes
+    return total
