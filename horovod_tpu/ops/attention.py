@@ -60,10 +60,10 @@ FLASH_AUTO_MIN_SEQ = 512
 # v5e-tuned default inner tiles (see flash_attention docstring). Swept on
 # hardware with dispatch-amortized, DCE-proof, baseline-subtracted timing
 # (examples/flash_attention_benchmark.py): at B=4 S=2048 H=8 D=64 bf16
-# causal, (512, 1024) is the sweep's best fwd at 1.27 ms and ~best
-# fwd+bwd at ~3.7-4.0 ms, vs 1.26-1.6 / ~5.4 for the XLA softmax path
-# (forward is a wash; the wins are fwd+bwd and O(S) memory);
-# the next size up (block_q=1024) exceeds the 16 MiB scoped-VMEM limit.
+# causal, (512, 1024) is the sweep's best both before and after the
+# round-3 input-dtype MXU rework — 0.43 ms fwd / 1.68 ms fwd+bwd (vs
+# 1.26-1.6 / ~5.4 for the XLA softmax path); the next size up
+# (block_q=1024) exceeds the 16 MiB scoped-VMEM limit.
 FLASH_DEFAULT_BLOCK_Q = 512
 FLASH_DEFAULT_BLOCK_K = 1024
 
@@ -103,9 +103,30 @@ def reference_attention(q, k, v, key_mask=None, causal=False,
 _STATE_LANES = 128
 
 
+def _allowed_mask(mask_ref, has_mask: bool, causal: bool, qb, kb,
+                  block_q: int, block_k: int, q_offset: int):
+    """The (block_q, block_k) allowed-entry mask, or None when every entry
+    is allowed (no key mask given AND not causal) so the callers skip the
+    where/zeroing VPU passes entirely. ``has_mask`` is static — the
+    public entry knows at trace time whether a key mask was supplied."""
+    allowed = None
+    if has_mask:
+        allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
+                                   (block_q, block_k))
+    if causal:
+        q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        tri = k_pos <= q_pos
+        allowed = tri if allowed is None else (allowed & tri)
+    return allowed
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
                   m_scr, l_scr, acc_scr, *, block_k: int, sm_scale: float,
-                  causal: bool, num_kb: int, block_q: int, q_offset: int):
+                  causal: bool, num_kb: int, block_q: int, q_offset: int,
+                  has_mask: bool):
     # Grid (bh, qb, kb), kb innermost. Block shapes: q (1, block_q, d)
     # (constant across kb — fetched once), k/v (1, block_k, d) (a NEW tile
     # streams in from HBM each kb step), mask (1, 1, block_k). Running
@@ -130,32 +151,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
         m = m_scr[:, :1]
         l = l_scr[:, :1]
+        # MXU in the INPUT dtype with f32 accumulation: bf16 q/k run at
+        # full MXU rate (the previous astype(f32)-before-dot forced an
+        # f32 matmul at a fraction of it — measured 43.7% of the whole
+        # Llama-300M step inside these kernels); sm_scale applies to the
+        # f32 product, which is algebraically identical.
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (block_q, block_k)
-        allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
-                                   (block_q, block_k))
-        if causal:
-            q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            allowed = allowed & (k_pos <= q_pos)
-        s = jnp.where(allowed, s, NEG_INF)
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        allowed = _allowed_mask(mask_ref, has_mask, causal, qb, kb,
+                                block_q, block_k, q_offset)
+        if allowed is not None:
+            s = jnp.where(allowed, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         # Explicit zeroing, not exp alone: in a fully-masked row m_new stays
         # at the NEG_INF init, where exp(s - m_new) would be exp(0) = 1 per
         # masked key and the row would silently emit mean(v).
-        p = jnp.where(allowed, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p drops to the V dtype for the MXU (f32 inputs: no-op, tests
+        # stay exact; bf16: full-rate matmul, the universal flash
+        # convention — probabilities carry ~8 mantissa bits there).
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -221,7 +244,7 @@ def _fit_block(block: int, seq: int) -> int:
 
 
 def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
-                   interpret):
+                   interpret, has_mask: bool = True):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
@@ -242,7 +265,7 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
     out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, sm_scale=scale,
                           causal=causal, num_kb=num_kb, block_q=block_q,
-                          q_offset=sk - sq),
+                          q_offset=sk - sq, has_mask=has_mask),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -274,7 +297,7 @@ def _flash_forward(q, k, v, key_mask, causal, sm_scale, block_q, block_k,
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                          delta_ref, dq_ref, dq_scr, *, block_k: int,
                          sm_scale: float, causal: bool, num_kb: int,
-                         block_q: int, q_offset: int):
+                         block_q: int, q_offset: int, has_mask: bool):
     # Grid (bh, qb, kb), kb innermost: K/V tiles stream from HBM while
     # q/do/lse/delta stay resident. Recompute p block-by-block from q, k and
     # the saved lse; no S x S materialization (FA-2 backward, dq pass).
@@ -290,32 +313,27 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _body():
-        q = q_ref[0].astype(jnp.float32) * sm_scale
-        do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, None]          # (block_q, 1)
         delta = delta_ref[0, 0][:, None]      # (block_q, 1)
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
+        # All dots in the INPUT dtype with f32 accumulation (see
+        # _flash_kernel); sm_scale moves onto the f32 product / the
+        # finalize write.
         s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        allowed = jnp.broadcast_to((mask_ref[0, 0] != 0)[None, :],
-                                   (block_q, block_k))
-        if causal:
-            q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            allowed = allowed & (k_pos <= q_pos)
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        allowed = _allowed_mask(mask_ref, has_mask, causal, qb, kb,
+                                block_q, block_k, q_offset)
         # Explicit zeroing (not exp of -inf): fully-masked rows keep p = 0,
         # so their gradients vanish as they must (out is identically 0).
-        p = jnp.where(allowed, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(kb == num_kb - 1)
@@ -327,7 +345,7 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
                            delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                            block_q: int, sm_scale: float, causal: bool,
                            num_qb: int, block_k: int, q_offset: int,
-                           inner_steps: int):
+                           inner_steps: int, has_mask: bool):
     # GQA-native grid (b*hkv, kb, t), t innermost sweeping the query GROUP
     # x q blocks (t = g * num_qb + qb): this program's K/V-head block stays
     # resident while Q/dO/lse/delta tiles stream from HBM for every query
@@ -350,45 +368,39 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
 
     @pl.when(live)
     def _body():
-        k_blk = k_ref[0].astype(jnp.float32)
-        v_blk = v_ref[0].astype(jnp.float32)
-        kmask = (mask_ref[0, 0] != 0)  # (block_k,)
-        q_blk = q_ref[0].astype(jnp.float32) * sm_scale
-        do_blk = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, 0][:, None]
         delta = delta_ref[0, 0][:, None]
+        # All dots in the INPUT dtype with f32 accumulation (see
+        # _flash_kernel); sm_scale moves onto the f32 product here and
+        # onto dk at finalize (dk = scale * ds^T q).
         s = jax.lax.dot_general(
-            q_blk, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # (block_q, block_k)
-        allowed = jnp.broadcast_to(kmask[None, :], (block_q, block_k))
-        if causal:
-            q_pos = qb * block_q + q_offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            allowed = allowed & (k_pos <= q_pos)
-        p = jnp.where(allowed, jnp.exp(s - lse), 0.0)
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        allowed = _allowed_mask(mask_ref, has_mask, causal, qb, kb,
+                                block_q, block_k, q_offset)
+        p = jnp.exp(s - lse)
+        if allowed is not None:
+            p = jnp.where(allowed, p, 0.0)
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        # q_blk carries sm_scale already, so dk = (ds^T @ q) * scale falls
-        # out directly.
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(t == inner_steps - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dk_ref[0] = (dk_scr[...] * sm_scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
-                    block_q, block_k, interpret, dlse=None):
+                    block_q, block_k, interpret, dlse=None,
+                    has_mask: bool = True):
     b, sq, h, d = q.shape
     sk, hkv = k.shape[1], k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
@@ -416,7 +428,8 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
                           sm_scale=scale, causal=causal, num_kb=num_kb,
-                          block_q=block_q, q_offset=sk - sq),
+                          block_q=block_q, q_offset=sk - sq,
+                          has_mask=has_mask),
         grid=(b * h, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0)),
@@ -451,7 +464,7 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
                           sm_scale=scale, causal=causal, num_qb=num_qb,
                           block_k=block_k, q_offset=sk - sq,
-                          inner_steps=inner),
+                          inner_steps=inner, has_mask=has_mask),
         grid=(b * hkv, num_kb, inner),
         in_specs=[
             pl.BlockSpec((1, block_q, d),
@@ -491,21 +504,23 @@ def _flash_backward(q, k, v, key_mask, out, lse, g, causal, sm_scale,
 # The mask rides as a *differentiable* float32 argument with a zero
 # cotangent: nondiff_argnums may not receive tracers (jit/shard_map callers
 # pass traced masks), so only the static config lives there.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, maskf, causal, sm_scale, block_q, block_k, interpret,
+           has_mask):
     out, _ = _flash_forward(q, k, v, maskf != 0, causal, sm_scale, block_q,
-                            block_k, interpret)
+                            block_k, interpret, has_mask=has_mask)
     return out
 
 
 def _flash_fwd_rule(q, k, v, maskf, causal, sm_scale, block_q, block_k,
-                    interpret):
+                    interpret, has_mask):
     out, lse = _flash_forward(q, k, v, maskf != 0, causal, sm_scale, block_q,
-                              block_k, interpret)
+                              block_k, interpret, has_mask=has_mask)
     return out, (q, k, v, maskf, out, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, has_mask,
+                    res, g):
     q, k, v, maskf, out, lse = res
     if os.environ.get("HOROVOD_FLASH_XLA_BWD"):
         # Escape hatch: rematerialized backward through the XLA reference
@@ -533,7 +548,8 @@ def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
         dq, dk, dv = vjp(g)
         return dq, dk, dv, jnp.zeros_like(maskf)
     dq, dk, dv = _flash_backward(q, k, v, maskf != 0, out, lse, g, causal,
-                                 sm_scale, block_q, block_k, interpret)
+                                 sm_scale, block_q, block_k, interpret,
+                                 has_mask=has_mask)
     return dq, dk, dv, jnp.zeros_like(maskf)
 
 
@@ -576,10 +592,14 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
         interpret = _auto_interpret()
     b, sk = k.shape[0], k.shape[1]
     _check_gqa_heads(q, k, v, "flash_attention")
+    # has_mask is static: with key_mask=None the kernels skip the mask
+    # broadcast/where VPU passes entirely (the placeholder ones-mask
+    # still rides along so the custom_vjp arity is fixed).
     return _flash(q, k, v,
                   (jnp.ones((b, sk), jnp.float32) if key_mask is None
                    else key_mask.astype(jnp.float32)),
-                  causal, sm_scale, block_q, block_k, interpret)
+                  causal, sm_scale, block_q, block_k, interpret,
+                  key_mask is not None)
 
 
 

@@ -111,6 +111,39 @@ def test_flash_causal_sq_gt_sk_grads(monkeypatch, xla_bwd):
                                    atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_matches_reference(causal):
+    # Pins the low-precision path the bf16-training headline runs on: in
+    # bf16 the kernels feed the MXU bf16 operands with f32 accumulation
+    # and drop p/ds to bf16 for their dots — every f32 test is an exact
+    # no-op for those casts, so only a bf16 run can catch a regression
+    # (e.g. a lost preferred_element_type). Tolerances are bf16-scale.
+    rng = np.random.RandomState(21)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(B, S, H, D).astype(np.float32) * 0.3, jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    ref = reference_attention(q, k, v, causal=causal).astype(jnp.float32)
+    out = flash_attention(q, k, v, causal=causal,
+                          block_q=16, block_k=16).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+    def loss(fn):
+        return lambda q, k, v: (
+            fn(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    flash = lambda q, k, v: flash_attention(  # noqa: E731
+        q, k, v, causal=causal, block_q=16, block_k=16)
+    refa = lambda q, k, v: reference_attention(q, k, v, causal=causal)  # noqa: E731
+    gf = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss(refa), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        denom = np.abs(b).max() + 1e-6
+        assert np.abs(a - b).max() / denom < 5e-2
+
+
 def test_flash_key_mask():
     q, k, v = _qkv(1)
     mask = jnp.asarray(np.random.RandomState(2).rand(B, S) > 0.3)

@@ -198,6 +198,38 @@ def test_hierarchical_dcn_payload_scaled():
     np.testing.assert_allclose(wire, expected)
 
 
+class _FakeCompiled:
+    def __init__(self, text):
+        self._text = text
+
+    def as_text(self):
+        return self._text
+
+
+def test_parser_async_start_and_groups():
+    """TPU-compiled HLO uses async -start/-done pairs whose result tuple
+    is (operands..., results...) — payload must count the result half
+    only — and iota-form replica_groups; the CPU-mesh tests never
+    produce either, so pin the parser on synthetic lines."""
+    text = "\n".join([
+        "  %ag = (f32[32]{0}, f32[256]{0}) all-gather-start(%p), "
+        "channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}",
+        "  %agd = f32[256]{0} all-gather-done(%ag)",
+        "  %ar = (bf16[64,32]{1,0}, bf16[64,32]{1,0}) "
+        "all-reduce-start(%q), channel_id=2, "
+        "replica_groups={{0,1},{2,3},{4,5},{6,7}}, to_apply=%sum",
+        "  %ard = bf16[64,32]{1,0} all-reduce-done(%ar)",
+        "  ROOT %sync = f32[128]{0} all-reduce(%r), channel_id=3, "
+        "replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%sum",
+    ])
+    colls = collectives(_FakeCompiled(text))
+    by = {(c.op, c.group_size): c.payload_bytes for c in colls}
+    assert len(colls) == 3               # -done lines skipped
+    assert by[("all-gather", 8)] == 256 * 4      # result half only
+    assert by[("all-reduce", 2)] == 64 * 32 * 2  # bf16, group size 2
+    assert by[("all-reduce", 8)] == 128 * 4      # sync (ROOT prefix)
+
+
 @pytest.mark.parametrize("hkv", [4, 1])
 def test_ring_attention_kv_bytes_scale_with_kv_heads(hkv):
     """SP ring: the per-hop ppermute payload is the K/V block — grouped
