@@ -4,11 +4,14 @@ The reference has no MoE (2019 CNN-era, SURVEY.md §2.3); this is a TPU
 extension on the same substrate: experts live along an ``"expert"`` mesh
 axis, and token dispatch/return ride ``jax.lax.all_to_all`` over ICI — the
 canonical TPU MoE layout (GShard/Switch): tokens are packed into
-``[experts, capacity, d_model]`` buffers by index-based routing (int32
-cumsum capacity slots + per-round row scatter/gather — see ``_route``;
-the one-hot mask einsums this replaces cost more FLOPs than the experts
-at LM scale), exchanged all-to-all so each device holds its expert's
-tokens from every peer, transformed, and exchanged back.
+``[experts, capacity, d_model]`` buffers by index-based routing — int32
+cumsum capacity slots (``_route``) and gather-only row permutations
+whose custom_vjps route the transposes through the inverse
+slot→assignment map (``_pack_rows``/``_combine_rows``; the one-hot mask
+einsums this replaces cost more FLOPs than the experts at LM scale, and
+autodiff's scatter-add transposes cost ~2.3x a gather on TPU) —
+exchanged all-to-all so each device holds its expert's tokens from
+every peer, transformed, and exchanged back.
 
 Routing is top-k with capacity dropping (Switch for ``k=1``, GShard for
 ``k=2``): per expert at most ``capacity = ceil(k*T/E * capacity_factor)``
@@ -61,10 +64,11 @@ def _route(probs: jax.Array, capacity: int, num_selected: int,
     capped MoE MFU at ~23%. This version keeps the cheap part of that
     scheme — each round's capacity slot from an int32 cumsum over the
     [T, E] one-hot, filling in (round, token) order with a cross-round
-    carry — and replaces the einsums with per-round row scatter/gather
-    in ``_pack_to_experts``/``_gather_from_experts``: O(T*D + E*C*D)
-    memory traffic, no O(T*E*C) anything, and no argsort (measured
-    slower than the cumsum on the v5e vector unit).
+    carry — and replaces the einsums with gather-only row permutations
+    (``_pack_to_experts``/``_gather_from_experts`` via ``_pack_rows``/
+    ``_combine_rows``): O(T*D + E*C*D) memory traffic, no O(T*E*C)
+    anything, and no argsort (measured slower than the cumsum on the
+    v5e vector unit).
 
     Routing decisions (argmax, gates) are computed from f32 probs;
     combine weights drop to ``dtype`` at the end so y doesn't silently
@@ -106,33 +110,126 @@ def _route(probs: jax.Array, capacity: int, num_selected: int,
     return _Routing(choices, slots, combine_w), aux
 
 
-def _pack_to_experts(x: jax.Array, routing: _Routing, num_experts: int,
+# ---------------------------------------------------------------------------
+# Gather-only permutation (round 3): dispatch/combine and BOTH their
+# transposes run as row gathers. XLA's autodiff of a gather emits a
+# scatter-add, and TPU row scatters cost ~2.3x a gather (chip microbench
+# in artifacts/moe_dispatch_r3.json) — but a capacity slot is owned by at
+# most ONE assignment, so every transpose is itself a gather through the
+# inverse slot->assignment map. The custom_vjps below encode that.
+
+
+def _routing_indices(routing: _Routing, num_experts: int, capacity: int,
+                     tokens: int):
+    """Stacked per-round destination indices plus the inverse map.
+
+    ``dests/keeps [k, T]``: each assignment's flat buffer slot (clamped
+    when dropped) and liveness. ``inv_token/inv_round/inv_valid [E*C]``:
+    which (round, token) assignment owns each buffer slot. Building the
+    inverse IS a scatter, but of int32 scalars (k*T * 4 bytes), not of
+    D-wide rows — the 768x-smaller payload is the whole trick. Dropped
+    assignments get the out-of-range flat index ec and fall out via
+    ``mode="drop"`` (clamping would corrupt a neighbouring expert's
+    slot 0); kept slots are unique by construction (the cumsum carry
+    counts kept assignments only), so ``.set`` cannot collide."""
+    ec = num_experts * capacity
+    dests, keeps = [], []
+    inv = jnp.full((ec,), -1, jnp.int32)
+    for r, (e_idx, slot) in enumerate(zip(routing.expert_idx,
+                                          routing.slot)):
+        keep = slot < capacity
+        flat = jnp.where(keep, e_idx * capacity + slot, ec)
+        ids = (r * tokens
+               + jax.lax.iota(jnp.int32, tokens))
+        inv = inv.at[flat].set(ids, mode="drop")
+        dests.append(jnp.where(keep, flat, 0))
+        keeps.append(keep)
+    inv_valid = inv >= 0
+    safe_inv = jnp.where(inv_valid, inv, 0)
+    return (jnp.stack(dests), jnp.stack(keeps),
+            safe_inv % tokens, safe_inv // tokens, inv_valid)
+
+
+@jax.custom_vjp
+def _pack_rows(x, inv_token, inv_valid, dests, keeps):
+    """[T, D] token rows -> [E*C, D] buffer rows (zeros in unowned
+    slots): a single gather through the inverse map."""
+    return jnp.where(inv_valid[:, None], x[inv_token], 0)
+
+
+def _pack_rows_fwd(x, inv_token, inv_valid, dests, keeps):
+    return _pack_rows(x, inv_token, inv_valid, dests, keeps), (dests, keeps)
+
+
+def _pack_rows_bwd(res, g):
+    dests, keeps = res
+    # dx[t] = sum over the <=k slots that read token t — per-round
+    # gathers, NOT the scatter-add autodiff would emit.
+    dx = None
+    for r in range(dests.shape[0]):
+        term = jnp.where(keeps[r][:, None], g[dests[r]], 0)
+        dx = term if dx is None else dx + term
+    return dx, None, None, None, None
+
+
+_pack_rows.defvjp(_pack_rows_fwd, _pack_rows_bwd)
+
+
+@jax.custom_vjp
+def _combine_rows(out_flat, w, dests, keeps, inv_token, inv_round,
+                  inv_valid):
+    """Gate-weighted combine: y[t] = sum_r w[r,t] * out_flat[dests[r,t]]
+    (dropped assignments carry weight 0 already)."""
+    y = None
+    for r in range(dests.shape[0]):
+        term = out_flat[dests[r]] * w[r][:, None]
+        y = term if y is None else y + term
+    return y
+
+
+def _combine_fwd(out_flat, w, dests, keeps, inv_token, inv_round,
+                 inv_valid):
+    y = _combine_rows(out_flat, w, dests, keeps, inv_token, inv_round,
+                      inv_valid)
+    return y, (out_flat, w, dests, keeps, inv_token, inv_round, inv_valid)
+
+
+def _combine_bwd(res, dy):
+    out_flat, w, dests, keeps, inv_token, inv_round, inv_valid = res
+    # d_out[ec] = w of the assignment owning the slot * dy of its token —
+    # one gather through the inverse map (the scatter-free transpose).
+    w_at_slot = w[inv_round, inv_token]                  # [E*C]
+    dout = jnp.where(inv_valid[:, None],
+                     dy[inv_token] * w_at_slot[:, None], 0)
+    # dw[r, t] = <dy[t], out_flat[dests[r, t]]> for kept assignments —
+    # recomputes the forward gather instead of carrying [k, T, D]
+    # residuals (memory-flat; gathers are the cheap primitive here).
+    dw = jnp.stack([
+        jnp.where(keeps[r],
+                  jnp.sum(dy * out_flat[dests[r]].astype(dy.dtype), -1),
+                  0).astype(w.dtype)
+        for r in range(dests.shape[0])
+    ])
+    return dout.astype(out_flat.dtype), dw, None, None, None, None, None
+
+
+_combine_rows.defvjp(_combine_fwd, _combine_bwd)
+
+
+def _pack_to_experts(x: jax.Array, idx, num_experts: int,
                      capacity: int) -> jax.Array:
-    """Pack token rows into the ``[E, C, D]`` expert buffers: one row
-    scatter per round (dropped assignments get an out-of-range flat index
-    and fall out via ``mode="drop"`` — clamping would corrupt a
-    neighbouring expert's slot 0)."""
-    buf = jnp.zeros((num_experts * capacity, x.shape[1]), x.dtype)
-    for e_idx, slot in zip(routing.expert_idx, routing.slot):
-        flat_idx = jnp.where(slot < capacity, e_idx * capacity + slot,
-                             num_experts * capacity)
-        buf = buf.at[flat_idx].add(x, mode="drop")
+    dests, keeps, inv_token, inv_round, inv_valid = idx
+    buf = _pack_rows(x, inv_token, inv_valid, dests, keeps)
     return buf.reshape(num_experts, capacity, x.shape[1])
 
 
 def _gather_from_experts(expert_out: jax.Array, routing: _Routing,
-                         capacity: int) -> jax.Array:
-    """Gate-weighted combine: gather each round's expert output rows and
-    sum the rounds per token (dropped assignments carry weight 0)."""
-    num_experts, _, d = expert_out.shape
-    flat = expert_out.reshape(num_experts * capacity, d)
-    y = None
-    for e_idx, slot, w in zip(routing.expert_idx, routing.slot,
-                              routing.combine_w):
-        safe = jnp.where(slot < capacity, e_idx * capacity + slot, 0)
-        term = flat[safe] * w[:, None]
-        y = term if y is None else y + term
-    return y
+                         idx) -> jax.Array:
+    num_experts, capacity, d = expert_out.shape
+    dests, keeps, inv_token, inv_round, inv_valid = idx
+    w = jnp.stack(routing.combine_w)                     # [k, T]
+    return _combine_rows(expert_out.reshape(num_experts * capacity, d),
+                         w, dests, keeps, inv_token, inv_round, inv_valid)
 
 
 def _capacity(tokens: int, num_experts: int, capacity_factor: float,
@@ -171,10 +268,11 @@ def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
     probs = jax.nn.softmax(gate_logits, axis=-1)  # [T, E]
     routing, aux = _route(
         probs, capacity, num_selected, normalize_gates, x.dtype)
+    idx = _routing_indices(routing, num_experts, capacity, tokens)
 
     # Pack assignment rows into [E, C, D]; all-to-all so each device
     # receives its expert's buffer from every peer: [E_src, C, D].
-    expert_in = _pack_to_experts(x, routing, num_experts, capacity)
+    expert_in = _pack_to_experts(x, idx, num_experts, capacity)
     expert_in = jax.lax.all_to_all(expert_in, axis_name,
                                    split_axis=0, concat_axis=0)
     local_params = jax.tree.map(lambda a: jnp.squeeze(a, axis=0),
@@ -184,7 +282,7 @@ def moe_apply(expert_fn: Callable[[Any, jax.Array], jax.Array],
     expert_out = expert_out.reshape(num_experts, capacity, -1)
     expert_out = jax.lax.all_to_all(expert_out, axis_name,
                                     split_axis=0, concat_axis=0)
-    y = _gather_from_experts(expert_out, routing, capacity)
+    y = _gather_from_experts(expert_out, routing, idx)
     return y, aux
 
 
@@ -208,9 +306,10 @@ def moe_apply_dense(expert_fn: Callable[[Any, jax.Array], jax.Array],
     probs = jax.nn.softmax(gate_logits, axis=-1)
     routing, aux = _route(
         probs, capacity, num_selected, normalize_gates, x.dtype)
+    idx = _routing_indices(routing, num_experts, capacity, tokens)
 
-    expert_in = _pack_to_experts(x, routing, num_experts,
+    expert_in = _pack_to_experts(x, idx, num_experts,
                                  capacity)                  # [E, C, D]
     expert_out = jax.vmap(expert_fn)(stacked_params, expert_in)
-    y = _gather_from_experts(expert_out, routing, capacity)
+    y = _gather_from_experts(expert_out, routing, idx)
     return y, aux

@@ -107,6 +107,67 @@ def test_moe_capacity_drops_tokens():
         assert nonzero[0]  # slot-filling keeps the earliest token
 
 
+def test_moe_custom_vjp_grads_match_autodiff():
+    """The gather-only permutation custom_vjps (_pack_rows/_combine_rows
+    route their transposes through the inverse slot map) must produce
+    the same gradients as plain autodiff of the same indexing math —
+    including through capacity drops, where the masks matter. Forward
+    parity alone cannot catch a broken bwd rule."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.parallel.moe import (
+        _capacity,
+        _route,
+        moe_apply_dense,
+    )
+
+    rng = np.random.RandomState(9)
+    tokens, d, k, cf = 16, 8, 2, 0.5  # tight capacity: real drops
+    params = {
+        "w": jnp.asarray(rng.randn(E, d, d) * 0.5, jnp.float32),
+        "scale": jnp.asarray(1.0 + rng.rand(E, 1), jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(tokens, d), jnp.float32)
+    logits = jnp.asarray(rng.randn(tokens, E), jnp.float32)
+
+    def autodiff_twin(params, x, logits):
+        """Same routing + same indexing math, but with plain jnp ops so
+        XLA autodiff derives every transpose (scatter-adds and all)."""
+        capacity = _capacity(tokens, E, cf, k)
+        probs = jax.nn.softmax(logits, axis=-1)
+        routing, aux = _route(probs, capacity, k, True, x.dtype)
+        buf = jnp.zeros((E * capacity, d), x.dtype)
+        for e_idx, slot in zip(routing.expert_idx, routing.slot):
+            flat = jnp.where(slot < capacity, e_idx * capacity + slot,
+                             E * capacity)
+            buf = buf.at[flat].add(x, mode="drop")
+        out = jax.vmap(expert_fn)(params, buf.reshape(E, capacity, d))
+        flat_out = out.reshape(E * capacity, d)
+        y = None
+        for e_idx, slot, w in zip(routing.expert_idx, routing.slot,
+                                  routing.combine_w):
+            safe = jnp.where(slot < capacity, e_idx * capacity + slot, 0)
+            term = jnp.where((slot < capacity)[:, None],
+                             flat_out[safe], 0) * w[:, None]
+            y = term if y is None else y + term
+        return y, aux
+
+    def loss_fast(params, x, logits):
+        y, aux = moe_apply_dense(expert_fn, params, x, logits,
+                                 capacity_factor=cf, num_selected=k)
+        return (y ** 2).sum() + 0.1 * aux
+
+    def loss_twin(params, x, logits):
+        y, aux = autodiff_twin(params, x, logits)
+        return (y ** 2).sum() + 0.1 * aux
+
+    gf = jax.grad(loss_fast, argnums=(0, 1, 2))(params, x, logits)
+    gt = jax.grad(loss_twin, argnums=(0, 1, 2))(params, x, logits)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gt)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_moe_top2_default_capacity_no_drops_at_uniform_routing():
     """Capacity must provision k*T/E*factor slots: perfectly uniform top-2
     routing at the default capacity_factor=1.25 must drop nothing. (Under
