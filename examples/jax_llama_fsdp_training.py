@@ -83,7 +83,6 @@ def main():
     def loss_fn(p, ids):
         return causal_lm_loss(model.apply({"params": p}, ids), ids)
 
-    @jax.jit
     def raw_step(p, s, ids):
         loss, g = jax.value_and_grad(loss_fn)(p, ids)
         u, s = tx.update(g, s, p)

@@ -124,6 +124,13 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
             return
         config = Config.from_env()
         logging.configure(config.log_level, config.log_hide_timestamp)
+        # Launcher-spawned ranks arm the parent-death watchdog (reference
+        # spark/task/mpirun_exec_fn.py:25-35): an orphaned rank must kill
+        # itself, not hold ring ports until a peer timeout. Runtime import:
+        # run/ imports common/ at module load.
+        from ..run.watchdog import maybe_install_from_env
+
+        maybe_install_from_env()
         _maybe_init_jax_distributed()
         topology = detect(ranks)
         logging.set_rank(topology.rank)

@@ -350,6 +350,13 @@ class Controller:
                         time.sleep(delay)
         except Exception as exc:  # transport failure: fail all pending work
             logging.error("controller loop failed: %s", exc)
+            if not isinstance(exc, RuntimeError):
+                # Raw transport errors (a peer died: ConnectionError, EOF)
+                # surface as the engine-error RuntimeError the native
+                # engine raises, so callers see ONE failure contract.
+                exc = RuntimeError(
+                    f"Horovod controller failed: {exc} "
+                    "(a peer process likely died)")
             self._fail_all(exc)
         finally:
             self._closed.set()

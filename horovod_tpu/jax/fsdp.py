@@ -69,6 +69,17 @@ def _spec_entries(spec, ndim: int):
     return entries + [None] * (ndim - len(entries))
 
 
+def _normalize_specs(specs):
+    """``None`` is a legal "replicated" leaf in user spec trees (jit
+    treats it so), but ``jax.tree`` utilities treat None as an empty
+    subtree — dropped by ``tree_leaves``, a structure mismatch under
+    ``tree_map``. Rewrite None leaves to ``PartitionSpec()`` so every
+    consumer sees congruent trees."""
+    return jax.tree.map(
+        lambda s: PartitionSpec() if s is None else s, specs,
+        is_leaf=lambda s: s is None or isinstance(s, PartitionSpec))
+
+
 def fsdp_param_specs(params, num_shards: int, axis: str = "data",
                      base_specs=None,
                      min_leaf_elems: int = FSDP_MIN_LEAF_ELEMS):
@@ -120,7 +131,7 @@ def fsdp_param_specs(params, num_shards: int, axis: str = "data",
 
     if base_specs is None:
         return jax.tree.map(lambda p: spec_for(p, None), params)
-    return jax.tree.map(spec_for, params, base_specs)
+    return jax.tree.map(spec_for, params, _normalize_specs(base_specs))
 
 
 def fsdp_state_specs(optimizer: optax.GradientTransformation, params,
@@ -141,7 +152,9 @@ def fsdp_state_specs(optimizer: optax.GradientTransformation, params,
     collisions.)
     """
     param_leaves = jax.tree_util.tree_flatten_with_path(params)[0]
-    spec_leaves = jax.tree_util.tree_leaves(param_specs)
+    spec_leaves = jax.tree_util.tree_leaves(
+        _normalize_specs(param_specs),
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
     by_path = {
         tuple(path): (leaf.shape, spec)
         for (path, leaf), spec in zip(param_leaves, spec_leaves)
@@ -171,8 +184,8 @@ def fsdp_shardings(mesh: Mesh, specs):
     """``NamedSharding`` tree from a ``PartitionSpec`` tree — feed to
     ``jax.device_put`` / ``jit(out_shardings=...)``."""
     return jax.tree.map(
-        lambda s: NamedSharding(mesh, s),
-        specs, is_leaf=lambda s: isinstance(s, PartitionSpec))
+        lambda s: NamedSharding(mesh, s), _normalize_specs(specs),
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
 
 
 def sharded_size_bytes(tree, specs, num_shards_by_axis) -> int:

@@ -86,6 +86,10 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
         "HOROVOD_CROSS_SIZE": str(cross_size),
         "HOROVOD_SECRET_KEY": secret,
     })
+    # Ranks we spawn watch their parent and die when orphaned (local: this
+    # launcher; remote: the ssh session's shell). HOROVOD_PARENT_WATCHDOG=0
+    # in the launcher's env opts out and is inherited via `base`.
+    env.setdefault("HOROVOD_PARENT_WATCHDOG", "1")
     if spmd:
         # SPMD multi-host mode: ranks join the JAX distributed runtime and
         # every process sees the global device set; no eager controller.

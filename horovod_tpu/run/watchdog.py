@@ -1,0 +1,102 @@
+"""Parent-death watchdog for launcher-spawned ranks.
+
+Reference: ``spark/task/mpirun_exec_fn.py:25-35`` — an orphaned rank
+(its parent launcher/executor died) kills itself instead of living on,
+holding ring ports and the TPU until some peer timeout fires. Here the
+same contract covers every spawn path: ``horovodrun`` local children,
+ssh-fanned remote ranks (their watched parent is the ssh session's
+shell — the session tears down when the launcher side goes away), and
+``horovod_tpu.spark`` tasks.
+
+Two layers, both armed by :func:`install`:
+
+* ``prctl(PR_SET_PDEATHSIG, SIGTERM)`` (Linux): the kernel delivers
+  SIGTERM the instant the parent dies — no polling latency.
+* A daemon thread polling ``os.getppid()``: catches the cases prctl
+  can't (non-Linux, or the exec'd interpreter re-parented between fork
+  and install) by noticing the re-parent to init/subreaper. It sends
+  SIGTERM to let ``hvd.shutdown``/atexit run, then escalates to
+  ``os._exit`` after a grace period in case the engine is wedged on the
+  very sockets the dead launcher held open.
+
+Ranks opt in via ``HOROVOD_PARENT_WATCHDOG=1``, which the launcher and
+the Spark task function export; standalone processes calling
+``hvd.init()`` from a user's shell are never watched (their parent
+dying — the shell exiting — must not kill training).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+
+_POLL_INTERVAL_S = 1.0
+_GRACE_S = 5.0
+
+_lock = threading.Lock()
+_installed = False
+
+
+def _set_pdeathsig(signum: int) -> bool:
+    """Best-effort ``prctl(PR_SET_PDEATHSIG, signum)`` (Linux only)."""
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        return libc.prctl(PR_SET_PDEATHSIG, signum, 0, 0, 0) == 0
+    except Exception:
+        return False
+
+
+def install(poll_interval: float = _POLL_INTERVAL_S,
+            grace: float = _GRACE_S) -> bool:
+    """Arm the watchdog against the CURRENT parent. Idempotent; returns
+    whether a watchdog is armed. No-op (False) when already orphaned at
+    install time — with the original parent unknowable, killing would be
+    a guess."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+        parent = os.getppid()
+        if parent <= 1:
+            return False
+        _set_pdeathsig(signal.SIGTERM)
+
+        def _watch():
+            while True:
+                time.sleep(poll_interval)
+                if os.getppid() != parent:
+                    try:
+                        # Best-effort: stderr may BE a pipe to the dead
+                        # parent — a BrokenPipeError here must not stop
+                        # the reaping below.
+                        sys.stderr.write(
+                            f"horovod_tpu: parent {parent} died; "
+                            "terminating orphaned rank "
+                            f"{os.environ.get('HOROVOD_RANK', '?')}\n")
+                        sys.stderr.flush()
+                    except Exception:
+                        pass
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    time.sleep(grace)
+                    os._exit(signal.SIGTERM + 128)
+
+        threading.Thread(target=_watch, name="hvd-parent-watchdog",
+                         daemon=True).start()
+        _installed = True
+        return True
+
+
+def maybe_install_from_env() -> bool:
+    """Arm iff the launcher asked for it (``HOROVOD_PARENT_WATCHDOG``).
+    Called from ``hvd.init()``; safe to call any number of times."""
+    from ..common.config import _env_bool
+
+    if not _env_bool("HOROVOD_PARENT_WATCHDOG"):
+        return False
+    return install()

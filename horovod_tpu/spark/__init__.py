@@ -32,6 +32,10 @@ def _task_fn(fn: Callable, args: tuple, kwargs: dict, driver_addr: str):
             "HOROVOD_RING_ADDRS": assignment["ring_addrs"],
             "HOROVOD_SECRET_KEY": assignment["secret"],
         })
+        # Orphaned-task self-termination (reference
+        # spark/task/mpirun_exec_fn.py:25-35): if the executor's python
+        # worker is orphaned mid-job, hvd.init()'s watchdog reaps it.
+        os.environ.setdefault("HOROVOD_PARENT_WATCHDOG", "1")
         yield fn(*args, **kwargs)
 
     return task

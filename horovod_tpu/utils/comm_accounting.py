@@ -45,7 +45,8 @@ class Collective:
     group_size: int     # devices per replica group (1 = unknown/whole)
 
 
-def _shape_entries(sig: str) -> List[int]:
+def _typed_entries(sig: str) -> List[tuple]:
+    """(dtype, dims, bytes) per array in an HLO signature string."""
     out = []
     for dtype, dims in _SHAPE_RE.findall(sig):
         if dtype not in _DTYPE_BYTES:
@@ -54,8 +55,29 @@ def _shape_entries(sig: str) -> List[int]:
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        out.append(n * _DTYPE_BYTES[dtype])
+        out.append((dtype, dims, n * _DTYPE_BYTES[dtype]))
     return out
+
+
+def _shape_entries(sig: str) -> List[int]:
+    return [b for _, _, b in _typed_entries(sig)]
+
+
+def _operand_count(line: str, open_paren: int) -> int:
+    """Number of comma-separated operands in the call parens opening at
+    ``open_paren`` (depth-aware; 0 for an empty list)."""
+    depth, i, commas = 1, open_paren + 1, 0
+    start = i
+    while i < len(line) and depth:
+        c = line[i]
+        if c == "(":
+            depth += 1
+        elif c == ")":
+            depth -= 1
+        elif c == "," and depth == 1:
+            commas += 1
+        i += 1
+    return 0 if not line[start:i - 1].strip() else commas + 1
 
 
 # "{{0,1,2,3},{4,5,6,7}}" (explicit) or "[2,4]<=[8]" (iota: 2 groups x 4).
@@ -94,12 +116,29 @@ def collectives(compiled) -> List[Collective]:
             continue
         if m.group(3) == "-done":
             continue
-        entries = _shape_entries(m.group(1))
-        if m.group(3) == "-start" and len(entries) % 2 == 0:
-            # Async form: the result tuple is (operands..., results...) —
-            # keep the result half only, or every async collective's
-            # payload double-counts.
-            entries = entries[len(entries) // 2:]
+        if m.group(3) == "-start":
+            # Async form: the result tuple is (operands..., results...)
+            # plus, for collective-permute-start, trailing u32[] context
+            # scalars. Strip the context, then drop exactly as many
+            # leading entries as the op has operands (parsed from the
+            # call parens) — an even-count halving heuristic miscounts
+            # whenever context entries pad the tuple.
+            ents = _typed_entries(m.group(1))
+            # Only collective-permute-start pads its tuple with u32[]
+            # context scalars; stripping them from other ops would zero
+            # out a genuine integer-scalar collective.
+            if m.group(2) == "collective-permute":
+                while ents and ents[-1][1] == "" and ents[-1][0] in (
+                        "u32", "s32"):
+                    ents.pop()
+            k = _operand_count(s, m.end() - 1)
+            if 0 < k < len(ents):
+                ents = ents[k:]
+            elif len(ents) % 2 == 0:
+                ents = ents[len(ents) // 2:]
+            entries = [b for _, _, b in ents]
+        else:
+            entries = _shape_entries(m.group(1))
         out.append(Collective(m.group(2), sum(entries), _group_size(s)))
     return out
 

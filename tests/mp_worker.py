@@ -310,6 +310,26 @@ def scenario_autotune(rank, size):
         np.testing.assert_allclose(out, want, rtol=1e-6)
 
 
+def scenario_peer_death(rank, size):
+    # A rank DYING (SIGKILL, no shutdown message) mid-job must surface as
+    # an engine error on its peers within the stall/ring timeout, not an
+    # unbounded hang — the contract shm.cc:19-23 documents for the local
+    # plane, here exercised end-to-end by actually killing a process.
+    import signal as _signal
+
+    out = np.asarray(hvd.allreduce(np.ones(4, np.float32), average=False,
+                                   name="pd.warm"))
+    np.testing.assert_allclose(out, float(size))
+    if rank == 1:
+        os.kill(os.getpid(), _signal.SIGKILL)  # die without cleanup
+    try:
+        hvd.allreduce(np.ones(4, np.float32), name="pd.after")
+    except RuntimeError as exc:
+        print(f"peer-death error surfaced: {exc}", flush=True)
+    else:
+        raise AssertionError("allreduce with a dead peer did not raise")
+
+
 def scenario_stall(rank, size):
     # Reference test/test_stall.py: one rank joins late; the coordinator must
     # warn (HOROVOD_STALL_CHECK_TIME_SECONDS=1 set by the parent) and the op
@@ -779,6 +799,7 @@ SCENARIOS = {
     "optimizer": scenario_optimizer,
     "stall": scenario_stall,
     "stall_shutdown": scenario_stall_shutdown,
+    "peer_death": scenario_peer_death,
     "allreduce": scenario_allreduce,
     "fusion": scenario_fusion,
     "allgather": scenario_allgather,

@@ -230,6 +230,29 @@ def test_parser_async_start_and_groups():
     assert by[("all-reduce", 8)] == 128 * 4      # sync (ROOT prefix)
 
 
+def test_parser_start_tuple_with_context_scalars():
+    """collective-permute-start's result tuple carries trailing u32[]
+    context scalars beyond (operand, result); a tuple-halving heuristic
+    would bill half the context into the payload. Also pin the
+    multi-operand combined all-reduce-start (operands..., results...)
+    form, where the operand count — not an even split — decides the
+    boundary."""
+    text = "\n".join([
+        "  %cp = (f32[64]{0}, f32[64]{0}, u32[], u32[]) "
+        "collective-permute-start(%p), channel_id=1, "
+        "source_target_pairs={{0,1},{1,0}}",
+        "  %cpd = f32[64]{0} collective-permute-done(%cp)",
+        "  %ar = (f32[16]{0}, bf16[8]{0}, f32[16]{0}, bf16[8]{0}) "
+        "all-reduce-start(%a, %b), channel_id=2, "
+        "replica_groups={{0,1,2,3}}, to_apply=%sum",
+        "  %ard = (f32[16]{0}, bf16[8]{0}) all-reduce-done(%ar)",
+    ])
+    colls = collectives(_FakeCompiled(text))
+    by = {c.op: c.payload_bytes for c in colls}
+    assert by["collective-permute"] == 64 * 4          # no context bytes
+    assert by["all-reduce"] == 16 * 4 + 8 * 2          # result half
+
+
 @pytest.mark.parametrize("hkv", [4, 1])
 def test_ring_attention_kv_bytes_scale_with_kv_heads(hkv):
     """SP ring: the per-hop ppermute payload is the K/V block — grouped

@@ -62,6 +62,24 @@ def test_param_specs_compose_with_tp_base():
         fsdp_param_specs(params, num_shards=2, axis="model", base_specs=tp)
 
 
+def test_param_specs_accept_none_as_replicated_base():
+    """``None`` is the common "replicated" idiom in user spec trees (jit
+    accepts it); tree.map treats None as an empty subtree, so both
+    fsdp_param_specs and fsdp_shardings must normalize rather than raise
+    a structure mismatch."""
+    params = {"w": jnp.zeros((64, 16)), "b": jnp.zeros((16,))}
+    base = {"w": P(None, "model"), "b": None}
+    specs = fsdp_param_specs(params, num_shards=N_DEV, base_specs=base,
+                             min_leaf_elems=1)
+    assert specs["w"] == P("data", "model")
+    assert specs["b"] == P("data")  # None base composed, dim 16 % 8 == 0
+
+    mesh = make_mesh({"data": 4, "model": 2})
+    sh = fsdp_shardings(mesh, {"w": P("data", None), "b": None})
+    assert sh["b"].spec == P()
+    assert sh["w"].spec == P("data", None)
+
+
 def test_state_specs_structural_match():
     params = {
         "w": jnp.zeros((64, 16)),

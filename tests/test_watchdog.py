@@ -1,0 +1,107 @@
+"""Parent-death watchdog (run/watchdog.py): an orphaned launcher-spawned
+rank reaps itself (reference ``spark/task/mpirun_exec_fn.py:25-35``)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+_PARENT = r"""
+import subprocess, sys, time
+prctl_ok = sys.argv[1] == "prctl"
+body = '''
+import horovod_tpu.run.watchdog as w
+if not %r:
+    w._set_pdeathsig = lambda s: False  # poll-thread-only path
+assert w.install(poll_interval=0.2, grace=1.0)
+import time
+time.sleep(120)
+''' % prctl_ok
+# stderr/stdout piped to THIS (soon dead) parent: the watchdog's
+# diagnostic write hits a broken pipe and must still reap the child.
+child = subprocess.Popen([sys.executable, "-c", body],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+print(child.pid, flush=True)
+time.sleep(120)
+"""
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+import pytest
+
+
+@pytest.mark.parametrize("layer", ["prctl", "poll"])
+def test_orphaned_child_reaps_itself(layer):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    parent = subprocess.Popen([sys.executable, "-c", _PARENT, layer],
+                              env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        child_pid = int(parent.stdout.readline())
+        assert _alive(child_pid)
+        # SIGKILL: no cleanup chance — the exact orphaning the watchdog
+        # exists for.
+        parent.send_signal(signal.SIGKILL)
+        parent.wait(timeout=10)
+        deadline = time.monotonic() + 15.0
+        while _alive(child_pid):
+            assert time.monotonic() < deadline, (
+                "orphaned child still alive 15s after its parent died")
+            time.sleep(0.2)
+    finally:
+        if parent.poll() is None:
+            parent.kill()
+        try:
+            os.kill(child_pid, signal.SIGKILL)
+        except (ProcessLookupError, UnboundLocalError):
+            pass
+
+
+def _probe(env_value):
+    """maybe_install_from_env() in a throwaway interpreter (arming a
+    watchdog inside the pytest process would watch pytest's own parent)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("HOROVOD_PARENT_WATCHDOG", None)
+    if env_value is not None:
+        env["HOROVOD_PARENT_WATCHDOG"] = env_value
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from horovod_tpu.run.watchdog import maybe_install_from_env;"
+         "print(maybe_install_from_env())"],
+        env=env, capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return out.stdout.strip()
+
+
+def test_env_gate():
+    assert _probe(None) == "False"      # standalone runs are never watched
+    assert _probe("0") == "False"       # explicit opt-out
+    assert _probe("1") == "True"        # launcher-exported opt-in
+
+
+def test_launcher_exports_watchdog_env():
+    from horovod_tpu.run.launch import build_rank_env
+
+    env = build_rank_env({}, rank=0, size=2, local_rank=0, local_size=2,
+                         cross_rank=0, cross_size=1,
+                         controller_addr="127.0.0.1:1", secret="ab",
+                         bind_chips=False)
+    assert env["HOROVOD_PARENT_WATCHDOG"] == "1"
+    # User opt-out in the launcher environment is inherited, not clobbered.
+    env = build_rank_env({"HOROVOD_PARENT_WATCHDOG": "0"}, rank=0, size=2,
+                         local_rank=0, local_size=2, cross_rank=0,
+                         cross_size=1, controller_addr="127.0.0.1:1",
+                         secret="ab", bind_chips=False)
+    assert env["HOROVOD_PARENT_WATCHDOG"] == "0"
