@@ -95,6 +95,24 @@ def _group_size(line: str) -> int:
     return 1
 
 
+def async_result_entries(line: str, opcode: str, ents: List[tuple],
+                         open_paren: int) -> List[tuple]:
+    """Result-half entries of an async ``X-start`` tuple: strip
+    collective-permute's trailing u32[] context scalars, then drop as
+    many leading entries as the op has operands (parsed from the call
+    parens); even-halving is the fallback when parsing fails. Shared by
+    :func:`collectives` and ``utils.overlap``."""
+    if opcode.startswith("collective-permute"):
+        while ents and ents[-1][1] == "" and ents[-1][0] in ("u32", "s32"):
+            ents.pop()
+    k = _operand_count(line, open_paren)
+    if 0 < k < len(ents):
+        return ents[k:]
+    if len(ents) % 2 == 0:
+        return ents[len(ents) // 2:]
+    return ents
+
+
 def collectives(compiled) -> List[Collective]:
     """Parse a ``jax`` compiled object (``jit(f).lower(...).compile()``)
     into its collective ops. Payload = the op's RESULT shape bytes (for
@@ -117,26 +135,9 @@ def collectives(compiled) -> List[Collective]:
         if m.group(3) == "-done":
             continue
         if m.group(3) == "-start":
-            # Async form: the result tuple is (operands..., results...)
-            # plus, for collective-permute-start, trailing u32[] context
-            # scalars. Strip the context, then drop exactly as many
-            # leading entries as the op has operands (parsed from the
-            # call parens) — an even-count halving heuristic miscounts
-            # whenever context entries pad the tuple.
-            ents = _typed_entries(m.group(1))
-            # Only collective-permute-start pads its tuple with u32[]
-            # context scalars; stripping them from other ops would zero
-            # out a genuine integer-scalar collective.
-            if m.group(2) == "collective-permute":
-                while ents and ents[-1][1] == "" and ents[-1][0] in (
-                        "u32", "s32"):
-                    ents.pop()
-            k = _operand_count(s, m.end() - 1)
-            if 0 < k < len(ents):
-                ents = ents[k:]
-            elif len(ents) % 2 == 0:
-                ents = ents[len(ents) // 2:]
-            entries = [b for _, _, b in ents]
+            entries = [b for _, _, b in async_result_entries(
+                s, m.group(2) + m.group(3), _typed_entries(m.group(1)),
+                m.end() - 1)]
         else:
             entries = _shape_entries(m.group(1))
         out.append(Collective(m.group(2), sum(entries), _group_size(s)))

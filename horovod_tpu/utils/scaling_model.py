@@ -1,0 +1,134 @@
+"""Measured-inputs scaling-efficiency projection for data parallelism.
+
+The reference's north-star numbers — 90% scaling efficiency for
+Inception V3 / ResNet-101 at 512 GPUs, 68% for VGG-16
+(``/root/reference/docs/benchmarks.md:5-6``) — are a function of three
+things: per-device step time, gradient bytes, and how much of the
+reduction hides behind backward compute. This module computes the same
+function for a TPU pod from inputs that are each individually *measured*
+on the hardware we have:
+
+* ``step_time_s`` — single-chip step time (bench.py / examples, real
+  v5e chip);
+* per-group gradient payloads and their **availability points** — parsed
+  from the real v5e-compiled schedule (``utils.overlap``: the compiler
+  emits one combined all-reduce per gradient group, placed where its
+  producers finish; the fraction of compute scheduled after it is the
+  overlap budget);
+* link bandwidth — the one input we cannot measure on a single chip;
+  taken from published per-chip ICI figures and carried as an explicit
+  parameter with a conservative band, never baked in.
+
+Pipelined-reduction event model (:func:`dp_step_time`): compute runs for
+``step_time_s``; gradient group *g* becomes available at
+``(1 - compute_after_frac_g) * step_time_s``; a single serial comm
+engine (the ICI DMA) starts each group when both the group is available
+and the engine is free. The step ends when both compute and the last
+reduction finish. This is exactly the overlap the reference's background
+thread implements in software (``horovod/common/operations.cc`` cycle
+loop) and XLA's schedule implements on TPU.
+
+Ring-allreduce wire bytes use :mod:`.comm_accounting`'s model:
+``2 (n-1)/n * B`` per device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+from .comm_accounting import ring_allreduce_bytes as ring_wire_bytes
+
+# Published per-chip aggregate ICI bandwidths (one-way, bytes/s). Sources:
+# cloud.google.com/tpu/docs system architecture pages — v5e: 1,600 Gbps
+# per chip (2D torus, 4 links); v5p: 4,800 Gbps per chip (3D torus,
+# 6 links). The optimistic figure assumes XLA's multi-dimension ring
+# decomposition drives every link (what its combined all-reduce does on
+# a full torus axis); the conservative band assumes a single torus
+# dimension's links only.
+ICI_BW_BYTES_PER_S = {
+    "v5e": 200e9,
+    "v5p": 600e9,
+}
+CONSERVATIVE_LINK_FRACTION = {
+    "v5e": 0.5,   # 1 of 2 torus dims
+    "v5p": 1 / 3,  # 1 of 3 torus dims
+}
+# Per-chip DCN share for multi-slice jobs: ~200 Gbps NICs per v5e host
+# of 8 chips => ~3 GB/s/chip sustained. Carried as a parameter.
+DCN_BW_BYTES_PER_S_PER_CHIP = 3e9
+
+
+@dataclasses.dataclass
+class GradGroup:
+    payload_bytes: int
+    compute_after_frac: float  # schedule fraction of compute still queued
+
+
+def dp_step_time(step_time_s: float, groups: Sequence[GradGroup],
+                 n: int, bw_bytes_per_s: float,
+                 overlap: bool = True) -> float:
+    """Projected per-step wall time at ``n`` chips (event model above)."""
+    if n <= 1:
+        return step_time_s
+    engine_free = 0.0
+    for g in sorted(groups, key=lambda g: g.compute_after_frac,
+                    reverse=True):
+        avail = ((1.0 - g.compute_after_frac) * step_time_s
+                 if overlap else step_time_s)
+        t_comm = ring_wire_bytes(n, g.payload_bytes) / bw_bytes_per_s
+        engine_free = max(engine_free, avail) + t_comm
+    return max(step_time_s, engine_free)
+
+
+def dp_efficiency(step_time_s: float, groups: Sequence[GradGroup], n: int,
+                  bw_bytes_per_s: float, overlap: bool = True) -> float:
+    """step_time(1) / step_time(n): weak-scaling efficiency (fixed
+    per-chip batch — the reference benchmark's definition,
+    ``/root/reference/docs/benchmarks.md:10-34``)."""
+    return step_time_s / dp_step_time(step_time_s, groups, n,
+                                      bw_bytes_per_s, overlap)
+
+
+def hierarchical_exposed_bytes(total_payload: int, ici_size: int) -> float:
+    """DCN bytes per chip for a two-level reduction (psum_scatter on ICI,
+    cross-slice psum of the 1/ici shard, all_gather back —
+    ``parallel/hierarchical.py``): each chip owns 1/ici_size of the
+    payload on the slow axis."""
+    return 2.0 * total_payload / ici_size
+
+
+def multislice_efficiency(step_time_s: float, groups: Sequence[GradGroup],
+                          n_slices: int, ici_size: int,
+                          ici_bw: float, dcn_bw_per_chip: float,
+                          overlap: bool = True) -> float:
+    """Two-slice+ jobs: ICI phase as in :func:`dp_efficiency` within the
+    slice, plus the serialized DCN phase on each chip's 1/ici shard
+    (conservative: DCN phase modeled unoverlapped beyond the ICI
+    pipeline, which is how ``hierarchical_allreduce`` sequences it)."""
+    t_ici = dp_step_time(step_time_s, groups, ici_size, ici_bw, overlap)
+    total = sum(g.payload_bytes for g in groups)
+    scale = (n_slices - 1) / n_slices
+    t_dcn = scale * hierarchical_exposed_bytes(
+        total, ici_size) / dcn_bw_per_chip
+    return step_time_s / (t_ici + t_dcn)
+
+
+def groups_from_overlap_report(report: dict,
+                               min_bytes: int = 1 << 16) -> List[GradGroup]:
+    """The sync-collective placements of a compiled DP step, as model
+    inputs. Small control collectives (loss psum, counters) are dropped:
+    they are not gradient traffic."""
+    out = []
+    for s in report["sync_collectives"]:
+        if s["opcode"] != "all-reduce" or s["payload_bytes"] < min_bytes:
+            continue
+        out.append(GradGroup(s["payload_bytes"], s["compute_after_frac"]))
+    return out
+
+
+def efficiency_curve(step_time_s: float, groups: Sequence[GradGroup],
+                     sizes: Sequence[int], bw_bytes_per_s: float,
+                     overlap: bool = True) -> Dict[int, float]:
+    return {n: dp_efficiency(step_time_s, groups, n, bw_bytes_per_s,
+                             overlap) for n in sizes}

@@ -1,0 +1,176 @@
+"""Overlap evidence + scaling projection (utils/overlap.py,
+utils/scaling_model.py, examples/scaling_projection.py): parser pinned on
+TPU-style synthetic schedules and a live CPU-mesh compile; the event
+model pinned on hand-computable cases; the shipped artifact's inputs
+pinned against the models they claim to describe."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.utils import overlap as ov
+from horovod_tpu.utils import scaling_model as sm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A TPU-style scheduled module: async all-gather pair with two fusions in
+# flight, an async slice-start (memory op, must not count as collective
+# evidence), a sync combined all-reduce mid-backward, and a scalar loss
+# all-reduce at the end.
+_TPU_STYLE = """\
+HloModule m, is_scheduled=true
+
+ENTRY %main_spmd (p0: f32[128,128]) -> f32[] {
+  %param.0 = f32[128,128]{1,0:T(8,128)} parameter(0)
+  %fusion.1 = f32[128,128]{1,0:T(8,128)} fusion(%param.0), kind=kLoop
+  %all-gather-start.1 = (f32[16,128]{1,0:T(8,128)}, f32[128,128]{1,0:T(8,128)}) all-gather-start(%fusion.1), channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}
+  %fusion.2 = f32[128,128]{1,0:T(8,128)} fusion(%fusion.1), kind=kLoop
+  %fusion.3 = f32[128,128]{1,0:T(8,128)} fusion(%fusion.2), kind=kLoop
+  %all-gather-done.1 = f32[128,128]{1,0:T(8,128)} all-gather-done(%all-gather-start.1)
+  %slice-start.1 = ((f32[128,128]{1,0:T(8,128)}), f32[16,128]{1,0:T(8,128)S(1)}, s32[]{:S(2)}) slice-start(%fusion.3), slice={[0:16], [0:128]}
+  %slice-done.1 = f32[16,128]{1,0:T(8,128)S(1)} slice-done(%slice-start.1)
+  %all-reduce.1 = f32[128,128]{1,0:T(8,128)} all-reduce(%all-gather-done.1), channel_id=2, replica_groups=[1,8]<=[8], to_apply=%sum
+  %fusion.4 = f32[128,128]{1,0:T(8,128)} fusion(%all-reduce.1), kind=kLoop
+  %fusion.5 = f32[]{:T(128)} fusion(%fusion.4), kind=kLoop
+  ROOT %all-reduce.2 = f32[]{:T(128)} all-reduce(%fusion.5), channel_id=3, replica_groups=[1,8]<=[8], to_apply=%sum
+}
+"""
+
+
+def test_parser_tpu_style_schedule():
+    sched = ov.parse_entry_schedule(_TPU_STYLE)
+    assert [o.opcode for o in sched[:3]] == [
+        "parameter", "fusion", "all-gather-start"]
+    pairs = ov.async_pairs(sched)
+    # slice pair parses but is not a collective
+    assert {p.opcode for p in pairs} == {"all-gather", "slice"}
+    ag = next(p for p in pairs if p.opcode == "all-gather")
+    assert ag.compute_in_flight == 2          # fusion.2, fusion.3
+    assert ag.payload_bytes == 128 * 128 * 4  # result half, not operand
+
+    syncs = ov.sync_collective_placement(sched)
+    assert [s.opcode for s in syncs] == ["all-reduce", "all-reduce"]
+    big, small = syncs
+    assert big.payload_bytes == 128 * 128 * 4
+    assert big.compute_after == 2             # fusion.4, fusion.5
+    assert small.payload_bytes == 4 and small.compute_after == 0
+
+    report = ov.overlap_report(_TPU_STYLE)
+    assert report["async_pairs"]["by_op"] == {"all-gather": 1}
+    assert report["async_pairs"]["with_compute_in_flight"] == 1
+    groups = sm.groups_from_overlap_report(report, min_bytes=1024)
+    assert len(groups) == 1                   # scalar loss reduce dropped
+    assert groups[0].payload_bytes == 128 * 128 * 4
+
+
+def test_parser_live_cpu_compile():
+    """The parser must also read what THIS jax emits: a DP step on the
+    8-device CPU mesh. CPU keeps collectives sync — placement evidence
+    only — and the gradient payload must equal the parameter bytes."""
+    import horovod_tpu as hvd
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:8]), ("data",))
+    feat = 32
+    params = {"w": jnp.zeros((feat, feat)), "b": jnp.zeros((feat,))}
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1), axis_name="data")
+    state = jax.eval_shape(tx.init, params)
+
+    def step(p, s, x, y):
+        def loss_fn(p_):
+            return jnp.mean((jnp.tanh(x @ p_["w"]) + p_["b"] - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P()), check_vma=False))
+    x = jax.ShapeDtypeStruct((16, feat), jnp.float32)
+    y = jax.ShapeDtypeStruct((16, feat), jnp.float32)
+    compiled = f.lower(params, state, x, y).compile()
+    report = ov.overlap_report(compiled)
+    groups = sm.groups_from_overlap_report(report, min_bytes=1024)
+    param_bytes = (feat * feat + feat) * 4
+    assert sum(g.payload_bytes for g in groups) == param_bytes
+    assert report["n_compute_ops"] > 0
+
+
+def test_event_model_hand_cases():
+    t = 0.1
+    g_end = [sm.GradGroup(100_000_000, 0.0)]   # ready at end of compute
+    bw = 1e9                                   # 1 GB/s: t_comm = 0.175s @8
+    wire = sm.ring_wire_bytes(8, 100_000_000)
+    assert sm.dp_step_time(t, g_end, 8, bw) == pytest.approx(t + wire / bw)
+    # Available from the start and comm shorter than compute: fully hidden.
+    g_start = [sm.GradGroup(100_000_000, 1.0)]
+    assert sm.dp_efficiency(t, g_start, 8, 10e9) == pytest.approx(1.0)
+    # overlap=False exposes the full wire time regardless of placement.
+    assert sm.dp_step_time(t, g_start, 8, bw, overlap=False) == \
+        pytest.approx(t + wire / bw)
+    # Serial engine: two groups ready at the same instant queue up.
+    two = [sm.GradGroup(50_000_000, 0.0), sm.GradGroup(50_000_000, 0.0)]
+    assert sm.dp_step_time(t, two, 8, bw) == pytest.approx(t + wire / bw)
+    # n=1 is a no-op; efficiency decreases with n.
+    assert sm.dp_step_time(t, g_end, 1, bw) == t
+    effs = [sm.dp_efficiency(t, g_end, n, bw) for n in (2, 8, 64, 256)]
+    assert all(a >= b for a, b in zip(effs, effs[1:]))
+    # Two-level: DCN phase strictly costs efficiency vs pure ICI.
+    assert sm.multislice_efficiency(t, g_end, 2, 128, 1e11, 3e9) < \
+        sm.dp_efficiency(t, g_end, 128, 1e11)
+
+
+def test_artifact_inputs_pinned():
+    """The shipped projection artifact's inputs must match what it claims:
+    gradient payload == the real model's parameter bytes (cheap
+    eval_shape, no compile), measured rate == the driver's BENCH record,
+    efficiencies coherent."""
+    path = os.path.join(REPO, "artifacts", "scaling_projection_r4.json")
+    d = json.load(open(path))
+
+    from horovod_tpu.models import BERT_BASE, BertEncoder, ResNet50
+
+    model_params = {
+        "resnet50": jax.eval_shape(
+            lambda: ResNet50(num_classes=1000, dtype=jnp.bfloat16).init(
+                jax.random.PRNGKey(0), jnp.ones((1, 224, 224, 3)),
+                train=True))["params"],
+        "bert_base": jax.eval_shape(
+            lambda: BertEncoder(BERT_BASE).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
+                deterministic=True))["params"],
+    }
+    bench = json.load(open(os.path.join(REPO, "BENCH_r03.json")))
+    assert d["resnet50"]["measured_input"]["rate"] == \
+        bench["parsed"]["value"]
+
+    for name, params in model_params.items():
+        sec = d[name]
+        pbytes = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                     for l in jax.tree.leaves(params))
+        hlo = sec["hlo_input"]["hlo_allreduce_payload_bytes"]
+        assert sec["hlo_input"]["param_bytes_crosscheck"] == pbytes
+        # The combined all-reduces must carry (almost exactly) one full
+        # gradient set: tiny leaves may fall below the group filter, the
+        # loss scalar may ride along.
+        assert abs(hlo - pbytes) / pbytes < 0.001, (name, hlo, pbytes)
+        for gen in ("v5e", "v5p"):
+            proj = sec["projection"][gen]
+            for n in map(str, (8, 64, 256)):
+                opt = proj["efficiency_optimistic"][n]
+                con = proj["efficiency_conservative"][n]
+                raw = proj["efficiency_no_overlap_conservative"][n]
+                assert 0 < raw <= con <= opt <= 1.0
+        groups = sec["hlo_input"]["gradient_groups"]
+        assert all(0 <= g["compute_after_frac"] <= 1 for g in groups)
+    # The async evidence must be non-trivial: every FSDP collective pair
+    # overlaps compute.
+    ap = d["fsdp_llama300m_async_evidence"]["async_pairs"]
+    assert ap["count"] > 0
+    assert ap["with_compute_in_flight"] == ap["count"]
