@@ -9,10 +9,13 @@ tuning step scores the current configuration over a sample window, then
 moves to the acquisition argmax (random-candidate search instead of L-BFGS —
 two smooth dimensions need no quasi-Newton machinery).
 
-Tuned knobs (the eager tier's two continuous parameters, as in the
-reference's joint-Bayesian group, ``parameter_manager.h:35-43``):
+Tuned knobs (the reference's full set, ``parameter_manager.h:35-85``):
   * fusion threshold, log2-bytes in [20, 28]  (1 MiB .. 256 MiB)
   * cycle time, ms in [1, 25]
+  * hierarchical allreduce / hierarchical allgather / cache enabled —
+    categorical, coordinate-descent (CategoricalParameter analogue)
+Each knob honors a ``fixed=`` override when the user's env supplies an
+explicit value (reference ``operations.cc:1005-1049``).
 
 Enabled by ``HOROVOD_AUTOTUNE``; per-step CSV via ``HOROVOD_AUTOTUNE_LOG``
 (reference ``operations.cc:1074-1078``). The coordinator tunes and the new
@@ -114,49 +117,125 @@ class BayesianOptimizer:
         return lo + pick * (hi - lo)
 
 
+# The reference's full categorical knob set (parameter_manager.h:66-85):
+# hierarchical allreduce, hierarchical allgather, response-cache enable.
+CATEGORICAL_KNOBS = ("hierarchical_allreduce", "hierarchical_allgather",
+                     "cache_enabled")
+# Continuous knobs, for ``fixed=`` spelling.
+CONTINUOUS_KNOBS = ("fusion_threshold", "cycle_time")
+
+
 class ParameterManager:
     """Scores the live configuration by observed throughput and proposes the
     next one (reference ``parameter_manager.cc:155-222`` Update/Tune).
 
-    Besides the joint-Bayesian continuous pair, optionally tunes
-    hierarchical allreduce on/off — the reference's categorical dimension
-    (``parameter_manager.h:35-43`` CategoricalParameterChain): each category
-    is explored for a few BO steps over two sweeps, then the better one is
-    locked in while the continuous search continues."""
+    Joint parameter set at reference parity (``parameter_manager.h:35-85``):
+    the continuous (fusion threshold, cycle time) pair under Bayesian
+    optimization, plus the categorical knobs {hierarchical allreduce,
+    hierarchical allgather, cache enabled} explored by coordinate descent —
+    each unfixed knob is visited in turn, both values held for a few BO
+    steps, the better locked in, over ``CATEGORY_SWEEPS`` passes.
+
+    ``fixed`` mirrors the reference's per-knob ``fixed=`` override
+    (``SetTensorFusionThresholdBytes(v, true)`` etc., set when the user's
+    env provides an explicit value, ``operations.cc:1005-1049``): a fixed
+    knob keeps its initial value and is excluded from the search.
+    """
 
     WARMUP_SAMPLES = 3      # discarded after every parameter change
     SAMPLES_PER_STEP = 10   # scored cycles per configuration
-    CATEGORY_STEPS = 3      # BO steps per category visit
-    CATEGORY_SWEEPS = 2     # full passes over both categories
+    CATEGORY_STEPS = 3      # BO steps per categorical value visit
+    CATEGORY_SWEEPS = 2     # full passes over the categorical knobs
 
     def __init__(self, fusion_threshold: int, cycle_time_ms: float,
                  log_path: Optional[str] = None, seed: int = 0,
+                 categoricals: Optional[dict] = None,
+                 fixed=frozenset(),
                  tune_hierarchical: bool = False,
                  hierarchical: bool = False):
+        # Legacy spelling (round-3 callers/tests): hierarchical allreduce
+        # only, tuned iff tune_hierarchical.
+        if categoricals is None:
+            categoricals = {"hierarchical_allreduce": hierarchical}
+            if not tune_hierarchical:
+                fixed = set(fixed) | {"hierarchical_allreduce"}
+        self.fixed = frozenset(fixed)
         # (log2 fusion bytes, cycle ms)
         self._bo = BayesianOptimizer([(20.0, 28.0), (1.0, 25.0)], seed=seed)
+        # Exact pinned values for fixed knobs: a log2/2** round trip would
+        # drift a non-power-of-two user threshold.
+        self._initial_threshold = int(fusion_threshold)
+        self._initial_cycle_ms = float(cycle_time_ms)
         self.fusion_threshold = int(fusion_threshold)
         self.cycle_time_ms = float(cycle_time_ms)
-        self.hierarchical = bool(hierarchical)
+        self.categoricals = {k: bool(v) for k, v in categoricals.items()}
         self._warmup_left = self.WARMUP_SAMPLES
         self._bytes = 0
         self._seconds = 0.0
         self._samples = 0
         self._log_path = log_path
+        self._log_header_due = log_path is not None
         self._best_score = -np.inf
         self.best_fusion_threshold = self.fusion_threshold
         self.best_cycle_time_ms = self.cycle_time_ms
-        self._cat_fixed = not tune_hierarchical
-        self._cat_scores = {False: -np.inf, True: -np.inf}
+        self.best_categoricals = dict(self.categoricals)
+        # Coordinate-descent plan over the unfixed categoricals: per knob,
+        # hold the initial value CATEGORY_STEPS BO steps, then the flipped
+        # value, lock the better, move on; CATEGORY_SWEEPS full passes.
+        self._cat_order = [k for k in self.categoricals
+                           if k not in self.fixed]
+        self._cat_pos = 0            # knob index within the sweep
+        self._cat_sweep = 0
+        self._cat_phase = 0          # 0 = initial value, 1 = flipped
         self._cat_steps = 0
-        self._cat_visits = 0
+        self._cat_phase_scores = [-np.inf, -np.inf]
+        self._cats_converged = not self._cat_order
+
+    @property
+    def tunable(self) -> bool:
+        """False when every knob is fixed — record() short-circuits, so a
+        fully-pinned job never pays the per-step GP Cholesky for values
+        it would discard anyway."""
+        return bool(self._cat_order) or not (
+            {"fusion_threshold", "cycle_time"} <= self.fixed)
+
+    @property
+    def hierarchical(self) -> bool:  # legacy accessor
+        return self.categoricals.get("hierarchical_allreduce", False)
+
+    def _advance_categoricals(self, score: float) -> None:
+        if self._cats_converged:
+            return
+        knob = self._cat_order[self._cat_pos]
+        self._cat_phase_scores[self._cat_phase] = max(
+            self._cat_phase_scores[self._cat_phase], score)
+        self._cat_steps += 1
+        if self._cat_steps < self.CATEGORY_STEPS:
+            return
+        self._cat_steps = 0
+        if self._cat_phase == 0:
+            self._cat_phase = 1
+            self.categoricals[knob] = not self.categoricals[knob]
+            return
+        # Both values visited: lock the better and move to the next knob.
+        keep_flipped = self._cat_phase_scores[1] > self._cat_phase_scores[0]
+        if not keep_flipped:
+            self.categoricals[knob] = not self.categoricals[knob]
+        self._cat_phase = 0
+        self._cat_phase_scores = [-np.inf, -np.inf]
+        self._cat_pos += 1
+        if self._cat_pos >= len(self._cat_order):
+            self._cat_pos = 0
+            self._cat_sweep += 1
+            if self._cat_sweep >= self.CATEGORY_SWEEPS:
+                self._cats_converged = True
 
     def record(self, nbytes: int,
-               seconds: float) -> Optional[Tuple[int, float, bool]]:
+               seconds: float) -> Optional[Tuple[int, float, dict]]:
         """Feed one cycle's totals; returns new (fusion_threshold, cycle_ms,
-        hierarchical) when the manager moves to a new configuration, else
+        categoricals) when the manager moves to a new configuration, else
         None."""
-        if nbytes <= 0 or seconds <= 0:
+        if nbytes <= 0 or seconds <= 0 or not self.tunable:
             return None
         if self._warmup_left > 0:
             self._warmup_left -= 1
@@ -174,31 +253,35 @@ class ParameterManager:
             self._best_score = score
             self.best_fusion_threshold = self.fusion_threshold
             self.best_cycle_time_ms = self.cycle_time_ms
-        self._cat_scores[self.hierarchical] = max(
-            self._cat_scores[self.hierarchical], score)
+            self.best_categoricals = dict(self.categoricals)
         if self._log_path:
+            cat_items = sorted(self.categoricals.items())
             with open(self._log_path, "a") as f:
+                if self._log_header_due:
+                    # Self-describing: the column set varies with the
+                    # categorical knobs, so name them.
+                    f.write("time,fusion_threshold,cycle_time_ms,"
+                            + ",".join(k for k, _ in cat_items)
+                            + ",score_bytes_per_sec\n")
+                    self._log_header_due = False
+                cats = ",".join(str(int(v)) for _, v in cat_items)
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
-                        f"{self.cycle_time_ms:.3f},"
-                        f"{int(self.hierarchical)},{score:.1f}\n")
+                        f"{self.cycle_time_ms:.3f},{cats},{score:.1f}\n")
 
-        if not self._cat_fixed:
-            self._cat_steps += 1
-            if self._cat_steps >= self.CATEGORY_STEPS:
-                self._cat_steps = 0
-                self._cat_visits += 1
-                if self._cat_visits >= 2 * self.CATEGORY_SWEEPS:
-                    self._cat_fixed = True
-                    self.hierarchical = bool(
-                        self._cat_scores[True] > self._cat_scores[False])
-                else:
-                    self.hierarchical = not self.hierarchical
+        self._advance_categoricals(score)
 
         nxt = self._bo.suggest()
-        self.fusion_threshold = int(2 ** nxt[0])
-        self.cycle_time_ms = float(nxt[1])
+        # fixed= continuous knobs keep their EXACT initial value (reference
+        # TunableParameter::SetValue(value, fixed=true) semantics).
+        self.fusion_threshold = (
+            self._initial_threshold if "fusion_threshold" in self.fixed
+            else int(2 ** nxt[0]))
+        self.cycle_time_ms = (
+            self._initial_cycle_ms if "cycle_time" in self.fixed
+            else float(nxt[1]))
         self._bytes = 0
         self._seconds = 0.0
         self._samples = 0
         self._warmup_left = self.WARMUP_SAMPLES
-        return self.fusion_threshold, self.cycle_time_ms, self.hierarchical
+        return (self.fusion_threshold, self.cycle_time_ms,
+                dict(self.categoricals))

@@ -1,18 +1,50 @@
 """Controller-side autotuner construction (kept separate so the controller
-module stays importable without numpy-linalg-heavy paths on the hot import)."""
+module stays importable without numpy-linalg-heavy paths on the hot import).
+
+Mirrors the reference's fixed-knob wiring (``operations.cc:1005-1049``):
+every knob the user's environment sets explicitly is pinned
+(``SetX(value, fixed=true)``); only the rest are tuned.
+"""
 
 from __future__ import annotations
+
+import os
 
 from ..common.autotune import ParameterManager
 from ..common.config import Config
 
+# knob name -> env var whose presence fixes it (reference env surface).
+_FIXING_ENV = {
+    "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
+    "cycle_time": "HOROVOD_CYCLE_TIME",
+    "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
+    "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
+    "cache_enabled": "HOROVOD_CACHE_CAPACITY",
+}
+
 
 def make_parameter_manager(config: Config,
-                           tune_hierarchical: bool = False) -> ParameterManager:
+                           tune_hierarchical: bool = False,
+                           tune_cache: bool = False) -> ParameterManager:
+    fixed = {knob for knob, env in _FIXING_ENV.items() if env in os.environ}
+    if not tune_hierarchical:
+        # No two-level rings in this job: the hierarchical knobs have no
+        # data plane to switch to — pin them at their config values (the
+        # data-plane gate re-checks ring availability independently).
+        fixed |= {"hierarchical_allreduce", "hierarchical_allgather"}
+    if not tune_cache:
+        # The native C++ engine owns its own response cache and exposes no
+        # runtime toggle — exploring a knob the engine ignores would only
+        # pollute the scores.
+        fixed |= {"cache_enabled"}
     return ParameterManager(
         fusion_threshold=config.fusion_threshold_bytes,
         cycle_time_ms=config.cycle_time_ms,
         log_path=config.autotune_log,
-        tune_hierarchical=tune_hierarchical,
-        hierarchical=config.hierarchical_allreduce,
+        categoricals={
+            "hierarchical_allreduce": config.hierarchical_allreduce,
+            "hierarchical_allgather": config.hierarchical_allgather,
+            "cache_enabled": config.cache_capacity > 0,
+        },
+        fixed=fixed,
     )

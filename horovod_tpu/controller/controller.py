@@ -127,11 +127,13 @@ class Controller:
         # exported per-group ring addresses.
         self._local_ring = None
         self._cross_ring = None
-        # Live copy of the hierarchical-allreduce knob: the autotuner may
-        # flip it at runtime (reference categorical tuning); the change is
-        # applied on every rank via the synced cycle reply only, so the
-        # per-response path choice never diverges.
+        # Live copies of the categorical knobs: the autotuner may flip them
+        # at runtime (reference categorical tuning, parameter_manager.h:
+        # 66-85); changes are applied on every rank via the synced cycle
+        # reply only, so the per-response path choice never diverges.
         self._hier_allreduce = config.hierarchical_allreduce
+        self._hier_allgather = config.hierarchical_allgather
+        self._cache_enabled = config.cache_capacity > 0
         if ((config.hierarchical_allreduce or config.hierarchical_allgather
              or config.autotune)
                 and topology.local_size > 1 and topology.cross_size > 1
@@ -159,7 +161,8 @@ class Controller:
             from .autotune_glue import make_parameter_manager
 
             self._param_manager = make_parameter_manager(
-                config, tune_hierarchical=self._local_ring is not None)
+                config, tune_hierarchical=self._local_ring is not None,
+                tune_cache=True)
 
         addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
         if topology.rank == 0:
@@ -377,13 +380,19 @@ class Controller:
             uncached: List[Request] = []
             for name in names:
                 entry = self._table[name]
-                bit = self._cache.lookup(entry.request)
+                # _cache_enabled is the autotunable categorical (reference
+                # SetCacheEnabled, parameter_manager.h:84-85); flipped only
+                # via the synced reply, so every rank skips or consults the
+                # cache for the same cycles and the bit masks stay aligned.
+                bit = (self._cache.lookup(entry.request)
+                       if self._cache_enabled else None)
                 if bit is not None:
                     self._bit_pending[bit] = name
                     continue
-                stale = self._cache.stale_bit(entry.request)
-                if stale is not None:
-                    invalid_mask |= 1 << stale
+                if self._cache_enabled:
+                    stale = self._cache.stale_bit(entry.request)
+                    if stale is not None:
+                        invalid_mask |= 1 << stale
                 uncached.append(entry.request)
             for bit in self._bit_pending:
                 cache_mask |= 1 << bit
@@ -550,7 +559,15 @@ class Controller:
         if tune is not None:
             self._fusion_threshold, self._cycle_time_ms = tune[:2]
             if len(tune) > 2:
-                self._hier_allreduce = bool(tune[2])
+                cats = tune[2]
+                self._hier_allreduce = bool(
+                    cats.get("hierarchical_allreduce",
+                             self._hier_allreduce))
+                self._hier_allgather = bool(
+                    cats.get("hierarchical_allgather",
+                             self._hier_allgather))
+                self._cache_enabled = bool(
+                    cats.get("cache_enabled", self._cache_enabled))
         executed_bytes = 0
         for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
             name = None
@@ -574,7 +591,8 @@ class Controller:
 
         rlist: ResponseList = reply["responses"]
         for response in rlist.responses:
-            executed_bytes += self._execute(response, cache_put=True)
+            executed_bytes += self._execute(
+                response, cache_put=self._cache_enabled)
 
         if rlist.shutdown or self._shutdown_requested:
             self._fail_all(ShutdownError("Horovod has been shut down"))
@@ -704,7 +722,7 @@ class Controller:
     def _execute_allgather(self, entry: _Pending, response: Response) -> None:
         dtype = entry.array.dtype
         rest = entry.array.shape[1:]
-        if self._use_hierarchical(dtype, self.cfg.hierarchical_allgather):
+        if self._use_hierarchical(dtype, self._hier_allgather):
             # Two-level: gather inside the node, local roots exchange node
             # blobs over the cross ring, fan the full result back out
             # (MPIHierarchicalAllgather shape, mpi_operations.cc:179-329;

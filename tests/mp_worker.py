@@ -308,6 +308,15 @@ def scenario_autotune(rank, size):
         out = np.asarray(hvd.allreduce(x, average=False, name=f"at.{it}"))
         want = np.ones(256) * (size * it + sum(range(size)))
         np.testing.assert_allclose(out, want, rtol=1e-6)
+    # Repeated name: the response cache serves bypass hits while the
+    # autotuner may flip cache_enabled mid-run (reference SetCacheEnabled
+    # categorical) — hits, misses, and the toggle must all stay correct
+    # and rank-synchronized.
+    for it in range(40):
+        x = np.ones(128, np.float32) * (rank + 2 * it)
+        out = np.asarray(hvd.allreduce(x, average=False, name="at.cached"))
+        want = np.ones(128) * (2 * size * it + sum(range(size)))
+        np.testing.assert_allclose(out, want, rtol=1e-6)
 
 
 def scenario_peer_death(rank, size):

@@ -43,8 +43,9 @@ def test_parameter_manager_cycles():
         out = pm.record(nbytes=1 << 20, seconds=0.005)
         if out is not None:
             changed += 1
-            thr, cyc, hier = out
-            assert hier is False  # tune_hierarchical off by default
+            thr, cyc, cats = out
+            # legacy spelling: hierarchical pinned off by default
+            assert cats == {"hierarchical_allreduce": False}
             assert (1 << 20) <= thr <= (1 << 28)
             assert 1.0 <= cyc <= 25.0
     assert changed >= 5  # warmup 3 + 10 samples per step
@@ -52,18 +53,98 @@ def test_parameter_manager_cycles():
 
 
 def test_parameter_manager_categorical_hierarchical():
-    # With tune_hierarchical on, the manager explores both categories over
-    # two sweeps, then locks in one (reference CategoricalParameter
-    # semantics, parameter_manager.h:35-43).
+    # Legacy spelling: with tune_hierarchical on, the manager explores both
+    # values over the sweeps, then locks one (reference
+    # CategoricalParameter semantics, parameter_manager.h:35-43).
     pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
                           seed=4, tune_hierarchical=True, hierarchical=False)
     seen = set()
     for _ in range(400):
         out = pm.record(nbytes=1 << 20, seconds=0.005)
         if out is not None:
-            seen.add(out[2])
+            seen.add(out[2]["hierarchical_allreduce"])
     assert seen == {False, True}  # both categories explored
-    assert pm._cat_fixed  # and a winner locked in
+    assert pm._cats_converged  # and a winner locked in
+
+
+def test_parameter_manager_joint_categoricals_converge_to_known_optimum():
+    """Full reference knob set (parameter_manager.h:66-85): synthetic
+    workload whose optimum is known by construction — hier allreduce ON
+    is 2x faster, hier allgather OFF is 1.5x faster, cache ON is 1.2x
+    faster. The coordinate-descent search must lock in exactly that
+    combination."""
+    pm = ParameterManager(
+        fusion_threshold=64 << 20, cycle_time_ms=5.0, seed=7,
+        categoricals={"hierarchical_allreduce": False,
+                      "hierarchical_allgather": True,
+                      "cache_enabled": False})
+
+    def seconds_for(cats):
+        s = 0.004
+        if not cats["hierarchical_allreduce"]:
+            s *= 2.0
+        if cats["hierarchical_allgather"]:
+            s *= 1.5
+        if not cats["cache_enabled"]:
+            s *= 1.2
+        return s
+
+    for _ in range(2000):
+        pm.record(nbytes=1 << 20, seconds=seconds_for(pm.categoricals))
+        if pm._cats_converged:
+            break
+    assert pm._cats_converged
+    assert pm.categoricals == {"hierarchical_allreduce": True,
+                               "hierarchical_allgather": False,
+                               "cache_enabled": True}
+
+
+def test_parameter_manager_fixed_overrides():
+    """Per-knob fixed= (reference SetX(value, fixed=true),
+    operations.cc:1005-1049): fixed knobs never move — continuous or
+    categorical — while the rest still tune."""
+    pm = ParameterManager(
+        fusion_threshold=32 << 20, cycle_time_ms=7.5, seed=5,
+        categoricals={"hierarchical_allreduce": True,
+                      "hierarchical_allgather": False,
+                      "cache_enabled": True},
+        fixed={"fusion_threshold", "hierarchical_allreduce",
+               "cache_enabled"})
+    assert pm._cat_order == ["hierarchical_allgather"]
+    cycles_seen = set()
+    for _ in range(600):
+        out = pm.record(nbytes=1 << 20, seconds=0.005)
+        if out is not None:
+            thr, cyc, cats = out
+            assert thr == 32 << 20                       # fixed continuous
+            assert cats["hierarchical_allreduce"] is True   # fixed cats
+            assert cats["cache_enabled"] is True
+            cycles_seen.add(round(cyc, 3))
+    assert len(cycles_seen) > 3  # the unfixed knob really is tuned
+
+
+def test_make_parameter_manager_env_fixes_knobs(monkeypatch):
+    """Env-provided values pin their knobs, mirroring the reference's
+    operations.cc:1005-1049 wiring."""
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.controller.autotune_glue import make_parameter_manager
+
+    monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", str(16 << 20))
+    monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "1")
+    monkeypatch.delenv("HOROVOD_CYCLE_TIME", raising=False)
+    monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
+    monkeypatch.delenv("HOROVOD_CACHE_CAPACITY", raising=False)
+    pm = make_parameter_manager(Config.from_env(), tune_hierarchical=True,
+                                tune_cache=True)
+    assert "fusion_threshold" in pm.fixed
+    assert "hierarchical_allgather" in pm.fixed
+    assert "cycle_time" not in pm.fixed
+    assert "hierarchical_allreduce" not in pm.fixed
+    assert "cache_enabled" not in pm.fixed
+    # Without two-level rings / cache application, those knobs pin off.
+    pm2 = make_parameter_manager(Config.from_env())
+    assert {"hierarchical_allreduce", "hierarchical_allgather",
+            "cache_enabled"} <= pm2.fixed
 
 
 def test_parameter_manager_log(tmp_path):
@@ -73,5 +154,32 @@ def test_parameter_manager_log(tmp_path):
     for _ in range(40):
         pm.record(nbytes=1 << 20, seconds=0.004)
     content = log.read_text().strip().splitlines()
-    assert len(content) >= 1
-    assert len(content[0].split(",")) == 5
+    assert len(content) >= 2
+    # Self-describing header: column count tracks the categorical set.
+    assert content[0].split(",")[:3] == ["time", "fusion_threshold",
+                                         "cycle_time_ms"]
+    assert content[0].split(",")[-1] == "score_bytes_per_sec"
+    assert len(content[1].split(",")) == len(content[0].split(","))
+
+
+def test_parameter_manager_fixed_keeps_exact_values():
+    """A pinned non-power-of-two threshold must not drift through the
+    log2/2** round trip, and an all-fixed manager must short-circuit
+    (no GP work, no parameter changes)."""
+    pm = ParameterManager(
+        fusion_threshold=10_000_000, cycle_time_ms=7.0, seed=9,
+        categoricals={"cache_enabled": True},
+        fixed={"fusion_threshold", "cache_enabled"})
+    for _ in range(60):
+        out = pm.record(nbytes=1 << 20, seconds=0.005)
+        if out is not None:
+            assert out[0] == 10_000_000
+
+    pinned = ParameterManager(
+        fusion_threshold=10_000_000, cycle_time_ms=7.0, seed=9,
+        categoricals={"cache_enabled": True},
+        fixed={"fusion_threshold", "cycle_time", "cache_enabled"})
+    assert not pinned.tunable
+    for _ in range(60):
+        assert pinned.record(nbytes=1 << 20, seconds=0.005) is None
+    assert pinned._bo._x == []  # no GP samples accumulated
