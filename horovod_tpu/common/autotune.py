@@ -193,10 +193,11 @@ class ParameterManager:
 
     @property
     def tunable(self) -> bool:
-        """False when every knob is fixed — record() short-circuits, so a
-        fully-pinned job never pays the per-step GP Cholesky for values
-        it would discard anyway."""
-        return bool(self._cat_order) or not (
+        """False when every knob is pinned or settled — record()
+        short-circuits, so a fully-pinned (or fully-converged) job never
+        pays the per-step GP Cholesky for values it would discard."""
+        cats_active = bool(self._cat_order) and not self._cats_converged
+        return cats_active or not (
             {"fusion_threshold", "cycle_time"} <= self.fixed)
 
     @property
@@ -259,10 +260,12 @@ class ParameterManager:
             with open(self._log_path, "a") as f:
                 if self._log_header_due:
                     # Self-describing: the column set varies with the
-                    # categorical knobs, so name them.
-                    f.write("time,fusion_threshold,cycle_time_ms,"
-                            + ",".join(k for k, _ in cat_items)
-                            + ",score_bytes_per_sec\n")
+                    # categorical knobs, so name them — but only at the
+                    # top of a fresh file (restarts append data rows).
+                    if f.tell() == 0:
+                        f.write("time,fusion_threshold,cycle_time_ms,"
+                                + ",".join(k for k, _ in cat_items)
+                                + ",score_bytes_per_sec\n")
                     self._log_header_due = False
                 cats = ",".join(str(int(v)) for _, v in cat_items)
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"

@@ -556,6 +556,7 @@ class Controller:
 
     def _process_reply(self, reply: dict) -> int:
         tune = reply.get("tune")
+        cache_turned_off = False
         if tune is not None:
             self._fusion_threshold, self._cycle_time_ms = tune[:2]
             if len(tune) > 2:
@@ -566,8 +567,10 @@ class Controller:
                 self._hier_allgather = bool(
                     cats.get("hierarchical_allgather",
                              self._hier_allgather))
-                self._cache_enabled = bool(
+                new_cache = bool(
                     cats.get("cache_enabled", self._cache_enabled))
+                cache_turned_off = self._cache_enabled and not new_cache
+                self._cache_enabled = new_cache
         executed_bytes = 0
         for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
             name = None
@@ -588,6 +591,15 @@ class Controller:
                 response_type=response.response_type,
                 tensor_names=[name],
                 tensor_sizes=list(response.tensor_sizes)), cache_put=False)
+
+        if cache_turned_off:
+            # Cache-hit tensors still parked on a bit (peer ranks hadn't
+            # all enqueued them, so no bypass arrived in this reply) would
+            # strand forever now that ticks stop advertising bits:
+            # renegotiate them as ordinary requests.
+            with self._lock:
+                self._queue.extend(self._bit_pending.values())
+                self._bit_pending.clear()
 
         rlist: ResponseList = reply["responses"]
         for response in rlist.responses:
