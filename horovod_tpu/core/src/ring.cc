@@ -102,6 +102,7 @@ float f16_to_f32(uint16_t h) {
       bits = sign | (exp << 23) | (mant << 13);
     }
   } else if (exp == 31) {
+    if (mant) mant |= 0x200;  // quiet the NaN, like VCVTPH2PS
     bits = sign | 0x7f800000 | (mant << 13);
   } else {
     bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
@@ -112,19 +113,37 @@ float f16_to_f32(uint16_t h) {
 }
 
 uint16_t f32_to_f16(float f) {
+  // Round-to-nearest-even, matching F16C's _mm256_cvtps_ph: the scalar
+  // tail and the vector body must produce byte-identical results.
   uint32_t bits;
   std::memcpy(&bits, &f, 4);
   uint16_t sign = (uint16_t)((bits >> 16) & 0x8000);
   int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
   uint32_t mant = bits & 0x7fffff;
-  if (exp >= 31) return sign | 0x7c00;  // overflow -> inf
+  if (exp >= 31) {
+    if (((bits >> 23) & 0xff) == 0xff && mant)
+      // NaN: quiet bit + truncated payload, exactly VCVTPS2PH's result
+      // (an exp>=31 finite or inf still becomes inf below).
+      return sign | 0x7e00 | (uint16_t)(mant >> 13);
+    return sign | 0x7c00;  // overflow -> inf
+  }
   if (exp <= 0) {
     if (exp < -10) return sign;
     mant |= 0x800000;
     uint32_t shift = (uint32_t)(14 - exp);
-    return sign | (uint16_t)(mant >> shift);
+    uint32_t half = mant >> shift;
+    uint32_t dropped_mask = (1u << shift) - 1;
+    uint32_t dropped = mant & dropped_mask;
+    uint32_t halfway = 1u << (shift - 1);
+    if (dropped > halfway || (dropped == halfway && (half & 1)))
+      half++;  // RNE on the subnormal shift
+    return sign | (uint16_t)half;
   }
-  return sign | (uint16_t)(exp << 10) | (uint16_t)(mant >> 13);
+  uint32_t half = (uint32_t)(exp << 10) | (mant >> 13);
+  uint32_t dropped = mant & 0x1fff;
+  if (dropped > 0x1000 || (dropped == 0x1000 && (half & 1)))
+    half++;  // RNE; mantissa carry correctly bumps the exponent
+  return sign | (uint16_t)half;
 }
 
 float bf16_to_f32(uint16_t h) {
@@ -140,6 +159,84 @@ uint16_t f32_to_bf16(float f) {
   // round-to-nearest-even on the dropped 16 bits
   uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
   return (uint16_t)((bits + rounding) >> 16);
+}
+
+// --- vectorized half-precision block ops -----------------------------------
+// The reference vectorizes its fp16 sum with F16C/AVX intrinsics behind a
+// runtime CPUID check (common/half.cc:28-78). Here the dispatch is at
+// COMPILE time: bindings.py builds this .so with -march=native and keys the
+// artifact name on the host CPU's flag signature, so __F16C__ being defined
+// means the host has it. Scalar tails use the RNE scalar converters above,
+// which match the intrinsics bit-for-bit.
+
+#if defined(__F16C__)
+#include <immintrin.h>
+#endif
+
+void f16_to_f32_block(const uint16_t* s, float* d, long n) {
+  long i = 0;
+#if defined(__F16C__)
+  for (; i + 8 <= n; i += 8)
+    _mm256_storeu_ps(
+        d + i, _mm256_cvtph_ps(_mm_loadu_si128((const __m128i*)(s + i))));
+#endif
+  for (; i < n; i++) d[i] = f16_to_f32(s[i]);
+}
+
+void f32_to_f16_block(const float* s, uint16_t* d, long n) {
+  long i = 0;
+#if defined(__F16C__)
+  for (; i + 8 <= n; i += 8)
+    _mm_storeu_si128(
+        (__m128i*)(d + i),
+        _mm256_cvtps_ph(_mm256_loadu_ps(s + i), _MM_FROUND_TO_NEAREST_INT));
+#endif
+  for (; i < n; i++) d[i] = f32_to_f16(s[i]);
+}
+
+void bf16_to_f32_block(const uint16_t* s, float* d, long n) {
+  // Plain shift loop: -O3 autovectorizes (widen u16 -> u32, shl, bitcast).
+  for (long i = 0; i < n; i++) {
+    uint32_t bits = (uint32_t)s[i] << 16;
+    std::memcpy(&d[i], &bits, 4);
+  }
+}
+
+void f32_to_bf16_block(const float* s, uint16_t* d, long n) {
+  // Branchless RNE loop, autovectorizable.
+  for (long i = 0; i < n; i++) {
+    uint32_t bits;
+    std::memcpy(&bits, &s[i], 4);
+    uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+    d[i] = (uint16_t)((bits + rounding) >> 16);
+  }
+}
+
+// One cache-friendly block of converted operands per iteration: big enough
+// to amortize loop overhead, small enough that 3 x 512 floats stay in L1.
+// (bf16 stays on its fused single-pass loop — see accumulate DT_BF16 —
+// so only f16 takes the blocked form.)
+constexpr long kHalfBlock = 512;
+
+void accumulate_f16(uint16_t* d, const uint16_t* s, long count) {
+  float a[kHalfBlock], b[kHalfBlock];
+  for (long off = 0; off < count; off += kHalfBlock) {
+    long n = count - off < kHalfBlock ? count - off : kHalfBlock;
+    f16_to_f32_block(d + off, a, n);
+    f16_to_f32_block(s + off, b, n);
+    for (long i = 0; i < n; i++) a[i] += b[i];
+    f32_to_f16_block(a, d + off, n);
+  }
+}
+
+void scale_f16(uint16_t* d, long count, float factor) {
+  float a[kHalfBlock];
+  for (long off = 0; off < count; off += kHalfBlock) {
+    long n = count - off < kHalfBlock ? count - off : kHalfBlock;
+    f16_to_f32_block(d + off, a, n);
+    for (long i = 0; i < n; i++) a[i] *= factor;
+    f32_to_f16_block(a, d + off, n);
+  }
 }
 
 void accumulate(void* dst, const void* src, long count, int dt) {
@@ -198,14 +295,13 @@ void accumulate(void* dst, const void* src, long count, int dt) {
       for (long i = 0; i < count; i++) d[i] = (uint8_t)(d[i] || s[i]);
       break;
     }
-    case DT_F16: {
-      uint16_t* d = (uint16_t*)dst;
-      const uint16_t* s = (const uint16_t*)src;
-      for (long i = 0; i < count; i++)
-        d[i] = f32_to_f16(f16_to_f32(d[i]) + f16_to_f32(s[i]));
+    case DT_F16:
+      accumulate_f16((uint16_t*)dst, (const uint16_t*)src, count);
       break;
-    }
     case DT_BF16: {
+      // Single fused pass, not the blocked form: the branchless widen/
+      // add/RNE-narrow loop autovectorizes as-is and measured ~3% FASTER
+      // than block-converting through scratch (4.0 vs 3.9 Gelem/s).
       uint16_t* d = (uint16_t*)dst;
       const uint16_t* s = (const uint16_t*)src;
       for (long i = 0; i < count; i++)
@@ -228,13 +324,11 @@ void scale(void* buf, long count, int dt, double factor) {
       break;
     }
     case DT_F16: {
-      uint16_t* d = (uint16_t*)buf;
-      for (long i = 0; i < count; i++)
-        d[i] = f32_to_f16((float)(f16_to_f32(d[i]) * factor));
+      scale_f16((uint16_t*)buf, count, (float)factor);
       break;
     }
     case DT_BF16: {
-      uint16_t* d = (uint16_t*)buf;
+      uint16_t* d = (uint16_t*)buf;  // fused pass (see accumulate DT_BF16)
       for (long i = 0; i < count; i++)
         d[i] = f32_to_bf16((float)(bf16_to_f32(d[i]) * factor));
       break;
@@ -324,10 +418,37 @@ bool recv_all(int fd, void* buf, size_t n) {
   return true;
 }
 
+// Segmented pipelining (round-3 verdict item #3): during a reduce-scatter
+// step, accumulate already-received chunks into the destination while the
+// kernel keeps streaming later bytes into the socket buffers — single
+// thread, but compute and wire genuinely overlap. 256 KiB balances overlap
+// granularity against per-chunk call overhead.
+constexpr size_t kReduceChunkBytes = 256 * 1024;
+
+struct ReduceSink {
+  char* dst;        // segment being reduced into (same layout as rbuf)
+  int dtype;
+  size_t esz;
+  size_t acc_done = 0;  // bytes of rbuf already accumulated
+
+  void drain(const char* rbuf, size_t roff, bool final) {
+    size_t ready = final ? roff : (roff / kReduceChunkBytes)
+                                      * kReduceChunkBytes;
+    // Chunk boundaries stay element-aligned: kReduceChunkBytes is a
+    // multiple of every dtype size (1/2/4/8).
+    if (ready <= acc_done) return;
+    accumulate(dst + acc_done, rbuf + acc_done,
+               (long)((ready - acc_done) / esz), dtype);
+    acc_done = ready;
+  }
+};
+
 // Full-duplex exchange: send `sn` bytes right while receiving `rn` bytes from
 // left. Poll-driven so large segments can't deadlock on filled socket
-// buffers (both neighbors send simultaneously each ring step).
-bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn) {
+// buffers (both neighbors send simultaneously each ring step). When `sink`
+// is given, completed receive chunks are reduced in while the rest streams.
+bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn,
+              ReduceSink* sink = nullptr) {
   size_t soff = 0, roff = 0;
   while (soff < sn || roff < rn) {
     struct pollfd fds[2];
@@ -378,9 +499,11 @@ bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn) {
       if (k > 0) {
         roff += (size_t)k;
         mark_progress();
+        if (sink) sink->drain((const char*)rbuf, roff, false);
       }
     }
   }
+  if (sink) sink->drain((const char*)rbuf, roff, true);
   return true;
 }
 
@@ -565,16 +688,26 @@ int ring_allreduce(Ring& ring, void* buf, long count, int dtype, int average) {
   std::vector<char> tmp((size_t)(base_len + 1) * esz);
 
   // Phase 1: reduce-scatter. After size-1 steps, rank r owns the fully
-  // reduced segment (r+1)%size.
+  // reduced segment (r+1)%size. The ReduceSink accumulates received
+  // chunks while later bytes still stream (pipelined, see exchange());
+  // HOROVOD_RING_PIPELINE=0 restores the unpipelined exchange-then-reduce
+  // sequence (measurement escape hatch, allreduce_bandwidth_r4.json).
+  static const bool pipelined = [] {
+    const char* e = getenv("HOROVOD_RING_PIPELINE");
+    return !(e && e[0] == '0');
+  }();
   for (int step = 0; step < ring.size - 1; step++) {
     long s_send = (ring.rank - step + ring.size) % ring.size;
     long s_recv = (ring.rank - step - 1 + ring.size) % ring.size;
+    ReduceSink sink{base + seg_off(s_recv) * esz, dtype, esz};
     if (!exchange(ring, base + seg_off(s_send) * esz,
                   (size_t)seg_len(s_send) * esz, tmp.data(),
-                  (size_t)seg_len(s_recv) * esz))
+                  (size_t)seg_len(s_recv) * esz,
+                  pipelined ? &sink : nullptr))
       return -1;
-    accumulate(base + seg_off(s_recv) * esz, tmp.data(), seg_len(s_recv),
-               dtype);
+    if (!pipelined)
+      accumulate(base + seg_off(s_recv) * esz, tmp.data(), seg_len(s_recv),
+                 dtype);
   }
   // Phase 2: allgather of reduced segments.
   for (int step = 0; step < ring.size - 1; step++) {
@@ -716,7 +849,35 @@ void hvd_dtype_accumulate(void* dst, const void* src, long count, int dtype) {
   accumulate(dst, src, count, dtype);
 }
 
+// Scalar reference for the half-precision sum: the exact element-at-a-time
+// loop the blocked/F16C path replaced. Kept as a test seam — parity tests
+// assert the vector path is byte-identical, and the bandwidth artifact
+// measures the speedup against it. Other dtypes fall through to the one
+// shared implementation.
+void hvd_dtype_accumulate_scalar(void* dst, const void* src, long count,
+                                 int dtype) {
+  if (dtype == DT_F16) {
+    uint16_t* d = (uint16_t*)dst;
+    const uint16_t* s = (const uint16_t*)src;
+    for (long i = 0; i < count; i++)
+      d[i] = f32_to_f16(f16_to_f32(d[i]) + f16_to_f32(s[i]));
+    return;
+  }
+  if (dtype == DT_BF16) {
+    uint16_t* d = (uint16_t*)dst;
+    const uint16_t* s = (const uint16_t*)src;
+    for (long i = 0; i < count; i++)
+      d[i] = f32_to_bf16(bf16_to_f32(d[i]) + bf16_to_f32(s[i]));
+    return;
+  }
+  accumulate(dst, src, count, dtype);
+}
+
 long hvd_dtype_size(int dtype) { return (long)dtype_size(dtype); }
+
+void hvd_dtype_scale(void* buf, long count, int dtype, double factor) {
+  scale(buf, count, dtype, factor);
+}
 
 // Monotonic timestamp of the last byte any ring in this process moved
 // (0.0 before any traffic). shm.cc's barrier uses it as a liveness signal

@@ -94,6 +94,14 @@ def test_three_ranks_allreduce():
     run_ranks("allreduce", size=3)
 
 
+def test_allreduce_unpipelined_escape_hatch():
+    """HOROVOD_RING_PIPELINE=0 restores exchange-then-reduce (the
+    measurement escape hatch in allreduce_bandwidth_r4.json) — full dtype
+    matrix must stay correct on both code paths."""
+    run_ranks("allreduce", size=3,
+              extra_env={"HOROVOD_RING_PIPELINE": "0"})
+
+
 def test_copybench_inplace_not_slower():
     """Zero-copy micro-bench: the in-place path (0 staging copies) must at
     least match the value path (1 defensive copy) in bytes/sec; before the

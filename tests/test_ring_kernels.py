@@ -1,0 +1,100 @@
+"""Native half-precision reduce kernels (ring.cc): the blocked/F16C path
+must be byte-identical to the scalar reference and to IEEE RNE arithmetic
+(reference half.cc:28-78 vectorizes the same contract)."""
+
+import ctypes
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from horovod_tpu.core import bindings
+
+DT_F32, DT_F16, DT_BF16 = 0, 5, 6
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = bindings.load()
+    if lib is None:
+        pytest.skip("native core unavailable (no toolchain)")
+    return lib
+
+
+def _acc(lib, fn, dst: np.ndarray, src: np.ndarray, code: int):
+    getattr(lib, fn)(
+        dst.ctypes.data_as(ctypes.c_void_p),
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_long(dst.size), ctypes.c_int(code))
+
+
+def _half_operands(dtype, n=4999, seed=0):
+    """Normals, subnormals, +-0, near-overflow, +-inf and NaN — the
+    vector body and scalar tail must agree on ALL of them (the scalar
+    converters quiet NaNs exactly like VCVTPH2PS/VCVTPS2PH)."""
+    rng = np.random.RandomState(seed)
+    vals = np.concatenate([
+        rng.randn(n - 260).astype(np.float32) * rng.choice(
+            [1e-4, 1.0, 100.0], size=n - 260),
+        np.full(50, 0.0, np.float32),
+        np.full(50, -0.0, np.float32),
+        rng.randn(50).astype(np.float32) * 1e-7,   # subnormal range
+        rng.randn(50).astype(np.float32) * 6e4,    # near f16 overflow
+        np.full(20, np.inf, np.float32),
+        np.full(20, -np.inf, np.float32),
+        np.full(20, np.nan, np.float32),
+    ])
+    rng.shuffle(vals)
+    return vals.astype(dtype)
+
+
+@pytest.mark.parametrize("dtype,code", [(np.float16, DT_F16),
+                                        (ml_dtypes.bfloat16, DT_BF16)])
+def test_half_accumulate_vector_scalar_and_ieee_agree(lib, dtype, code):
+    a = _half_operands(dtype, seed=1)
+    b = _half_operands(dtype, seed=2)
+    d_vec = a.copy().view(np.uint16)
+    d_sca = a.copy().view(np.uint16)
+    s = b.view(np.uint16)
+    _acc(lib, "hvd_dtype_accumulate", d_vec, s, code)
+    _acc(lib, "hvd_dtype_accumulate_scalar", d_sca, s, code)
+    # Byte-exact: blocked/F16C vs element-at-a-time scalar — including
+    # inf arithmetic and NaN propagation.
+    np.testing.assert_array_equal(d_vec, d_sca)
+    # And both equal IEEE RNE: add in f32, round once back to the half
+    # type (what numpy/ml_dtypes astype implements). NaN payload bits are
+    # implementation-defined in numpy, so compare NaN-ness there and
+    # exact bytes everywhere else.
+    want = (a.astype(np.float32) + b.astype(np.float32)).astype(dtype)
+    got_f = d_vec.view(dtype).astype(np.float32)
+    want_f = want.astype(np.float32)
+    nan = np.isnan(want_f)
+    np.testing.assert_array_equal(np.isnan(got_f), nan)
+    np.testing.assert_array_equal(d_vec[~nan],
+                                  want.view(np.uint16)[~nan])
+
+
+@pytest.mark.parametrize("dtype,code", [(np.float16, DT_F16),
+                                        (ml_dtypes.bfloat16, DT_BF16)])
+def test_half_scale_matches_ieee(lib, dtype, code):
+    a = _half_operands(dtype, seed=3)
+    buf = a.copy().view(np.uint16)
+    lib.hvd_dtype_scale.restype = None
+    lib.hvd_dtype_scale(
+        buf.ctypes.data_as(ctypes.c_void_p), ctypes.c_long(buf.size),
+        ctypes.c_int(code), ctypes.c_double(0.25))
+    want = (a.astype(np.float32) * np.float32(0.25)).astype(dtype)
+    nan = np.isnan(want.astype(np.float32))
+    np.testing.assert_array_equal(
+        np.isnan(buf.view(dtype).astype(np.float32)), nan)
+    np.testing.assert_array_equal(buf[~nan], want.view(np.uint16)[~nan])
+
+
+def test_f32_unaffected_by_half_blocking(lib):
+    rng = np.random.RandomState(4)
+    a, b = rng.randn(1000).astype(np.float32), rng.randn(1000).astype(
+        np.float32)
+    d = a.copy()
+    _acc(lib, "hvd_dtype_accumulate", d.view(np.uint32), b.view(np.uint32),
+         DT_F32)
+    np.testing.assert_array_equal(d, a + b)
