@@ -30,6 +30,10 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#if defined(__F16C__)
+#include <immintrin.h>
+#endif
+
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -169,10 +173,6 @@ uint16_t f32_to_bf16(float f) {
 // means the host has it. Scalar tails use the RNE scalar converters above,
 // which match the intrinsics bit-for-bit.
 
-#if defined(__F16C__)
-#include <immintrin.h>
-#endif
-
 void f16_to_f32_block(const uint16_t* s, float* d, long n) {
   long i = 0;
 #if defined(__F16C__)
@@ -194,24 +194,6 @@ void f32_to_f16_block(const float* s, uint16_t* d, long n) {
   for (; i < n; i++) d[i] = f32_to_f16(s[i]);
 }
 
-void bf16_to_f32_block(const uint16_t* s, float* d, long n) {
-  // Plain shift loop: -O3 autovectorizes (widen u16 -> u32, shl, bitcast).
-  for (long i = 0; i < n; i++) {
-    uint32_t bits = (uint32_t)s[i] << 16;
-    std::memcpy(&d[i], &bits, 4);
-  }
-}
-
-void f32_to_bf16_block(const float* s, uint16_t* d, long n) {
-  // Branchless RNE loop, autovectorizable.
-  for (long i = 0; i < n; i++) {
-    uint32_t bits;
-    std::memcpy(&bits, &s[i], 4);
-    uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
-    d[i] = (uint16_t)((bits + rounding) >> 16);
-  }
-}
-
 // One cache-friendly block of converted operands per iteration: big enough
 // to amortize loop overhead, small enough that 3 x 512 floats stay in L1.
 // (bf16 stays on its fused single-pass loop — see accumulate DT_BF16 —
@@ -229,12 +211,14 @@ void accumulate_f16(uint16_t* d, const uint16_t* s, long count) {
   }
 }
 
-void scale_f16(uint16_t* d, long count, float factor) {
+void scale_f16(uint16_t* d, long count, double factor) {
+  // Multiply in double like every other dtype's scale path (and like the
+  // pre-vectorization loop): one rounding convention across half types.
   float a[kHalfBlock];
   for (long off = 0; off < count; off += kHalfBlock) {
     long n = count - off < kHalfBlock ? count - off : kHalfBlock;
     f16_to_f32_block(d + off, a, n);
-    for (long i = 0; i < n; i++) a[i] *= factor;
+    for (long i = 0; i < n; i++) a[i] = (float)(a[i] * factor);
     f32_to_f16_block(a, d + off, n);
   }
 }
@@ -324,7 +308,7 @@ void scale(void* buf, long count, int dt, double factor) {
       break;
     }
     case DT_F16: {
-      scale_f16((uint16_t*)buf, count, (float)factor);
+      scale_f16((uint16_t*)buf, count, factor);
       break;
     }
     case DT_BF16: {

@@ -12,6 +12,29 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_resnet50_roofline_artifact_coherent():
+    """The shipped ceiling proof (examples/resnet50_roofline.py) must stay
+    internally coherent: measured time sits between the optimistic
+    max(flops,bytes) bound and the serial sum bound, and the batch matches
+    what bench.py actually runs."""
+    import bench
+
+    d = json.load(open(os.path.join(REPO, "artifacts",
+                                    "resnet50_roofline_r4.json")))
+    r = d["roofline"]
+    assert r["max_bound_ms"] <= r["sum_bound_ms"]
+    assert r["max_bound_ratio"] < 1.0
+    # ceiling claim: within 10% of the serial two-resource bound
+    assert 0.9 <= r["sum_bound_ratio"] <= 1.15, r["sum_bound_ratio"]
+    assert d["batch_per_chip"] == bench.BATCH_PER_CHIP
+    for row in r["top_ops"]:
+        assert row["limiter"] in ("flops", "hbm")
+        assert row["roofline_ratio"] is not None  # top ops all have time
+        assert abs(max(row["t_flops_ms"], row["t_hbm_ms"])
+                   - row["roofline_ratio"] * row["t_measured_ms"]) \
+            < 0.02 * max(row["t_measured_ms"], 0.1)
+
+
 def test_scaling_harness_curve_shape():
     env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
