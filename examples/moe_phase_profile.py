@@ -1,0 +1,169 @@
+"""MoE per-phase time accounting (round-3 verdict item #5).
+
+Traces the MoE-LM training step on the real chip and buckets every
+scheduled op's time into the pipeline phases — router, route/sort,
+dispatch gather, expert matmul, combine, attention, other — by XLA
+provenance. The per-phase table is what decides whether another MFU
+lever exists or the configuration is at its structural ceiling
+(``artifacts/moe_ceiling_r4.json``).
+
+Run: python examples/moe_phase_profile.py --model small --seq-len 1024 --batch-size 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+# Ordered: first hit wins. Keys match the jax name-stack in hlo_stats'
+# tf_op_name (e.g. "jit(step)/transpose(jvp(MoeLM))/layer_3/moe_ffn/
+# vmap()/dot_general:").
+PHASES = (
+    ("attention", ("/attention/", "flash")),
+    ("expert_mm", ("vmap()/dot_general", "vmap(jvp(", "silu")),
+    ("route_sort", ("cumsum", "sort", "one_hot", "argmax", "top_k",
+                    "iota")),
+    ("router", ("softmax", "/moe_ffn/dot_general",
+                "/moe_ffn/convert_element_type")),
+    ("dispatch_combine", ("/moe_ffn/", )),  # residual moe ops: the
+    # gather-only pack/combine permutations and their transposes
+    ("lm_head_embed", ("lm_head", "embed")),
+)
+
+
+def classify(tf_op_name: str) -> str:
+    for phase, keys in PHASES:
+        if any(k in tf_op_name for k in keys):
+            return phase
+    return "other"
+
+
+def capture(model_name: str, seq: int, batch: int, trace_dir: str,
+            steps: int = 5) -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import (MOE_SMALL, MOE_TINY, MoeLM,
+                                    causal_lm_loss)
+    from horovod_tpu.ops.attention import make_attention_fn
+
+    hvd.init()
+    cfg = {"tiny": MOE_TINY, "small": MOE_SMALL}[model_name]
+    # Flash wiring identical to examples/jax_moe_lm_training.py — the
+    # configuration the round-3 throughput rows were measured on.
+    model = MoeLM(cfg, attention_fn=make_attention_fn(causal=True))
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+    tx = optax.adamw(3e-4)
+    state = tx.init(params)
+
+    def loss_fn(p, ids):
+        # Same objective as examples/jax_moe_lm_training.py.
+        logits, col = model.apply({"params": p}, ids,
+                                  mutable=["aux_loss"])
+        aux = sum(jax.tree.leaves(col["aux_loss"]))
+        return causal_lm_loss(logits, ids) + 0.01 * aux
+
+    @jax.jit
+    def step(p, s, ids):
+        loss, g = jax.value_and_grad(loss_fn)(p, ids)
+        u, s = tx.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    for _ in range(3):
+        params, state, loss = step(params, state, ids)
+    float(loss)
+    t0 = time.perf_counter()
+    with hvd.profiler.trace(trace_dir):
+        for _ in range(steps):
+            params, state, loss = step(params, state, ids)
+        float(loss)
+    wall = time.perf_counter() - t0
+    rate = batch * seq * steps / wall
+    print(f"capture s{seq} b{batch}: {rate:.0f} tok/s during trace",
+          file=sys.stderr)
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise RuntimeError(f"no xplane under {trace_dir}")
+    return max(paths, key=os.path.getmtime)  # newest capture wins
+
+
+def phase_table(xplane: str, steps: int = 5, dump: bool = False) -> dict:
+    from tensorflow.python.profiler.internal import \
+        _pywrap_profiler_plugin as pp
+
+    data, _ = pp.xspace_to_tools_data([xplane], "hlo_stats", {})
+    d = json.loads(data)
+    cols = {c["id"]: i for i, c in enumerate(d["cols"])}
+
+    def val(row, col):
+        v = row["c"][cols[col]]["v"]
+        return v if v is not None else ""
+
+    buckets = {}
+    total = 0.0
+    for row in d["rows"]:
+        t_ms = float(val(row, "total_self_time") or 0) / 1e3 / steps
+        if not t_ms:
+            continue
+        op = val(row, "tf_op_name")
+        phase = classify(op)
+        total += t_ms
+        b = buckets.setdefault(phase, {"ms": 0.0, "ops": 0, "top": []})
+        b["ms"] += t_ms
+        b["ops"] += 1
+        b["top"].append((t_ms, val(row, "hlo_op_name"), op[-90:],
+                         val(row, "bound_by")))
+        if dump and t_ms > 0.3:
+            print(f"{phase:16s} {t_ms:6.2f}ms {val(row, 'bound_by'):8s} "
+                  f"{op[:110]}", file=sys.stderr)
+    for b in buckets.values():
+        b["top"] = [
+            {"ms": round(t, 2), "op": n, "prov": p, "bound_by": bb}
+            for t, n, p, bb in sorted(b["top"], reverse=True)[:4]]
+        b["ms"] = round(b["ms"], 2)
+    return {"total_ms_per_step": round(total, 1),
+            "phases": dict(sorted(buckets.items(),
+                                  key=lambda kv: -kv[1]["ms"]))}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="small")
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--xplane", default=None)
+    ap.add_argument("--dump", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    trace_dir = args.trace_dir or (
+        f"/tmp/moe_trace_s{args.seq_len}_b{args.batch_size}")
+    xplane = args.xplane or capture(args.model, args.seq_len,
+                                    args.batch_size, trace_dir)
+    table = phase_table(xplane, dump=args.dump)
+    out = {"model": args.model, "seq_len": args.seq_len,
+           "batch_per_chip": args.batch_size, "xplane": xplane,
+           **table}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps({k: (v if k != "phases" else {
+        p: b["ms"] for p, b in v.items()}) for k, v in out.items()
+        if k != "xplane"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,7 +87,8 @@ def capture_trace(batch: int, trace_dir: str) -> str:
                       recursive=True)
     if not paths:
         raise RuntimeError(f"no xplane under {trace_dir}")
-    return paths[0]
+    # Newest wins: a reused trace dir accumulates timestamped captures.
+    return max(paths, key=os.path.getmtime)
 
 
 def roofline(xplane_path: str) -> dict:
@@ -129,7 +130,9 @@ def roofline(xplane_path: str) -> dict:
             "limiter": "flops" if t_fl > t_mem else "hbm",
         })
     rows.sort(key=lambda r: -r["t_measured_ms"])
-    under = [r for r in rows if (r["roofline_ratio"] or 1) < 0.8]
+    under = [r for r in rows
+             if (r["roofline_ratio"] if r["roofline_ratio"] is not None
+                 else 1.0) < 0.8]
     return {
         "steps_in_window": TRACE_STEPS,
         "measured_ms": round(tot_meas * 1e3, 1),

@@ -17,6 +17,7 @@ def test_resnet50_roofline_artifact_coherent():
     internally coherent: measured time sits between the optimistic
     max(flops,bytes) bound and the serial sum bound, and the batch matches
     what bench.py actually runs."""
+    sys.path.insert(0, REPO)  # bench.py lives at the repo root
     import bench
 
     d = json.load(open(os.path.join(REPO, "artifacts",
@@ -33,6 +34,26 @@ def test_resnet50_roofline_artifact_coherent():
         assert abs(max(row["t_flops_ms"], row["t_hbm_ms"])
                    - row["roofline_ratio"] * row["t_measured_ms"]) \
             < 0.02 * max(row["t_measured_ms"], 0.1)
+
+
+def test_moe_ceiling_artifact_coherent():
+    """Phase tables must be internally coherent: phases sum to the total,
+    the MoE dispatch machinery stays under 10% of the step (the headline
+    claim), and the device totals reproduce the round-3 throughput rows
+    within the measured noise band."""
+    d = json.load(open(os.path.join(REPO, "artifacts",
+                                    "moe_ceiling_r4.json")))
+    for cfg, (tok, r3_tok) in (("s1024_b8", (8 * 1024, 105_200)),
+                               ("s512_b32", (32 * 512, 120_700))):
+        t = dict(d["phase_ms_per_step"][cfg])
+        total = t.pop("total")
+        ssum = sum(v for v in t.values())
+        assert abs(ssum - total) < 0.02 * total, (cfg, ssum, total)
+        moe_overhead = (t["dispatch_combine"] + t["router"]
+                        + t["route_sort"])
+        assert moe_overhead / total < 0.10, (cfg, moe_overhead)
+        tok_s = tok / (total / 1e3)
+        assert abs(tok_s - r3_tok) / r3_tok < 0.12, (cfg, tok_s)
 
 
 def test_scaling_harness_curve_shape():
