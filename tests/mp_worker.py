@@ -317,6 +317,15 @@ def scenario_autotune(rank, size):
         out = np.asarray(hvd.allreduce(x, average=False, name="at.cached"))
         want = np.ones(128) * (2 * size * it + sum(range(size)))
         np.testing.assert_allclose(out, want, rtol=1e-6)
+    # Variable-dim allgathers while the hierarchical-ALLGATHER categorical
+    # may flip mid-run (two-level vs flat gather must agree bit-for-bit).
+    for it in range(12):
+        g = np.full((rank + 1, 2), rank * 10 + it, dtype=np.float32)
+        out = np.asarray(hvd.allgather(g, name=f"at.gather.{it}"))
+        want = np.concatenate(
+            [np.full((r + 1, 2), r * 10 + it, dtype=np.float32)
+             for r in range(size)])
+        np.testing.assert_array_equal(out, want)
 
 
 def scenario_peer_death(rank, size):

@@ -134,13 +134,20 @@ def test_artifact_inputs_pinned():
     path = os.path.join(REPO, "artifacts", "scaling_projection_r4.json")
     d = json.load(open(path))
 
-    from horovod_tpu.models import BERT_BASE, BertEncoder, ResNet50
+    from horovod_tpu.models import (BERT_BASE, VGG16, BertEncoder,
+                                    InceptionV3, ResNet50)
+
+    def cnn_params(cls, size):
+        return jax.eval_shape(
+            lambda: cls(num_classes=1000, dtype=jnp.bfloat16).init(
+                {"params": jax.random.PRNGKey(0),
+                 "dropout": jax.random.PRNGKey(1)},
+                jnp.ones((1, size, size, 3)), train=True))["params"]
 
     model_params = {
-        "resnet50": jax.eval_shape(
-            lambda: ResNet50(num_classes=1000, dtype=jnp.bfloat16).init(
-                jax.random.PRNGKey(0), jnp.ones((1, 224, 224, 3)),
-                train=True))["params"],
+        "resnet50": cnn_params(ResNet50, 224),
+        "inception3": cnn_params(InceptionV3, 299),
+        "vgg16": cnn_params(VGG16, 224),
         "bert_base": jax.eval_shape(
             lambda: BertEncoder(BERT_BASE).init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32),
@@ -174,3 +181,10 @@ def test_artifact_inputs_pinned():
     ap = d["fsdp_llama300m_async_evidence"]["async_pairs"]
     assert ap["count"] > 0
     assert ap["with_compute_in_flight"] == ap["count"]
+    # The reference's published table structure must emerge from measured
+    # inputs: VGG-16 (the parameter-heavy outlier at 68% in the
+    # reference) projects strictly below ResNet-50 and Inception V3.
+    eff = {m: d[m]["projection"]["v5e"]["efficiency_conservative"]["256"]
+           for m in ("resnet50", "inception3", "vgg16")}
+    assert eff["vgg16"] < eff["resnet50"]
+    assert eff["vgg16"] < eff["inception3"]
