@@ -67,13 +67,19 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
 
     def __init__(self, multiplier: Union[float, Callable[[int], float]],
                  start_epoch: int = 0, end_epoch: Optional[int] = None,
-                 staircase: bool = True, steps_per_epoch: Optional[int] = None):
+                 staircase: bool = True, steps_per_epoch: Optional[int] = None,
+                 initial_lr: Optional[float] = None):
         super().__init__()
         self.start_epoch = start_epoch
         self.end_epoch = end_epoch
         self.staircase = staircase
         self.steps_per_epoch = steps_per_epoch
-        self.initial_lr: Optional[float] = None
+        # Explicit initial_lr matters when resuming from a checkpoint: the
+        # restored optimizer already carries a DECAYED rate, so the lazy
+        # first-use capture below would double-apply the multiplier (the
+        # reference's 0.16-era lazy capture, _keras/callbacks.py:119-120,
+        # has the same hazard; upstream later made this an explicit arg).
+        self.initial_lr = initial_lr
         self.current_epoch = 0
         if callable(multiplier):
             self.multiplier = multiplier
@@ -115,7 +121,8 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     ``_keras/callbacks.py:149-168``, the Goyal et al. linear ramp)."""
 
     def __init__(self, warmup_epochs: int = 5, momentum_correction: bool = True,
-                 steps_per_epoch: Optional[int] = None, verbose: int = 0):
+                 steps_per_epoch: Optional[int] = None, verbose: int = 0,
+                 initial_lr: Optional[float] = None):
         del momentum_correction  # Keras-3: no momentum cache to correct
         self.warmup_epochs = warmup_epochs
         self.verbose = verbose
@@ -128,7 +135,8 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
 
         super().__init__(multiplier=multiplier, start_epoch=0,
                          end_epoch=warmup_epochs, staircase=False,
-                         steps_per_epoch=steps_per_epoch)
+                         steps_per_epoch=steps_per_epoch,
+                         initial_lr=initial_lr)
 
     def on_epoch_end(self, epoch, logs=None):
         super().on_epoch_end(epoch, logs)

@@ -5,9 +5,11 @@ layer ``tensorflow/mpi_ops.cc``. The reference targets TF1 graph mode
 (AsyncOpKernels + SessionRunHook); this rebuild targets TF2 eager /
 ``tf.function`` — the op surface is the same (allreduce with the
 IndexedSlices→allgather sparse path, broadcast_variables,
-DistributedOptimizer, DistributedGradientTape), with collectives executed by
-the shared controller through ``tf.py_function`` so they work inside traced
-``tf.function`` graphs. For migrating TF1 session code, the v1 surface is
+DistributedOptimizer, DistributedGradientTape). Collectives take the
+custom-op data path when the native engine is live (real AsyncOpKernel
+graph nodes, ``src/tf_ops.cc`` — reference ``tensorflow/mpi_ops.cc``
+parity), falling back to ``tf.py_function`` through the shared controller
+otherwise (see ``docs/migration.md`` for the boundary). For migrating TF1 session code, the v1 surface is
 kept as a ``tf.compat.v1`` shim: ``broadcast_global_variables`` returns the
 grouped assign op and ``BroadcastGlobalVariablesHook`` is a
 ``SessionRunHook`` (reference ``tensorflow/__init__.py:90-143``); TF2 eager
@@ -47,11 +49,70 @@ def _controller():
     return basics.controller()
 
 
+def _custom_ops():
+    """The native custom-op module when the fast path is live, else None.
+
+    Fast path = real TF graph nodes (AsyncOpKernels in
+    ``src/tf_ops.cc`` enqueueing into the C++ engine): no GIL on the data
+    path, SavedModel-serializable, reference ``tensorflow/mpi_ops.cc``
+    parity. Requires the native engine (the ops attach to the in-process
+    engine the ctypes tier initialized) and an opt-out escape hatch
+    ``HOROVOD_TENSORFLOW_CUSTOM_OP=0``.
+
+    The choice is AGREED ACROSS RANKS (min over local availability via one
+    controller allreduce, memoized on the controller): the custom-op path
+    fixes anonymous names into graphs at trace time while the py_function
+    fallback draws a fresh autoname per execution, so a mixed-path job
+    (one host missing TF headers, or a per-rank opt-out) would diverge the
+    name sequence and stall negotiation."""
+    ctrl = _controller()
+    cached = getattr(ctrl, "_tf_custom_op_agreed", None)
+    if cached is not None:
+        from . import tf_ops
+
+        return tf_ops if cached else None
+
+    import os
+
+    local_ok = True
+    if os.environ.get("HOROVOD_TENSORFLOW_CUSTOM_OP", "1").strip().lower() in (
+            "0", "false", "no", "off"):
+        local_ok = False
+    else:
+        from ..controller.native import NativeController
+
+        if not isinstance(ctrl, NativeController):
+            local_ok = False
+        else:
+            from . import tf_ops
+
+            local_ok = tf_ops.available()
+    agreed = bool(local_ok)
+    if size() > 1:
+        votes = np.asarray(ctrl.allreduce(
+            np.array([1 if local_ok else 0], dtype=np.int32), average=False,
+            name="hvd.tf.custom_op.agree"))
+        agreed = int(votes[0]) == size()
+        if local_ok and not agreed:
+            from ..common import hvd_logging as logging
+
+            logging.warning(
+                "TF custom-op path disabled job-wide: another rank lacks it "
+                "(build failure or HOROVOD_TENSORFLOW_CUSTOM_OP=0)")
+    ctrl._tf_custom_op_agreed = agreed
+    if not agreed:
+        return None
+    from . import tf_ops
+
+    return tf_ops
+
+
 def _np_collective(fn, tensor: tf.Tensor, out_dtype=None) -> tf.Tensor:
     """Run a controller collective on a TF tensor, staying graph-compatible:
     under tf.function the call is embedded as a py_function node (the TF2
     counterpart of the reference's AsyncOpKernel enqueue,
-    tensorflow/mpi_ops.cc:276-303)."""
+    tensorflow/mpi_ops.cc:276-303). Fallback path — the custom-op library
+    (``_custom_ops``) is preferred when available."""
     out_dtype = out_dtype or tensor.dtype
 
     def runner(t):
@@ -81,10 +142,19 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
     if size() == 1:
         return tf.identity(tensor)
     compressed, ctx = compression.compress(tensor)
-    ctrl = _controller()
-    out = _np_collective(
-        lambda a: ctrl.allreduce(a, average=average, name=name),
-        compressed)
+    ops = _custom_ops()
+    if ops is not None:
+        out = ops.allreduce_sum(compressed, name=name)
+        if average and out.dtype != tf.bool:
+            # Graph-level divide (reference tensorflow/__init__.py:36-87);
+            # int dtypes round-trip through the division like the
+            # controller's truncate-cast post-divide.
+            out = tf.cast(out / size(), out.dtype)
+    else:
+        ctrl = _controller()
+        out = _np_collective(
+            lambda a: ctrl.allreduce(a, average=average, name=name),
+            compressed)
     return compression.decompress(out, ctx)
 
 
@@ -135,6 +205,9 @@ def allgather(tensor, name: Optional[str] = None):
     tensor = tf.convert_to_tensor(tensor)
     if size() == 1:
         return tf.identity(tensor)
+    ops = _custom_ops()
+    if ops is not None:
+        return ops.allgather(tensor, name=name)
     ctrl = _controller()
     return _np_collective(lambda a: ctrl.allgather(a, name=name), tensor)
 
@@ -145,6 +218,14 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
         if root_rank != 0:
             raise ValueError(f"root_rank {root_rank} out of range for size 1")
         return tf.identity(tensor)
+    if not 0 <= root_rank < size():
+        # Fail fast on every rank; an out-of-range root passes validation
+        # (all ranks agree on it) and would hang the data phase.
+        raise ValueError(
+            f"root_rank {root_rank} out of range for size {size()}")
+    ops = _custom_ops()
+    if ops is not None:
+        return ops.broadcast(tensor, root_rank=root_rank, name=name)
     ctrl = _controller()
     return _np_collective(
         lambda a: ctrl.broadcast(a, root_rank=root_rank, name=name), tensor)
@@ -244,20 +325,12 @@ class DistributedGradientTape(tf.GradientTape):
         ]
 
 
-def DistributedOptimizer(optimizer, name: Optional[str] = None,
-                         compression=Compression.none,
-                         device_dense: str = "", device_sparse: str = "",
-                         backward_passes_per_step: int = 1):
-    """Wrap a keras optimizer so ``apply_gradients`` first averages the
-    gradients across ranks (reference ``tensorflow/__init__.py:146-244``;
-    the reference overrides ``compute_gradients`` on TF1 optimizers — the
-    Keras-3 equivalent seam is ``apply_gradients``)."""
-    if backward_passes_per_step != 1:
-        raise ValueError(
-            "backward_passes_per_step > 1 is not supported on the TF tier; "
-            "use hvd.torch or hvd.jax for local gradient accumulation")
-
-    base = optimizer.__class__
+def _distributed_optimizer_class(base, compression=Compression.none):
+    """Subclass ``base`` (a keras optimizer class) so ``apply_gradients``
+    first averages the gradients across ranks. Class-level seam shared by
+    :func:`DistributedOptimizer` (wraps an instance) and
+    ``keras.load_model`` (wraps classes for deserialization, reference
+    ``_keras/__init__.py:93-109``)."""
 
     class _Distributed(base):
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
@@ -273,5 +346,22 @@ def DistributedOptimizer(optimizer, name: Optional[str] = None,
             return super().apply_gradients(grads_and_vars, *args, **kwargs)
 
     _Distributed.__name__ = f"Distributed{base.__name__}"
-    dist = _Distributed.from_config(optimizer.get_config())
-    return dist
+    _Distributed._hvd_distributed = True  # keras.load_model double-wrap guard
+    return _Distributed
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         compression=Compression.none,
+                         device_dense: str = "", device_sparse: str = "",
+                         backward_passes_per_step: int = 1):
+    """Wrap a keras optimizer so ``apply_gradients`` first averages the
+    gradients across ranks (reference ``tensorflow/__init__.py:146-244``;
+    the reference overrides ``compute_gradients`` on TF1 optimizers — the
+    Keras-3 equivalent seam is ``apply_gradients``)."""
+    if backward_passes_per_step != 1:
+        raise ValueError(
+            "backward_passes_per_step > 1 is not supported on the TF tier; "
+            "use hvd.torch or hvd.jax for local gradient accumulation")
+
+    cls = _distributed_optimizer_class(optimizer.__class__, compression)
+    return cls.from_config(optimizer.get_config())

@@ -18,6 +18,7 @@ from ...keras import (  # noqa: F401
     cross_size,
     init,
     is_initialized,
+    load_model,
     local_rank,
     local_size,
     mpi_threads_supported,

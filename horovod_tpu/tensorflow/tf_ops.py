@@ -1,0 +1,211 @@
+"""Build + load the TF custom-op binding to the native engine.
+
+Reference: the per-framework shared library the reference builds in
+``setup.py`` and loads via ``tf.load_op_library`` semantics
+(``horovod/tensorflow/mpi_ops.py:33-58`` ``_load_library``), plus the
+gradient registrations for the three ops
+(``horovod/tensorflow/mpi_ops.py:82-171``).
+
+Like the native core (``core/bindings.py``), the library self-builds on
+first use with the toolchain at hand — here against the installed
+TensorFlow's headers (``tf.sysconfig``) — and everything degrades to the
+``tf.py_function`` path when a piece is missing (no g++, no TF headers, or
+the engine is the pure-Python controller)."""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import tensorflow as tf
+
+from ..common import hvd_logging as logging
+from ..core import bindings as core_bindings
+
+_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src",
+                    "tf_ops.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build")
+
+_lock = threading.Lock()
+_module = None
+_load_failed: Optional[str] = None
+_autoname_fallback: dict = {}
+
+
+def _lib_path() -> str:
+    # Key the artifact on the TF version: a TF upgrade changes the ABI and
+    # must produce a fresh .so (the reference rebuilds per framework install
+    # the same way, setup.py probing the live TF).
+    tag = hashlib.sha256(
+        ("tf:" + tf.__version__).encode()).hexdigest()[:12]
+    return os.path.join(_BUILD_DIR, f"libhvdtf-{tag}.so")
+
+
+def build() -> str:
+    """Compile the op library (idempotent, mtime-cached, flock-serialized:
+    N ranks starting at once must not each spend minutes compiling against
+    the TF headers on one core)."""
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    lib_path = _lib_path()
+
+    def fresh() -> bool:
+        return (os.path.exists(lib_path)
+                and os.path.getmtime(lib_path) >= os.path.getmtime(_SRC))
+
+    if fresh():
+        return lib_path
+    lock_path = lib_path + ".lock"
+    with open(lock_path, "w") as lock_file:
+        fcntl.flock(lock_file, fcntl.LOCK_EX)
+        try:
+            if fresh():  # another rank built it while we waited
+                return lib_path
+            flags = tf.sysconfig.get_compile_flags()
+            link_flags = tf.sysconfig.get_link_flags()
+            tmp_path = f"{lib_path}.tmp.{os.getpid()}"
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", *flags,
+                   _SRC, "-o", tmp_path, *link_flags, "-ldl"]
+            logging.debug("building TF op library: %s", " ".join(cmd))
+            try:
+                result = subprocess.run(cmd, capture_output=True, text=True)
+                if result.returncode != 0:
+                    raise RuntimeError(
+                        f"TF op library build failed:\n{result.stderr[-4000:]}")
+                os.replace(tmp_path, lib_path)
+            finally:
+                if os.path.exists(tmp_path):
+                    os.remove(tmp_path)
+        finally:
+            fcntl.flock(lock_file, fcntl.LOCK_UN)
+    return lib_path
+
+
+def load():
+    """Build + ``tf.load_op_library``; returns the op module or ``None``
+    (with the reason logged once) so callers can fall back to py_function."""
+    global _module, _load_failed
+    with _lock:
+        if _module is not None:
+            return _module
+        if _load_failed is not None:
+            return None
+        try:
+            # The op library attaches to the SAME core .so the ctypes tier
+            # drives: build (or reuse) it and export its path for the
+            # kernels' dlopen.
+            core_path = core_bindings.build()
+            os.environ["HOROVOD_TPU_CORE_LIB"] = core_path
+            path = build()
+            _module = tf.load_op_library(path)
+        except (RuntimeError, FileNotFoundError, tf.errors.OpError) as exc:
+            _load_failed = str(exc)
+            logging.warning(
+                "TF custom-op library unavailable (%s); collectives use the "
+                "tf.py_function path", exc)
+            return None
+        return _module
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _names(kind: str, name: Optional[str]) -> str:
+    """Cross-rank-consistent tensor name. Explicit names pass through; for
+    anonymous tensors the native controller's autoname counter is the
+    namespace shared with the ctypes tier, so a custom-op collective can
+    never collide with a pending controller-enqueued one.
+
+    Inside ``tf.function`` this runs at trace time, fixing the name into the
+    graph — the reference's graph-node-name behavior
+    (``tensorflow/mpi_ops.py:66-80``): names repeat across step executions
+    (legal: uniqueness is only required among concurrently-pending ops) and
+    advance on retrace identically on every rank."""
+    if name is not None:
+        return name
+    from ..common import basics
+
+    try:
+        return basics.controller()._autoname(kind, None)
+    except (ValueError, RuntimeError):
+        # No controller (size-1 smoke use, or a SavedModel reloaded before
+        # hvd.init): a local counter keeps names unique within the process.
+        with _lock:
+            n = _autoname_fallback.get(kind, 0)
+            _autoname_fallback[kind] = n + 1
+        return f"{kind}.tfop.{n}"
+
+
+def allreduce_sum(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    ops = load()
+    return ops.horovod_tpu_allreduce(
+        tensor, tensor_name=_names("allreduce", name))
+
+
+def allgather(tensor: tf.Tensor, name: Optional[str] = None) -> tf.Tensor:
+    ops = load()
+    return ops.horovod_tpu_allgather(
+        tensor, tensor_name=_names("allgather", name))
+
+
+def broadcast(tensor: tf.Tensor, root_rank: int,
+              name: Optional[str] = None) -> tf.Tensor:
+    ops = load()
+    return ops.horovod_tpu_broadcast(
+        tensor, tensor_name=_names("broadcast", name), root_rank=root_rank)
+
+
+# ---------------------------------------------------------------------------
+# Gradients (reference horovod/tensorflow/mpi_ops.py:82-171). Registered at
+# import; they only fire when the op library loaded and a tape/graph
+# differentiates through these ops.
+
+@tf.RegisterGradient("HorovodTpuAllreduce")
+def _allreduce_grad(op, grad):
+    # d(sum_r x_r)/dx = 1 on every rank; the upstream grads differ per rank,
+    # so the backward is itself a sum-allreduce (mpi_ops.py:82-93).
+    return allreduce_sum(grad)
+
+
+@tf.RegisterGradient("HorovodTpuAllgather")
+def _allgather_grad(op, grad):
+    # Sum grads across ranks, then slice out this rank's rows using the
+    # gathered per-rank first dims (mpi_ops.py:115-138).
+    from ..common import basics
+
+    grad = allreduce_sum(grad)
+    d0 = tf.shape(op.inputs[0], out_type=tf.int32)[:1]
+    dims = tf.reshape(allgather(d0), [basics.size()])
+    splits = tf.split(grad, num_or_size_splits=dims, axis=0)
+    return splits[basics.rank()]
+
+
+@tf.RegisterGradient("HorovodTpuBroadcast")
+def _broadcast_grad(op, grad):
+    # All grads flow to the root's input; other ranks' inputs don't affect
+    # the output (mpi_ops.py:158-171).
+    from ..common import basics
+
+    root_rank = op.get_attr("root_rank")
+    reduced = allreduce_sum(grad)
+    if basics.rank() != root_rank:
+        return reduced * 0
+    return reduced
+
+
+# Reference-name module surface: horovod/tensorflow/mpi_ops.py re-exports
+# the lifecycle basics at module level (mpi_ops.py:42-58); keep drop-in
+# imports working here too.
+from ..common.basics import (  # noqa: E402,F401
+    init,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)

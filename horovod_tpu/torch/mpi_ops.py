@@ -292,3 +292,22 @@ def broadcast(tensor: torch.Tensor, root_rank: int,
 def broadcast_(tensor: torch.Tensor, root_rank: int,
                name: Optional[str] = None) -> torch.Tensor:
     return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# ---------------------------------------------------------------------------
+# Reference-name module surface (drop-in imports from horovod/torch/mpi_ops.py
+# keep working): the autograd Function classes under their public names
+# (reference mpi_ops.py:110,236,318) and the lifecycle basics the reference
+# re-exports at module level via HorovodBasics (mpi_ops.py:42-52).
+
+HorovodAllreduce = _AllreduceFunction
+HorovodAllgather = _AllgatherFunction
+HorovodBroadcast = _BroadcastFunction
+
+init = basics.init
+shutdown = basics.shutdown
+size = basics.size
+local_size = basics.local_size
+rank = basics.rank
+local_rank = basics.local_rank
+mpi_threads_supported = basics.mpi_threads_supported

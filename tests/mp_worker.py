@@ -514,6 +514,133 @@ def scenario_tensorflow(rank, size):
     np.testing.assert_allclose(logs["loss"], (size - 1) / 2)
 
 
+def scenario_tf_custom_op(rank, size):
+    # The native custom-op data path (tensorflow/src/tf_ops.cc): real graph
+    # nodes enqueueing into the C++ engine — reference
+    # tensorflow/mpi_ops.cc AsyncOpKernel semantics across real ranks.
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as tfhvd
+    from horovod_tpu.tensorflow import tf_ops
+
+    # run_ranks exports HOROVOD_RING_ADDRS → native engine → fast path live.
+    expect(tfhvd._custom_ops() is tf_ops,
+           "custom-op path must be active under the native engine")
+
+    # Eager average + sum.
+    x = tf.constant(np.arange(6, dtype=np.float32) + rank)
+    out = tfhvd.allreduce(x, average=True)
+    np.testing.assert_allclose(
+        out.numpy(), np.arange(6) + (size - 1) / 2, rtol=1e-6)
+    out = tfhvd.allreduce(x, average=False)
+    np.testing.assert_allclose(
+        out.numpy(), size * np.arange(6) + size * (size - 1) / 2, rtol=1e-6)
+
+    # bfloat16 rides the engine's native bf16 kernels; int32 average
+    # truncates back to int (the controller post-divide contract).
+    xb = tf.cast(tf.fill([8], float(rank + 1)), tf.bfloat16)
+    ob = tfhvd.allreduce(xb, average=False)
+    expect(ob.dtype == tf.bfloat16, "bf16 in, bf16 out")
+    np.testing.assert_allclose(tf.cast(ob, tf.float32).numpy(),
+                               sum(range(1, size + 1)))
+    xi = tf.constant([1, 2, 5], dtype=tf.int32)
+    oi = tfhvd.allreduce(xi, average=True)
+    expect(oi.dtype == tf.int32, "int average keeps dtype")
+    np.testing.assert_array_equal(oi.numpy(), [1, 2, 5])
+
+    # Allgather with uneven first dims; broadcast from a non-zero root.
+    rows = tf.fill([rank + 1, 2], float(rank))
+    gathered = tfhvd.allgather(rows)
+    expect(gathered.shape[0] == size * (size + 1) // 2,
+           f"gathered {gathered.shape}")
+    np.testing.assert_allclose(
+        gathered.numpy()[:, 0],
+        np.concatenate([np.full(r + 1, float(r)) for r in range(size)]))
+    b = tfhvd.broadcast(tf.constant([float(rank)]), root_rank=size - 1)
+    np.testing.assert_allclose(b.numpy(), [float(size - 1)])
+
+    # tf.function: the collective is a REAL graph node (no EagerPyFunc), and
+    # executes correctly.
+    @tf.function
+    def traced(t):
+        return tfhvd.allreduce(t, average=False, name="tfop.mp.traced")
+
+    cf = traced.get_concrete_function(tf.TensorSpec([2], tf.float32))
+    op_types = {op.type for op in cf.graph.get_operations()}
+    expect("HorovodTpuAllreduce" in op_types, f"graph ops: {op_types}")
+    expect("EagerPyFunc" not in op_types, "py_function must not appear")
+    tr = traced(tf.constant([1.0, 2.0]))
+    np.testing.assert_allclose(tr.numpy(), [size, 2.0 * size])
+
+    # Executor-concurrency burst: 32 independent collectives in one traced
+    # step — TF schedules the AsyncOpKernels from its thread pool, so this
+    # stresses concurrent ComputeAsync enqueue + engine fusion (the
+    # reference's "multiple" fusion-stressing test, test_torch.py).
+    @tf.function
+    def burst(t):
+        outs = [tfhvd.allreduce(t + float(i), average=False,
+                                name=f"tfop.mp.burst.{i}")
+                for i in range(32)]
+        return tf.stack(outs)
+
+    res = burst(tf.constant([float(rank)]))
+    want = np.array([[size * (size - 1) / 2 + size * i] for i in range(32)])
+    np.testing.assert_allclose(res.numpy(), want)
+
+    # Gradients through the registered custom-op grads
+    # (reference tensorflow/mpi_ops.py:82-171): d/dw sum_r mean_r(w^2).
+    w = tf.Variable([float(rank + 1)])
+    with tfhvd.DistributedGradientTape() as tape:
+        loss = w * w
+    (grad,) = tape.gradient(loss, [w])
+    want = np.mean([2.0 * (r + 1) for r in range(size)])
+    np.testing.assert_allclose(grad.numpy(), [want], rtol=1e-6)
+
+    # Allgather gradient: rank's slice of the summed upstream grad.
+    v = tf.Variable(tf.fill([rank + 1, 2], float(rank + 1)))
+    with tf.GradientTape() as tape:
+        g = tfhvd.allgather(v, name="tfop.mp.ag_grad")
+        # Weight rows so each rank's slice has a distinct expected grad.
+        loss = tf.reduce_sum(g) * float(size)
+    gv = tape.gradient(loss, v)
+    np.testing.assert_allclose(gv.numpy(),
+                               np.full((rank + 1, 2), float(size) * size))
+
+    # Broadcast gradient: all grads land on the root, zeros elsewhere.
+    bv = tf.Variable([2.0])
+    with tf.GradientTape() as tape:
+        out = tfhvd.broadcast(bv, root_rank=0, name="tfop.mp.bc_grad")
+        loss = tf.reduce_sum(out) * float(rank + 1)
+    gbv = tape.gradient(loss, bv)
+    want_root = float(sum(r + 1 for r in range(size)))
+    np.testing.assert_allclose(
+        gbv.numpy(), [want_root] if rank == 0 else [0.0])
+
+    # Cross-rank validation error surfaces as a TF error: ndim mismatch is
+    # rejected by the engine's construct_response matrix.
+    try:
+        bad = tf.zeros([2] if rank == 0 else [2, 2])
+        tfhvd.allreduce(bad, name="tfop.mp.mismatch")
+        expect(False, "mismatched ndim must raise")
+    except tf.errors.OpError as exc:
+        expect("mismatch" in str(exc).lower() or "rank" in str(exc).lower(),
+               f"unexpected error text: {exc}")
+
+    # The engine keeps serving after a rejected op.
+    ok = tfhvd.allreduce(tf.constant([1.0]), average=False,
+                         name="tfop.mp.after_error")
+    np.testing.assert_allclose(ok.numpy(), [float(size)])
+
+    # IndexedSlices sparse path rides the custom allgather.
+    slices = tf.IndexedSlices(
+        values=tf.constant([[float(rank + 1), 0.0]]),
+        indices=tf.constant([rank]), dense_shape=tf.constant([size, 2]))
+    red = tfhvd.allreduce(slices, average=True)
+    expect(isinstance(red, tf.IndexedSlices), "sparse stays sparse")
+    np.testing.assert_allclose(red.values.numpy()[:, 0],
+                               (np.arange(size) + 1) / size)
+
+
 def scenario_optimizer(rank, size):
     # End-to-end eager-tier DistributedOptimizer + broadcast_parameters
     # (reference examples/pytorch_mnist.py pattern).
@@ -813,6 +940,7 @@ SCENARIOS = {
     "mxnet": scenario_mxnet,
     "autotune": scenario_autotune,
     "tensorflow": scenario_tensorflow,
+    "tf_custom_op": scenario_tf_custom_op,
     "torch": scenario_torch,
     "optimizer": scenario_optimizer,
     "stall": scenario_stall,

@@ -44,7 +44,7 @@ def _launcher_env(**extra):
 
 
 def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
-              extra_env=None):
+              extra_env=None, per_rank_env=None):
     addr = f"127.0.0.1:{_free_port()}"
     ring_addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(size))
     procs = []
@@ -58,6 +58,7 @@ def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
             HOROVOD_RING_ADDRS=ring_addrs,
         )
         env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(rank, {}))
         procs.append(subprocess.Popen(
             [sys.executable, WORKER, scenario],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -92,6 +93,27 @@ def test_two_ranks(scenario):
 
 def test_three_ranks_allreduce():
     run_ranks("allreduce", size=3)
+
+
+def test_tf_custom_op_mixed_availability_agrees_on_fallback():
+    """One rank opts out of the custom-op path (the shape of a host whose
+    op library can't build): the job-wide vote in ``_custom_ops`` must drop
+    BOTH ranks to the py_function path — a mixed-path job would diverge
+    anonymous collective names (trace-time vs per-execution autonaming)
+    and stall negotiation."""
+    run_ranks("tensorflow", size=2, timeout=240.0,
+              per_rank_env={1: {"HOROVOD_TENSORFLOW_CUSTOM_OP": "0"}})
+
+
+def test_tf_custom_op_two_ranks():
+    """TF custom-op data path (tensorflow/src/tf_ops.cc) across real ranks:
+    graph-node collectives, gradients, validation errors. Building the op
+    library against the TF headers takes minutes on one core, so the parent
+    builds (or reuses the cached .so) before the ranks spawn."""
+    from horovod_tpu.tensorflow import tf_ops
+
+    tf_ops.build()
+    run_ranks("tf_custom_op", size=2, timeout=240.0)
 
 
 def test_allreduce_unpipelined_escape_hatch():
@@ -216,6 +238,9 @@ def test_star_data_plane(scenario):
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "cache", "error_mismatch", "duplicate_name",
     "inplace", "grouped", "objects",
+    # TF on the Python controller = the tf.py_function fallback path (the
+    # native-engine run of this scenario rides the custom op instead).
+    "tensorflow",
 ])
 def test_python_engine(scenario):
     # The Python controller (TCP star control plane) remains selectable via
@@ -323,7 +348,7 @@ def test_autotune_categorical_hierarchical_stays_correct():
         [sys.executable, "-m", "horovod_tpu.run", "-np", "4",
          "-H", "localhost:2,localhost:2",
          sys.executable, WORKER, "autotune"],
-        env=env, capture_output=True, text=True, timeout=180, cwd=REPO)
+        env=env, capture_output=True, text=True, timeout=360, cwd=REPO)
     assert res.returncode == 0, res.stdout + res.stderr
     for r in range(4):
         assert f"worker rank={r} scenario=autotune: OK" in res.stdout
