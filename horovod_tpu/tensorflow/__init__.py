@@ -19,6 +19,7 @@ users should prefer ``broadcast_variables`` /
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 import numpy as np
@@ -43,6 +44,8 @@ from ..ops.collective_ops import (  # noqa: F401  (framework-agnostic)
     broadcast_object,
 )
 from .compression import Compression  # noqa: F401
+
+_custom_op_vote_lock = threading.Lock()
 
 
 def _controller():
@@ -71,7 +74,19 @@ def _custom_ops():
         from . import tf_ops
 
         return tf_ops if cached else None
+    # Serialize the probe+vote: two threads both missing the cache would
+    # each issue the agreement collective, but peers answer it exactly once
+    # (the second vote would hit the duplicate-name rejection or hang).
+    with _custom_op_vote_lock:
+        cached = getattr(ctrl, "_tf_custom_op_agreed", None)
+        if cached is not None:
+            from . import tf_ops
 
+            return tf_ops if cached else None
+        return _custom_ops_vote(ctrl)
+
+
+def _custom_ops_vote(ctrl):
     import os
 
     local_ok = True

@@ -223,6 +223,17 @@ def test_mxnet_imagenet_resnet50_two_ranks():
     assert "epoch 0" in out
 
 
+def test_tensorflow_synthetic_benchmark_two_ranks():
+    out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
+                sys.executable,
+                os.path.join(EX, "tensorflow_synthetic_benchmark.py"),
+                "--model", "MobileNetV2", "--batch-size", "4",
+                "--image-size", "32", "--num-classes", "10",
+                "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+                "--num-iters", "2"])
+    assert "Total img/sec on 2 worker(s):" in out
+
+
 def test_torch_synthetic_benchmark_two_ranks():
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable,

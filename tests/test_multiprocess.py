@@ -101,6 +101,12 @@ def test_tf_custom_op_mixed_availability_agrees_on_fallback():
     BOTH ranks to the py_function path — a mixed-path job would diverge
     anonymous collective names (trace-time vs per-execution autonaming)
     and stall negotiation."""
+    from horovod_tpu.tensorflow import tf_ops
+
+    # Pre-build in the parent: rank 0's availability probe inside the vote
+    # would otherwise spend minutes compiling while rank 1 sits parked in
+    # the agreement allreduce, racing the timeout on a cold cache.
+    tf_ops.build()
     run_ranks("tensorflow", size=2, timeout=240.0,
               per_rank_env={1: {"HOROVOD_TENSORFLOW_CUSTOM_OP": "0"}})
 
