@@ -315,14 +315,13 @@ class Controller:
                   wrap: Optional[Callable] = None):
         return self.broadcast_async(tensor, root_rank, name, wrap=wrap).wait()
 
-    def reducescatter(self, tensor, average: bool = True):
-        raise NotImplementedError(
-            "reducescatter is an SPMD-tier extension; use it inside "
-            "jit/shard_map (the reference has no eager reducescatter either)")
+    def reducescatter(self, tensor, average: bool = True,
+                      wrap: Optional[Callable] = None):
+        return composed_reducescatter(self, tensor, average=average,
+                                      wrap=wrap)
 
-    def alltoall(self, tensor):
-        raise NotImplementedError(
-            "alltoall is an SPMD-tier extension; use it inside jit/shard_map")
+    def alltoall(self, tensor, wrap: Optional[Callable] = None):
+        return composed_alltoall(self, tensor, wrap=wrap)
 
     def shutdown(self) -> None:
         """Cooperative teardown: flag travels with the next tick, coordinator
@@ -807,3 +806,61 @@ class Controller:
                 result = np.frombuffer(raw, dtype=entry.array.dtype).reshape(
                     entry.array.shape)
         self._finish(entry, np.array(result, copy=True))
+
+
+# ---------------------------------------------------------------------------
+# Composed eager collectives, shared by both controller implementations.
+# The reference has no eager reducescatter/alltoall (they appear upstream in
+# Horovod 0.19/0.20; in 0.16.1 reduce-scatter exists only INSIDE
+# NCCLHierarchicalAllreduce, nccl_operations.cc:230-247). The eager host
+# tier implements them by composition over the negotiated primitives —
+# correctness-first (2x the wire bytes of a native reduce-scatter; alltoall
+# gathers the full payload). The bandwidth-optimal forms live on the SPMD
+# tier (lax.psum_scatter / lax.all_to_all in ops/collective_ops.py), which
+# is where throughput-critical traffic belongs.
+
+
+def composed_reducescatter(ctl, tensor, average: bool = True, wrap=None):
+    """Reduce across ranks, keep this rank's dim-0 block. Uneven first dims
+    split like ``np.array_split`` (lower ranks get the larger blocks) —
+    matching the SPMD variant's rank-ordered tiling."""
+    arr = np.asarray(tensor)
+    if arr.ndim == 0:
+        raise ValueError(
+            "reducescatter requires at least one dimension (got a scalar)")
+    full = np.asarray(ctl.allreduce(arr, average=average))
+    size, rank = ctl.topo.size, ctl.topo.rank
+    base, rem = divmod(arr.shape[0], size)
+    counts = [base + (1 if r < rem else 0) for r in range(size)]
+    off = sum(counts[:rank])
+    out = np.array(full[off:off + counts[rank]], copy=True)
+    return wrap(out) if wrap is not None else out
+
+
+def composed_alltoall(ctl, tensor, wrap=None):
+    """Exchange dim-0 splits: rank r's output is the concatenation of every
+    rank's r-th block. Requires each rank's OWN first dim divisible by the
+    world size (per-rank block sizes may differ between ranks); the block
+    map is agreed via a first-dim allgather, so an invalid dim raises the
+    SAME error on every rank instead of hanging the data phase."""
+    arr = np.asarray(tensor)
+    if arr.ndim == 0:
+        raise ValueError(
+            "alltoall requires at least one dimension (got a scalar)")
+    size, rank = ctl.topo.size, ctl.topo.rank
+    dims = np.asarray(ctl.allgather(
+        np.asarray([arr.shape[0]], dtype=np.int64))).reshape(size)
+    for r, d in enumerate(dims):
+        if int(d) % size != 0:
+            raise ValueError(
+                f"alltoall requires every rank's first dimension to be "
+                f"divisible by size {size}; rank {r} has dim 0 = {int(d)}")
+    gathered = np.asarray(ctl.allgather(arr))
+    offsets = np.concatenate([[0], np.cumsum(dims)])
+    parts = []
+    for j in range(size):
+        seg = int(dims[j]) // size
+        start = int(offsets[j]) + rank * seg
+        parts.append(gathered[start:start + seg])
+    out = np.concatenate(parts, axis=0)
+    return wrap(out) if wrap is not None else out

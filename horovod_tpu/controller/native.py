@@ -350,14 +350,17 @@ class NativeController:
                   wrap: Optional[Callable] = None):
         return self.broadcast_async(tensor, root_rank, name, wrap=wrap).wait()
 
-    def reducescatter(self, tensor, average: bool = True):
-        raise NotImplementedError(
-            "reducescatter is an SPMD-tier extension; use it inside "
-            "jit/shard_map (the reference has no eager reducescatter either)")
+    def reducescatter(self, tensor, average: bool = True,
+                      wrap: Optional[Callable] = None):
+        from .controller import composed_reducescatter
 
-    def alltoall(self, tensor):
-        raise NotImplementedError(
-            "alltoall is an SPMD-tier extension; use it inside jit/shard_map")
+        return composed_reducescatter(self, tensor, average=average,
+                                      wrap=wrap)
+
+    def alltoall(self, tensor, wrap: Optional[Callable] = None):
+        from .controller import composed_alltoall
+
+        return composed_alltoall(self, tensor, wrap=wrap)
 
     # ----------------------------------------------------------- lifecycle
 

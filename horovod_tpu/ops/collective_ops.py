@@ -385,7 +385,10 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[str] = No
     """Reduce + scatter along dim 0. TPU extension: the reference has no
     user-facing reducescatter (it appears only inside
     ``NCCLHierarchicalAllreduce``, ``nccl_operations.cc:230-247``). On ICI this
-    is the bandwidth-optimal half of an allreduce."""
+    is the bandwidth-optimal half of an allreduce; the eager tier composes
+    it from a negotiated allreduce + local slice
+    (``controller.composed_reducescatter`` — correctness-first, 2x the
+    native wire bytes)."""
     avg = _resolve_average(average, op)
     if _is_traced(tensor):
         def _rs(t, ax):
@@ -396,16 +399,25 @@ def reducescatter(tensor, average: Optional[bool] = None, op: Optional[str] = No
 
         return _traced_collective(tensor, axis_name, _rs,
                                   opname="reducescatter")
+    if np.asarray(tensor).ndim == 0:
+        # Validate BEFORE the size-1 shortcut: behavior must not depend on
+        # world size.
+        raise ValueError(
+            "reducescatter requires at least one dimension (got a scalar)")
     st = basics.state()
     if st.topology.size == 1:
         return _wrap_value(tensor)
-    return _controller().reducescatter(tensor, average=avg)
+    return _controller().reducescatter(tensor, average=avg,
+                                       wrap=_wrap_for(tensor))
 
 
 def alltoall(tensor, axis_name: Optional[str] = None):
     """Exchange dim-0 splits between ranks. TPU extension (reference lacks
     alltoall; it arrived upstream in Horovod 0.20). Building block for
-    Ulysses-style sequence parallelism (``horovod_tpu.parallel.sequence``)."""
+    Ulysses-style sequence parallelism (``horovod_tpu.parallel.sequence``).
+    The eager tier composes it from allgathers
+    (``controller.composed_alltoall``); the bandwidth-optimal
+    ``lax.all_to_all`` form is the traced path."""
     if _is_traced(tensor):
         def _a2a(t, ax):
             n = lax.psum(1, ax)
@@ -416,10 +428,14 @@ def alltoall(tensor, axis_name: Optional[str] = None):
 
         return _traced_collective(tensor, axis_name, _a2a,
                                   opname="alltoall")
+    if np.asarray(tensor).ndim == 0:
+        # Size-independent validation, as in reducescatter above.
+        raise ValueError(
+            "alltoall requires at least one dimension (got a scalar)")
     st = basics.state()
     if st.topology.size == 1:
         return _wrap_value(tensor)
-    return _controller().alltoall(tensor)
+    return _controller().alltoall(tensor, wrap=_wrap_for(tensor))
 
 
 # ---------------------------------------------------------------------------

@@ -160,6 +160,57 @@ def scenario_grouped(rank, size):
             dense_shape=tf.constant([2, 1]))])
 
 
+def scenario_reducescatter_alltoall(rank, size):
+    # Composed eager reducescatter/alltoall (controller.composed_*): the
+    # SPMD tier's collectives, made available on the host tier.
+    # reducescatter: sum then keep this rank's dim-0 block; 5 rows over
+    # size ranks exercises the uneven array_split boundaries.
+    x = np.arange(10, dtype=np.float32).reshape(5, 2) + rank
+    out = np.asarray(hvd.reducescatter(x, average=False))
+    full = size * (np.arange(10, dtype=np.float32).reshape(5, 2)) \
+        + sum(range(size))
+    base, rem = divmod(5, size)
+    counts = [base + (1 if r < rem else 0) for r in range(size)]
+    off = sum(counts[:rank])
+    np.testing.assert_allclose(out, full[off:off + counts[rank]])
+    # average=True divides by size.
+    out = np.asarray(hvd.reducescatter(x, average=True))
+    np.testing.assert_allclose(out, full[off:off + counts[rank]] / size)
+
+    # alltoall: rank r receives every rank's r-th block, in rank order.
+    # Rank j sends blocks of j+1 rows (per-rank dims may differ).
+    rows = size * (rank + 1)
+    x = np.full((rows, 3), float(rank), np.float32)
+    x[:, 1] = np.repeat(np.arange(size), rank + 1)  # block id in col 1
+    out = np.asarray(hvd.alltoall(x))
+    expect(out.shape == (sum(r + 1 for r in range(size)), 3),
+           f"alltoall shape {out.shape}")
+    want = np.concatenate([
+        np.stack([np.full(j + 1, float(j)),
+                  np.full(j + 1, float(rank)),
+                  np.full(j + 1, float(j))], axis=1)
+        for j in range(size)
+    ])
+    np.testing.assert_allclose(out, want)
+
+    # Indivisible first dim raises the SAME error on every rank (agreed via
+    # the dims gather) instead of hanging the data phase.
+    try:
+        hvd.alltoall(np.zeros((size + 1, 2), np.float32))
+        expect(False, "indivisible alltoall must raise")
+    except ValueError as exc:
+        expect("divisible" in str(exc), str(exc))
+    # Scalars are rejected up front.
+    try:
+        hvd.reducescatter(np.float32(3.0))
+        expect(False, "scalar reducescatter must raise")
+    except ValueError:
+        pass
+    # The job keeps serving afterwards.
+    ok = np.asarray(hvd.allreduce(np.ones(2, np.float32), average=False))
+    np.testing.assert_allclose(ok, size * np.ones(2))
+
+
 def scenario_objects(rank, size):
     # broadcast_object / allgather_object (later-Horovod API): arbitrary
     # picklable payloads of rank-dependent size over the eager tier.
@@ -934,6 +985,7 @@ SCENARIOS = {
     "grouped": scenario_grouped,
     "shmgather": scenario_shmgather,
     "objects": scenario_objects,
+    "reducescatter_alltoall": scenario_reducescatter_alltoall,
     "copybench": scenario_copybench,
     "shmbench": scenario_shmbench,
     "hierarchical": scenario_hierarchical,

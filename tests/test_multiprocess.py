@@ -85,7 +85,7 @@ def run_ranks(scenario: str, size: int = 2, timeout: float = 120.0,
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "allgather", "broadcast", "cache",
     "error_mismatch", "duplicate_name", "optimizer", "torch", "tensorflow",
-    "mxnet", "inplace", "grouped", "objects",
+    "mxnet", "inplace", "grouped", "objects", "reducescatter_alltoall",
 ])
 def test_two_ranks(scenario):
     run_ranks(scenario, size=2)
@@ -93,6 +93,12 @@ def test_two_ranks(scenario):
 
 def test_three_ranks_allreduce():
     run_ranks("allreduce", size=3)
+
+
+def test_three_ranks_reducescatter_alltoall():
+    # 5 rows over 3 ranks: uneven array_split blocks [2, 2, 1]; alltoall
+    # with three distinct per-rank block sizes.
+    run_ranks("reducescatter_alltoall", size=3)
 
 
 def test_tf_custom_op_mixed_availability_agrees_on_fallback():
@@ -243,7 +249,7 @@ def test_star_data_plane(scenario):
 
 @pytest.mark.parametrize("scenario", [
     "allreduce", "fusion", "cache", "error_mismatch", "duplicate_name",
-    "inplace", "grouped", "objects",
+    "inplace", "grouped", "objects", "reducescatter_alltoall",
     # TF on the Python controller = the tf.py_function fallback path (the
     # native-engine run of this scenario rides the custom op instead).
     "tensorflow",
