@@ -180,8 +180,9 @@ def grouped_allreduce(tensors, average: bool = True,
     surface; executed by the same enqueue-together + Tensor Fusion path).
     In eager mode all members are enqueued before any is joined, so the
     engine sees the whole group in one cycle; inside ``tf.function`` each
-    member rides its own py_function node (the executor schedules them
-    concurrently)."""
+    member is its own graph node — custom-op kernels when the fast path is
+    live, py_function otherwise — and the executor schedules them
+    concurrently, which lands them in the same engine cycle in practice."""
     if not isinstance(tensors, (list, tuple)):
         raise TypeError("grouped_allreduce expects a list/tuple of tensors")
     tensors = list(tensors)
