@@ -1,0 +1,161 @@
+"""Wire framing + validation-matrix unit tests.
+
+The authenticated frame format (``common/wire.py``, reference
+``run/common/util/network.py:48-83``) and the cross-rank validation matrix
+(``common/message.py construct_response``, reference
+``operations.cc:198-371``) are the control plane's trust boundary; the
+multiprocess scenarios exercise them end-to-end, these tests pin the edge
+cases directly — tampering, wrong key, truncation, oversized frames, and a
+randomized sweep of mismatch injections.
+"""
+
+import pickle
+import socket
+import struct
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common.message import (
+    Request,
+    RequestType,
+    ResponseType,
+    construct_response,
+)
+from horovod_tpu.common.wire import DIGEST_LEN, AuthError, Wire
+
+
+def _pair(secret=b"k" * 32):
+    a, b = socket.socketpair()
+    return Wire(a, secret), Wire(b, secret), a, b
+
+
+def test_roundtrip_bytes_and_obj():
+    w1, w2, *_ = _pair()
+    w1.send_bytes(b"\x00\x01payload")
+    assert w2.recv_bytes() == b"\x00\x01payload"
+    w2.send_obj({"rank": 3, "shape": (2, 4)})
+    assert w1.recv_obj() == {"rank": 3, "shape": (2, 4)}
+    # Empty payload frames are legal.
+    w1.send_bytes(b"")
+    assert w2.recv_bytes() == b""
+
+
+def test_tampered_payload_rejected():
+    w1, w2, a, _ = _pair()
+    payload = b"x" * 64
+    w1.send_bytes(payload)
+    # Tamper in flight: resend the same frame with one payload byte flipped
+    # but the original digest.
+    import hashlib
+    import hmac as hmac_mod
+
+    digest = hmac_mod.new(b"k" * 32, payload, hashlib.sha256).digest()
+    bad = bytearray(payload)
+    bad[10] ^= 0xFF
+    a.sendall(struct.pack(">I", len(bad)) + digest + bytes(bad))
+    assert w2.recv_bytes() == payload  # the honest frame passes
+    with pytest.raises(AuthError, match="HMAC"):
+        w2.recv_bytes()
+
+
+def test_wrong_secret_rejected():
+    a, b = socket.socketpair()
+    w1 = Wire(a, b"A" * 32)
+    w2 = Wire(b, b"B" * 32)
+    w1.send_bytes(b"hello")
+    with pytest.raises(AuthError, match="HMAC"):
+        w2.recv_bytes()
+
+
+def test_truncated_stream_raises_not_hangs():
+    w1, w2, a, _ = _pair()
+    # Half a header, then close: the reader must get a clean error.
+    a.sendall(b"\x00\x00")
+    a.close()
+    with pytest.raises(ConnectionError, match="closed"):
+        w2.recv_bytes()
+
+
+def test_oversized_frame_rejected_before_allocation():
+    _, w2, a, _ = _pair()
+    a.sendall(struct.pack(">I", (1 << 31) + 5) + b"\x00" * DIGEST_LEN)
+    with pytest.raises(AuthError, match="oversized"):
+        w2.recv_bytes()
+
+
+def test_garbage_pickle_fails_loudly():
+    w1, w2, *_ = _pair()
+    w1.send_bytes(b"not a pickle")
+    with pytest.raises(pickle.UnpicklingError):
+        w2.recv_obj()
+
+
+# ---------------------------------------------------------------------------
+# construct_response randomized sweep
+
+
+def _req(rank, rtype=RequestType.ALLREDUCE, dtype="float32", shape=(4, 2),
+         root=-1):
+    return Request(request_rank=rank, request_type=rtype,
+                   tensor_dtype=dtype, tensor_shape=tuple(shape),
+                   root_rank=root, tensor_name="t")
+
+
+def test_validation_matrix_randomized():
+    """200 seeded cases: a consistent request set must negotiate; a single
+    injected mismatch must produce ERROR whose message names the offending
+    rank — never an exception, never a false pass (reference
+    ConstructResponse first-mismatch-wins, operations.cc:198-371)."""
+    rng = np.random.RandomState(0)
+    dtypes = ["float32", "float64", "int32"]
+    for case in range(200):
+        size = int(rng.randint(2, 6))
+        rtype = RequestType(int(rng.randint(0, 3)))
+        shape = tuple(int(d) for d in rng.randint(1, 5, size=rng.randint(1, 4)))
+        root = int(rng.randint(0, size)) if rtype == RequestType.BROADCAST \
+            else -1
+        reqs = [_req(r, rtype, dtypes[0], shape, root) for r in range(size)]
+        if rtype == RequestType.ALLGATHER:
+            # Per-rank first dims are legal for allgather.
+            for r, rq in enumerate(reqs):
+                rq.tensor_shape = (int(rng.randint(1, 6)),) + shape[1:]
+
+        clean = construct_response(list(reqs), size)
+        assert clean.response_type == ResponseType(int(rtype)), (
+            case, rtype, clean.error_message)
+
+        # Inject exactly one mismatch into a non-first rank.
+        victim = int(rng.randint(1, size))
+        kind = rng.choice(["op", "dtype", "shape"])
+        if kind == "op":
+            reqs[victim].request_type = RequestType((int(rtype) + 1) % 3)
+            # Changing op on a broadcast victim may need a sane root for the
+            # new op; the op check fires first regardless.
+        elif kind == "dtype":
+            reqs[victim].tensor_dtype = dtypes[1]
+        else:
+            if rtype == RequestType.ALLGATHER:
+                # Only trailing-dim/rank changes are errors for allgather.
+                reqs[victim].tensor_shape = reqs[victim].tensor_shape + (7,)
+            else:
+                reqs[victim].tensor_shape = tuple(
+                    d + 1 for d in reqs[victim].tensor_shape)
+        err = construct_response(list(reqs), size)
+        assert err.response_type == ResponseType.ERROR, (case, kind)
+        assert "Mismatched" in err.error_message, err.error_message
+        assert f"rank {victim}" in err.error_message, (
+            case, kind, err.error_message)
+
+
+def test_broadcast_invalid_root_and_scalar_allgather():
+    reqs = [_req(r, RequestType.BROADCAST, root=5, shape=(3,))
+            for r in range(2)]
+    out = construct_response(reqs, 2)
+    assert out.response_type == ResponseType.ERROR
+    assert "Invalid broadcast root rank 5" in out.error_message
+
+    reqs = [_req(r, RequestType.ALLGATHER, shape=()) for r in range(2)]
+    out = construct_response(reqs, 2)
+    assert out.response_type == ResponseType.ERROR
+    assert "scalar" in out.error_message
