@@ -228,6 +228,13 @@ long long EnqueueOrFail(OpKernelContext* ctx,
     done();
     return -1;
   }
+  if (h == -3) {
+    // Engine enqueue's closed/shutdown code (engine.cc enqueue); the
+    // ctypes tier maps this to ShutdownError the same way (native.py).
+    ctx->SetStatus(FailedPrecondition("Horovod has been shut down"));
+    done();
+    return -1;
+  }
   if (h < 0) {
     ctx->SetStatus(FailedPrecondition(
         "engine enqueue failed (", api->last_error(),

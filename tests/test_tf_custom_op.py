@@ -118,3 +118,22 @@ def test_allgather_gradient_needs_ranks(engine):
         tape.watch(x)
         y = f(x)
     assert tape.gradient(y, x).numpy() == pytest.approx(1.0)
+
+
+def test_enqueue_after_shutdown_raises_cleanly(engine):
+    # Shuts the shared engine down, asserts the op fails with the engine's
+    # shutdown contract (FailedPrecondition, not a stale error string),
+    # then re-inits so later tests don't depend on execution order
+    # (re-init after finish() is legal, engine.cc hvd_eng_init).
+    engine.hvd_eng_shutdown()
+    try:
+        with pytest.raises(tf.errors.FailedPreconditionError,
+                           match="shut down"):
+            tf_ops.allreduce_sum(tf.constant([1.0]),
+                                 name="tfop.after.shutdown")
+    finally:
+        secret = b"\x01" * 32
+        key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
+        rc = engine.hvd_eng_init(0, 1, b"", key, len(secret), 1.0, 1 << 20,
+                                 64, 1, 60.0, -1.0, b"", 0)
+        assert rc == 0, engine.hvd_eng_last_error().decode()
