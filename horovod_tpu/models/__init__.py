@@ -45,3 +45,11 @@ from .vgg import (  # noqa: F401
     VGG19,
     VGGTiny,
 )
+from .vit import (  # noqa: F401
+    VIT_B16,
+    VIT_S16,
+    VIT_TINY,
+    VisionTransformer,
+    ViTConfig,
+    classification_loss,
+)

@@ -106,6 +106,15 @@ def test_imagenet_resnet50_checkpoint_resume(tmp_path):
     assert "resumed" in out and "ckpt_2" in out
 
 
+def test_vit_example_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_vit_training.py"),
+                "--model", "tiny", "--batch-per-chip", "2", "--steps", "4",
+                "--warmup-steps", "1"],
+               extra_env={
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    assert "vit-tiny" in out and "img/sec" in out
+
+
 def test_moe_example_smoke():
     out = _run([sys.executable, os.path.join(EX, "jax_moe_training.py"),
                 "--steps", "15", "--tokens-per-device", "128",

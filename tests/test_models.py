@@ -180,3 +180,53 @@ def test_bert_remat_matches_no_remat():
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
         g0, g1)
+
+
+def test_vit_tiny_forward_loss_and_grad():
+    from horovod_tpu.models import (VIT_TINY, VisionTransformer,
+                                    classification_loss)
+
+    cfg = VIT_TINY
+    model = VisionTransformer(cfg)
+    imgs = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    labels = jnp.asarray([1, 7])
+    variables = model.init(jax.random.PRNGKey(0), imgs, deterministic=True)
+    logits = model.apply(variables, imgs, deterministic=True)
+    assert logits.shape == (2, cfg.num_classes)
+    loss, grads = jax.value_and_grad(
+        lambda v: classification_loss(
+            model.apply(v, imgs, deterministic=True), labels))(variables)
+    # Random init: loss ~ ln(num_classes); params must all receive grads.
+    assert 0.5 * np.log(cfg.num_classes) < float(loss) \
+        < 3 * np.log(cfg.num_classes)
+    leaves = jax.tree.leaves(grads)
+    assert leaves and all(np.all(np.isfinite(np.asarray(g))) for g in leaves)
+    assert any(float(jnp.abs(g).max()) > 0 for g in leaves)
+
+
+def test_vit_remat_matches_no_remat():
+    # Compare GRADIENTS, not just forwards: remat only changes the backward
+    # (recomputation), so a forward-only comparison would be vacuous (the
+    # BERT twin test, test_bert_remat_matches_no_remat, for the same
+    # reason).
+    import dataclasses
+
+    from horovod_tpu.models import (VIT_TINY, VisionTransformer,
+                                    classification_loss)
+
+    imgs = jnp.asarray(np.random.RandomState(1).rand(1, 32, 32, 3), jnp.float32)
+    labels = jnp.asarray([3])
+    base = VisionTransformer(VIT_TINY)
+    rematted = VisionTransformer(dataclasses.replace(VIT_TINY, remat=True))
+    variables = base.init(jax.random.PRNGKey(0), imgs, deterministic=True)
+
+    def loss_fn(model):
+        return lambda v: classification_loss(
+            model.apply(v, imgs, deterministic=True), labels)
+
+    l0, g0 = jax.value_and_grad(loss_fn(base))(variables)
+    l1, g1 = jax.value_and_grad(loss_fn(rematted))(variables)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0, g1)
