@@ -18,6 +18,8 @@ from .llama import (  # noqa: F401
     LlamaLM,
     causal_lm_loss,
     chunked_causal_lm_loss,
+    generate,
+    init_kv_cache,
     llama_tp_param_specs,
     sp_causal_lm_loss,
     token_nll,

@@ -13,6 +13,7 @@ heads to their K/V group in the grid — no repeat); others get repeated K/V.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -108,7 +109,13 @@ class LlamaAttention(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None, cache_index=None):
+        """``cache``/``cache_index``: autoregressive-decoding mode (see
+        :func:`init_kv_cache`). The new K/V rows are written into the
+        static-shape cache at ``cache_index`` and attention runs against
+        the whole window under an explicit positional mask; returns
+        ``(out, new_cache)``. Training mode (``cache=None``) is unchanged.
+        """
         cfg = self.config
         head_dim = cfg.dim // cfg.num_heads
         dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
@@ -119,6 +126,14 @@ class LlamaAttention(nn.Module):
         k = rotary_embedding(dense(cfg.num_kv_heads, "wk")(x),
                              cfg.rope_theta, positions)
         v = dense(cfg.num_kv_heads, "wv")(x)
+        out_proj = nn.DenseGeneral(features=cfg.dim, axis=(-2, -1),
+                                   use_bias=False, dtype=cfg.dtype,
+                                   param_dtype=jnp.float32, name="wo")
+
+        if cache is not None:
+            ctx, new_cache = _cached_attention(q, k, v, cache, cache_index)
+            return out_proj(ctx), new_cache
+
         # flash_attention / reference_attention / ring_attention handle
         # grouped K/V heads natively (the flash grid routes each query
         # head to its group's K/V row — no repeated K/V copy in HBM; the
@@ -137,9 +152,37 @@ class LlamaAttention(nn.Module):
             from ..ops.attention import reference_attention
 
             ctx = reference_attention(q, k, v, causal=True)
-        return nn.DenseGeneral(features=cfg.dim, axis=(-2, -1),
-                               use_bias=False, dtype=cfg.dtype,
-                               param_dtype=jnp.float32, name="wo")(ctx)
+        return out_proj(ctx)
+
+
+def _cached_attention(q, k, v, cache, cache_index):
+    """Decode-mode attention: write the s new K/V rows at ``cache_index``,
+    attend every query (global position ``cache_index + i``) over the full
+    static window under ``key_pos <= q_pos`` — one code path covers both
+    prefill (s = prompt length at index 0) and single-token steps. Masked
+    logits hit exp(-inf) = 0 exactly, so the softmax equals the one over
+    only the valid prefix. Grouped-query: queries attend their K/V group
+    directly (no repeated K/V in the cache)."""
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    group = h // hkv
+    k_cache = jax.lax.dynamic_update_slice(
+        cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0))
+    window = k_cache.shape[1]
+    scale = 1.0 / np.sqrt(d)
+    qg = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,blhd->bshgl", qg, k_cache).astype(
+        jnp.float32) * scale
+    q_pos = cache_index + jnp.arange(s)
+    key_pos = jnp.arange(window)
+    mask = key_pos[None, :] <= q_pos[:, None]          # (s, window)
+    logits = jnp.where(mask[None, :, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("bshgl,blhd->bshgd", probs, v_cache).reshape(b, s, h, d)
+    return ctx, {"k": k_cache, "v": v_cache}
 
 
 class LlamaBlock(nn.Module):
@@ -147,19 +190,25 @@ class LlamaBlock(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None, cache_index=None):
         cfg = self.config
-        x = x + LlamaAttention(cfg, attention_fn=self.attention_fn,
-                               name="attention")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x),
-            positions)
+        attn_in = RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x)
+        attn = LlamaAttention(cfg, attention_fn=self.attention_fn,
+                              name="attention")
+        new_cache = None
+        if cache is None:
+            x = x + attn(attn_in, positions)
+        else:
+            a, new_cache = attn(attn_in, positions, cache, cache_index)
+            x = x + a
         h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
         dense = lambda f, name: nn.Dense(  # noqa: E731
             f, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
             name=name)
         gated = nn.silu(dense(cfg.ffn_hidden, "w_gate")(h)) * \
             dense(cfg.ffn_hidden, "w_up")(h)
-        return x + dense(cfg.dim, "w_down")(gated)
+        out = x + dense(cfg.dim, "w_down")(gated)
+        return out if cache is None else (out, new_cache)
 
 
 class LlamaLM(nn.Module):
@@ -169,20 +218,36 @@ class LlamaLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, return_hidden=False):
+    def __call__(self, input_ids, positions=None, return_hidden=False,
+                 cache=None, cache_index=None):
         """``positions``: global token positions of the local rows, shape
         (S,). Required under sequence parallelism (each shard passes its
         global offsets so RoPE rotates correctly); defaults to 0..S-1.
         ``return_hidden``: skip the lm_head and return the final-norm
         hidden states (B, S, dim) — pair with
-        :func:`chunked_causal_lm_loss`."""
+        :func:`chunked_causal_lm_loss`.
+        ``cache``/``cache_index``: autoregressive decoding — the rows are
+        the tokens at global positions ``cache_index..cache_index+S-1``
+        (RoPE positions default accordingly), the per-layer K/V land in
+        the cache, and the call returns ``(logits, new_cache)``. Use
+        :func:`init_kv_cache` + :func:`generate`."""
         cfg = self.config
+        if cache is not None and positions is None:
+            positions = cache_index + jnp.arange(input_ids.shape[1])
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
+        new_cache = {}
         block_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, attention_fn=self.attention_fn,
-                          name=f"layer_{i}")(x, positions)
+            if cache is None:
+                x = block_cls(cfg, attention_fn=self.attention_fn,
+                              name=f"layer_{i}")(x, positions)
+            else:
+                # Decoding never needs remat (no backward pass).
+                x, new_cache[f"layer_{i}"] = LlamaBlock(
+                    cfg, attention_fn=self.attention_fn,
+                    name=f"layer_{i}")(x, positions, cache[f"layer_{i}"],
+                                       cache_index)
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         if return_hidden:
             # For chunked_causal_lm_loss: the caller applies the lm_head
@@ -190,9 +255,101 @@ class LlamaLM(nn.Module):
             return x
         # Head matmul in head_dtype (default: model compute dtype; MXU
         # accumulates f32 internally) — see LlamaConfig.head_dtype.
-        return nn.Dense(cfg.vocab_size, use_bias=False,
-                        dtype=cfg.head_dtype or cfg.dtype,
-                        param_dtype=jnp.float32, name="lm_head")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=cfg.head_dtype or cfg.dtype,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits if cache is None else (logits, new_cache)
+
+
+def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
+                  dtype=None):
+    """Static-shape per-layer K/V cache for autoregressive decoding:
+    ``{layer_i: {"k"/"v": (B, max_len, num_kv_heads, head_dim)}}``. GQA
+    pays off directly here: the cache holds ``num_kv_heads`` rows, an
+    H/Hkv memory saving over repeating K/V (the reason GQA exists)."""
+    dtype = dtype or cfg.dtype
+    head_dim = cfg.dim // cfg.num_heads
+    shape = (batch_size, max_len, cfg.num_kv_heads, head_dim)
+    return {
+        f"layer_{i}": {"k": jnp.zeros(shape, dtype),
+                       "v": jnp.zeros(shape, dtype)}
+        for i in range(cfg.num_layers)
+    }
+
+
+def generate(model: "LlamaLM", variables, prompt_ids, max_new_tokens: int,
+             max_len: Optional[int] = None, temperature: float = 0.0,
+             rng=None):
+    """Autoregressive decoding with the KV cache: prefill the prompt in one
+    call, then ``lax.scan`` single-token steps — the whole loop is two
+    compiled programs regardless of length (no per-token dispatch).
+
+    ``temperature`` 0.0 = greedy argmax (default); > 0 samples from
+    ``softmax(logits / temperature)`` using ``rng``. Returns
+    ``(B, prompt + max_new_tokens)`` ids (prompt included).
+
+    This is the inference counterpart of the training path the framework
+    benchmarks; for serving without this framework see ``docs/inference.md``
+    (checkpoints are plain pytrees)."""
+    cfg = model.config
+    b, s = prompt_ids.shape
+    if max_len is None:
+        max_len = min(cfg.max_seq_len, s + max_new_tokens)
+    if s + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"cache window max_len={max_len}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused on the greedy path
+    if max_new_tokens <= 0:
+        return prompt_ids
+    # greedy is the only STATIC part of the sampling decision: temperature
+    # rides in as a traced operand so a temperature sweep shares one
+    # compiled program instead of recompiling the prefill+scan per value.
+    new_tokens = _decode(model, variables, prompt_ids, rng,
+                         jnp.float32(temperature), int(max_new_tokens),
+                         int(max_len), temperature <= 0.0)
+    return jnp.concatenate([prompt_ids, new_tokens], axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "max_len", "greedy"))
+def _decode(model, variables, prompt_ids, rng, temperature, max_new_tokens,
+            max_len, greedy):
+    """Compiled decode body. Module-level with the model as a STATIC arg
+    (flax modules hash by structure): repeated ``generate`` calls with the
+    same model/shapes hit the jit cache — a per-call ``@jax.jit`` closure
+    would recompile the prefill+scan program on every invocation."""
+    cfg = model.config
+    b, s = prompt_ids.shape
+
+    def pick(logits, step_rng):
+        logits = logits.astype(jnp.float32)
+        if greedy:
+            return jnp.argmax(logits, axis=-1)
+        return jax.random.categorical(step_rng, logits / temperature)
+
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = model.apply(variables, prompt_ids, cache=cache,
+                                cache_index=0)
+    rng, step_rng = jax.random.split(rng)
+    first = pick(logits[:, -1], step_rng)
+
+    def body(carry, i):
+        tok, cache, rng = carry
+        logits, cache = model.apply(variables, tok[:, None], cache=cache,
+                                    cache_index=s + i)
+        rng, step_rng = jax.random.split(rng)
+        nxt = pick(logits[:, -1], step_rng)
+        return (nxt, cache, rng), nxt
+
+    # lax.scan handles the zero-length xs of max_new_tokens == 1.
+    (_, _, _), rest = jax.lax.scan(
+        body, (first, cache, rng), jnp.arange(max_new_tokens - 1))
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 
 def llama_tp_param_specs(params, axis: str = "model"):

@@ -106,6 +106,13 @@ def test_imagenet_resnet50_checkpoint_resume(tmp_path):
     assert "resumed" in out and "ckpt_2" in out
 
 
+def test_llama_generation_example_smoke():
+    out = _run([sys.executable, os.path.join(EX, "jax_llama_generation.py"),
+                "--model", "tiny", "--prompt-len", "8",
+                "--max-new-tokens", "8", "--batch-size", "2"])
+    assert "decode tokens/sec" in out
+
+
 def test_vit_example_smoke():
     out = _run([sys.executable, os.path.join(EX, "jax_vit_training.py"),
                 "--model", "tiny", "--batch-per-chip", "2", "--steps", "4",

@@ -374,3 +374,106 @@ def test_tensor_parallel_specs_match_data_parallel():
     np.testing.assert_allclose(dp_losses[0], tp_losses[0], rtol=1e-3)
     np.testing.assert_allclose(dp_losses, tp_losses, rtol=5e-2)
     assert tp_losses[-1] < tp_losses[0]
+
+
+def test_kv_cache_decode_matches_full_forward():
+    # Greedy decoding through the static-shape KV cache must reproduce the
+    # no-cache path exactly: token-by-token full forwards over the growing
+    # sequence pick the same argmax at every step. f32 so numerics can't
+    # flip a tie between the two einsum orders.
+    import dataclasses
+
+    from horovod_tpu.models import generate
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    prompt = _ids((2, 5), seed=3)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+
+    n_new = 6
+    out = generate(model, variables, prompt, max_new_tokens=n_new)
+    assert out.shape == (2, 5 + n_new)
+    np.testing.assert_array_equal(np.asarray(out[:, :5]), np.asarray(prompt))
+
+    seq = prompt
+    for _ in range(n_new):
+        logits = model.apply(variables, seq)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_kv_cache_logits_match_full_forward():
+    # Prefill + one decode step: the cached-path logits equal the full
+    # forward's logits at the same positions (masked window softmax ==
+    # prefix softmax; exp(-inf) is exactly 0).
+    import dataclasses
+
+    from horovod_tpu.models import init_kv_cache
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    ids = _ids((2, 8), seed=4)
+    variables = model.init(jax.random.PRNGKey(0), ids)
+
+    full = model.apply(variables, ids)
+    cache = init_kv_cache(cfg, 2, 16)
+    pre, cache = model.apply(variables, ids[:, :7], cache=cache,
+                             cache_index=0)
+    np.testing.assert_allclose(np.asarray(pre), np.asarray(full[:, :7]),
+                               rtol=1e-5, atol=1e-5)
+    step, cache = model.apply(variables, ids[:, 7:8], cache=cache,
+                              cache_index=7)
+    np.testing.assert_allclose(np.asarray(step[:, 0]), np.asarray(full[:, 7]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_generate_sampling_and_validation():
+    from horovod_tpu.models import generate
+
+    model = LlamaLM(LLAMA_TINY)
+    prompt = _ids((1, 4), seed=5)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+
+    # Temperature sampling: deterministic under a fixed key, right shape,
+    # in-vocab tokens.
+    a = generate(model, variables, prompt, max_new_tokens=3, temperature=0.8,
+                 rng=jax.random.PRNGKey(7))
+    b = generate(model, variables, prompt, max_new_tokens=3, temperature=0.8,
+                 rng=jax.random.PRNGKey(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert a.shape == (1, 7)
+    assert int(jnp.max(a)) < LLAMA_TINY.vocab_size
+
+    import pytest
+
+    with pytest.raises(ValueError, match="rng"):
+        generate(model, variables, prompt, max_new_tokens=2, temperature=1.0)
+    with pytest.raises(ValueError, match="exceeds"):
+        generate(model, variables, prompt, max_new_tokens=4, max_len=6)
+    # Single-token path (no scan).
+    one = generate(model, variables, prompt, max_new_tokens=1)
+    assert one.shape == (1, 5)
+
+
+def test_generate_zero_tokens_and_temperature_shares_compile():
+    from horovod_tpu.models import generate
+    from horovod_tpu.models.llama import _decode
+
+    model = LlamaLM(LLAMA_TINY)
+    prompt = _ids((1, 4), seed=6)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+
+    # max_new_tokens=0 is a no-op, not an extra token.
+    out = generate(model, variables, prompt, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+    # Temperature is a TRACED operand: sweeping values must not recompile
+    # the decode program (greedy/sampling is the only static split).
+    before = _decode._cache_size()
+    generate(model, variables, prompt, max_new_tokens=2, temperature=0.7,
+             rng=jax.random.PRNGKey(0))
+    one = _decode._cache_size()
+    generate(model, variables, prompt, max_new_tokens=2, temperature=1.3,
+             rng=jax.random.PRNGKey(0))
+    assert _decode._cache_size() == one > before
