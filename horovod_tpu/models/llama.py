@@ -185,6 +185,20 @@ def _cached_attention(q, k, v, cache, cache_index):
     return ctx, {"k": k_cache, "v": v_cache}
 
 
+def attention_sublayer(cfg, attention_fn, x, positions, cache, cache_index):
+    """Pre-norm attention + residual, shared by ``LlamaBlock`` and
+    ``MoeBlock`` so ONE place owns the cache protocol (plain function:
+    flax submodules created here live in the calling module's compact
+    scope, keeping the param names ``attention_norm``/``attention``).
+    Returns ``(x, new_cache_or_None)``."""
+    attn_in = RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x)
+    attn = LlamaAttention(cfg, attention_fn=attention_fn, name="attention")
+    if cache is None:
+        return x + attn(attn_in, positions), None
+    a, new_cache = attn(attn_in, positions, cache, cache_index)
+    return x + a, new_cache
+
+
 class LlamaBlock(nn.Module):
     config: LlamaConfig
     attention_fn: Optional[Callable] = None
@@ -192,15 +206,8 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, positions=None, cache=None, cache_index=None):
         cfg = self.config
-        attn_in = RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x)
-        attn = LlamaAttention(cfg, attention_fn=self.attention_fn,
-                              name="attention")
-        new_cache = None
-        if cache is None:
-            x = x + attn(attn_in, positions)
-        else:
-            a, new_cache = attn(attn_in, positions, cache, cache_index)
-            x = x + a
+        x, new_cache = attention_sublayer(cfg, self.attention_fn, x,
+                                          positions, cache, cache_index)
         h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
         dense = lambda f, name: nn.Dense(  # noqa: E731
             f, use_bias=False, dtype=cfg.dtype, param_dtype=jnp.float32,
@@ -261,12 +268,13 @@ class LlamaLM(nn.Module):
         return logits if cache is None else (logits, new_cache)
 
 
-def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
-                  dtype=None):
+def init_kv_cache(cfg, batch_size: int, max_len: int, dtype=None):
     """Static-shape per-layer K/V cache for autoregressive decoding:
     ``{layer_i: {"k"/"v": (B, max_len, num_kv_heads, head_dim)}}``. GQA
     pays off directly here: the cache holds ``num_kv_heads`` rows, an
-    H/Hkv memory saving over repeating K/V (the reason GQA exists)."""
+    H/Hkv memory saving over repeating K/V (the reason GQA exists).
+    ``cfg`` is any config with dim/num_heads/num_kv_heads/num_layers
+    (``LlamaConfig`` or ``MoeConfig``)."""
     dtype = dtype or cfg.dtype
     head_dim = cfg.dim // cfg.num_heads
     shape = (batch_size, max_len, cfg.num_kv_heads, head_dim)
@@ -277,12 +285,14 @@ def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int,
     }
 
 
-def generate(model: "LlamaLM", variables, prompt_ids, max_new_tokens: int,
+def generate(model, variables, prompt_ids, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
              rng=None):
     """Autoregressive decoding with the KV cache: prefill the prompt in one
     call, then ``lax.scan`` single-token steps — the whole loop is two
     compiled programs regardless of length (no per-token dispatch).
+    ``model`` is any causal LM with the cache call contract (``LlamaLM``,
+    ``MoeLM``).
 
     ``temperature`` 0.0 = greedy argmax (default); > 0 samples from
     ``softmax(logits / temperature)`` using ``rng``. Returns
@@ -294,7 +304,10 @@ def generate(model: "LlamaLM", variables, prompt_ids, max_new_tokens: int,
     cfg = model.config
     b, s = prompt_ids.shape
     if max_len is None:
-        max_len = min(cfg.max_seq_len, s + max_new_tokens)
+        # MoeConfig has no max_seq_len (RoPE-only positions); cap on it
+        # only where the config declares one.
+        max_len = min(getattr(cfg, "max_seq_len", s + max_new_tokens),
+                      s + max_new_tokens)
     if s + max_new_tokens > max_len:
         raise ValueError(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "

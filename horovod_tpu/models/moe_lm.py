@@ -90,6 +90,9 @@ class MoeFFN(nn.Module):
     config: MoeConfig
     expert_axis: Optional[str] = None
     local_experts: Optional[int] = None
+    # Decode mode: capacity covers the all-tokens-to-one-expert worst case
+    # (cf = E/k) so no assignment is ever dropped — see MoeBlock.
+    no_drop: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -118,7 +121,9 @@ class MoeFFN(nn.Module):
             h = nn.silu(t @ p["wi"].astype(cfg.dtype))
             return h @ p["wo"].astype(cfg.dtype)
 
-        kwargs = dict(capacity_factor=cfg.capacity_factor,
+        capacity_factor = (cfg.num_experts / cfg.num_selected
+                           if self.no_drop else cfg.capacity_factor)
+        kwargs = dict(capacity_factor=capacity_factor,
                       num_selected=cfg.num_selected)
         if self.expert_axis is None:
             y, aux = moe_apply_dense(expert_fn, experts,
@@ -142,16 +147,24 @@ class MoeBlock(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None, cache_index=None):
         cfg = self.config
-        x = x + LlamaAttention(cfg.llama(), attention_fn=self.attention_fn,
-                               name="attention")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, name="attention_norm")(x),
-            positions)
+        from .llama import attention_sublayer
+
+        x, new_cache = attention_sublayer(cfg.llama(), self.attention_fn, x,
+                                          positions, cache, cache_index)
         h = RMSNorm(cfg.norm_eps, cfg.dtype, name="ffn_norm")(x)
-        return x + MoeFFN(cfg, expert_axis=self.expert_axis,
-                          local_experts=self.local_experts,
-                          name="moe_ffn")(h)
+        # Decode runs the experts at NO-DROP capacity (cf = E/k covers the
+        # all-tokens-to-one-expert worst case): training-time capacity
+        # drops are a throughput/regularization tradeoff computed from the
+        # per-CALL token pool, and a single-token decode step's tiny pool
+        # would bind capacity differently from the training forward —
+        # dropping tokens at inference is never the right trade.
+        out = x + MoeFFN(cfg, expert_axis=self.expert_axis,
+                         local_experts=self.local_experts,
+                         no_drop=cache is not None,
+                         name="moe_ffn")(h)
+        return out if cache is None else (out, new_cache)
 
 
 class MoeLM(nn.Module):
@@ -173,21 +186,46 @@ class MoeLM(nn.Module):
     attention_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, return_hidden=False):
+    def __call__(self, input_ids, positions=None, return_hidden=False,
+                 cache=None, cache_index=None):
         """``positions``: global token positions of the local rows (see
         ``LlamaLM.__call__``) — required under sequence parallelism.
         ``return_hidden``: skip the lm_head and return the final-norm
         hidden states — pair with ``models.chunked_causal_lm_loss``
-        (same contract as ``LlamaLM``)."""
+        (same contract as ``LlamaLM``).
+        ``cache``/``cache_index``: autoregressive decoding, same contract
+        as ``LlamaLM`` (``models.llama.generate`` works on this model too;
+        aux-loss sow is a no-op outside a mutable collection). Decode runs
+        the experts at NO-DROP capacity (see ``MoeBlock``): capacity is
+        otherwise computed from the per-call token pool, so a single-token
+        step would drop different assignments than a full forward. Decode
+        therefore matches a full forward exactly WHEN the full forward's
+        own capacity doesn't bind; under training-config capacity drops
+        the two can legitimately diverge (the drop is a training
+        artifact)."""
         cfg = self.config
+        if cache is not None and positions is None:
+            positions = cache_index + jnp.arange(input_ids.shape[1])
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
+        new_cache = {}
         moe_cls = nn.remat(MoeBlock) if cfg.remat else MoeBlock
         dense_cls = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
         for i in range(cfg.num_layers):
             # Every moe_every-th layer is routed (moe_every=1: all layers);
             # the rest are plain LlamaBlocks (shared implementation).
-            if i % cfg.moe_every == cfg.moe_every - 1:
+            routed = i % cfg.moe_every == cfg.moe_every - 1
+            if cache is not None:
+                # Decoding never needs remat (no backward pass).
+                cls = MoeBlock if routed else LlamaBlock
+                kwargs = (dict(expert_axis=self.expert_axis,
+                               local_experts=self.local_experts)
+                          if routed else {})
+                x, new_cache[f"layer_{i}"] = cls(
+                    cfg if routed else cfg.llama(),
+                    attention_fn=self.attention_fn, name=f"layer_{i}",
+                    **kwargs)(x, positions, cache[f"layer_{i}"], cache_index)
+            elif routed:
                 x = moe_cls(cfg, expert_axis=self.expert_axis,
                             local_experts=self.local_experts,
                             attention_fn=self.attention_fn,
@@ -200,6 +238,7 @@ class MoeLM(nn.Module):
             return x
         # Head matmul in head_dtype (default: model compute dtype),
         # matching LlamaLM — see LlamaConfig.head_dtype.
-        return nn.Dense(cfg.vocab_size, use_bias=False,
-                        dtype=cfg.head_dtype or cfg.dtype,
-                        param_dtype=jnp.float32, name="lm_head")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False,
+                          dtype=cfg.head_dtype or cfg.dtype,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits if cache is None else (logits, new_cache)
