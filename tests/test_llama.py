@@ -477,3 +477,31 @@ def test_generate_zero_tokens_and_temperature_shares_compile():
     generate(model, variables, prompt, max_new_tokens=2, temperature=1.3,
              rng=jax.random.PRNGKey(0))
     assert _decode._cache_size() == one > before
+
+
+def test_generate_tensor_parallel_matches_single_device():
+    # Multi-chip INFERENCE: generate() with params device_put under the
+    # Megatron TP specs (llama_tp_param_specs) — GSPMD propagates the
+    # shardings through prefill + scan and inserts the per-block psums —
+    # must emit the same greedy tokens as replicated params. f32 so
+    # reduction order can't flip an argmax tie.
+    import dataclasses
+
+    from jax.sharding import Mesh, NamedSharding
+
+    from horovod_tpu.models import generate, llama_tp_param_specs
+
+    cfg = dataclasses.replace(LLAMA_TINY, dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    prompt = _ids((2, 4), seed=11)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+    base = generate(model, variables, prompt, max_new_tokens=5)
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("model",))
+    specs = llama_tp_param_specs(variables["params"], axis="model")
+    sharded = {"params": jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        variables["params"], specs)}
+    with mesh:
+        tp = generate(model, sharded, prompt, max_new_tokens=5)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(tp))
