@@ -22,12 +22,18 @@ from horovod_tpu.models import (
     LLAMA_300M,
     LLAMA_8B,
     LLAMA_TINY,
+    MOE_SMALL,
+    MOE_TINY,
     LlamaLM,
+    MoeLM,
     generate,
 )
 
-CONFIGS = {"tiny": LLAMA_TINY, "300m": LLAMA_300M, "1b": LLAMA_1B,
-           "8b": LLAMA_8B}
+# MoE configs decode through the same generate() (no-drop expert
+# capacity — see models.moe_lm.MoeBlock).
+CONFIGS = {"tiny": (LlamaLM, LLAMA_TINY), "300m": (LlamaLM, LLAMA_300M),
+           "1b": (LlamaLM, LLAMA_1B), "8b": (LlamaLM, LLAMA_8B),
+           "moe-tiny": (MoeLM, MOE_TINY), "moe-small": (MoeLM, MOE_SMALL)}
 
 
 def main():
@@ -41,8 +47,8 @@ def main():
                         help="orbax checkpoint dir of model params")
     args = parser.parse_args()
 
-    cfg = CONFIGS[args.model]
-    model = LlamaLM(cfg)
+    model_cls, cfg = CONFIGS[args.model]
+    model = model_cls(cfg)
     rng = np.random.RandomState(0)
     prompt = jnp.asarray(rng.randint(
         0, cfg.vocab_size, (args.batch_size, args.prompt_len)), jnp.int32)
@@ -53,6 +59,10 @@ def main():
         variables = ocp.PyTreeCheckpointer().restore(args.checkpoint)
     else:
         variables = model.init(jax.random.PRNGKey(0), prompt[:, :8])
+    if model_cls is MoeLM:
+        # Apply with params only: a stale init-time aux_loss collection
+        # must not ride along (MoeLM docstring).
+        variables = {"params": variables["params"]}
 
     kwargs = dict(max_new_tokens=args.max_new_tokens,
                   temperature=args.temperature,
