@@ -78,7 +78,9 @@ def main():
     dt = time.perf_counter() - t0
 
     new_tokens = args.batch_size * args.max_new_tokens
-    print(f"llama-{args.model} prompt={args.prompt_len} b={args.batch_size}: "
+    label = args.model if model_cls is MoeLM else f"llama-{args.model}"
+    print(f"{label} prompt={args.prompt_len} "
+          f"b={args.batch_size}: "
           f"{new_tokens / dt:.0f} decode tokens/sec "
           f"({args.max_new_tokens / dt:.1f} tok/s/sequence), "
           f"sample ids {np.asarray(out[0, args.prompt_len:args.prompt_len + 8])}")
