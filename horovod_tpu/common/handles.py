@@ -14,7 +14,8 @@ from typing import Any, Dict, Optional
 
 
 class Handle:
-    __slots__ = ("_id", "_event", "_result", "_error", "_manager")
+    __slots__ = ("_id", "_event", "_result", "_error", "_manager",
+                 "tensor_sizes")
 
     def __init__(self, handle_id: int, manager: "HandleManager"):
         self._id = handle_id
@@ -22,6 +23,12 @@ class Handle:
         self._result: Any = None
         self._error: Optional[BaseException] = None
         self._manager = manager
+        # For allgather handles: every rank's first-dim size from the
+        # negotiated Response (reference Response.tensor_sizes, carried to
+        # the adapter via TensorShape in torch/adapter_v2.cc:91-102) — so
+        # autograd backward can locate this rank's slice WITHOUT a second
+        # sizes-allgather. None for other ops / size-1 fast paths.
+        self.tensor_sizes: Optional[list] = None
 
     @property
     def id(self) -> int:

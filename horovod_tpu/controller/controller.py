@@ -733,6 +733,10 @@ class Controller:
     def _execute_allgather(self, entry: _Pending, response: Response) -> None:
         dtype = entry.array.dtype
         rest = entry.array.shape[1:]
+        # Expose the negotiated per-rank first dims on the handle: callers
+        # (torch autograd backward) locate their slice locally instead of
+        # paying a second sizes-allgather per call.
+        entry.handle.tensor_sizes = [int(s) for s in response.tensor_sizes]
         if self._use_hierarchical(dtype, self._hier_allgather):
             # Two-level: gather inside the node, local roots exchange node
             # blobs over the cross ring, fan the full result back out

@@ -56,7 +56,7 @@ class NativeHandle:
     resolved and released."""
 
     __slots__ = ("_ctl", "_id", "_postprocess", "_result", "_error",
-                 "_taken", "_buffer")
+                 "_taken", "_buffer", "tensor_sizes")
 
     def __init__(self, ctl: "NativeController", handle_id: int,
                  postprocess: Optional[Callable[[np.ndarray], Any]],
@@ -68,6 +68,10 @@ class NativeHandle:
         self._error: Optional[BaseException] = None
         self._taken = False
         self._buffer = buffer
+        # Allgather: every rank's negotiated first-dim size (see
+        # common.handles.Handle.tensor_sizes); filled at wait() from the
+        # engine slot. None for other ops.
+        self.tensor_sizes = None
 
     @classmethod
     def failed(cls, exc: BaseException) -> "NativeHandle":
@@ -79,6 +83,7 @@ class NativeHandle:
         h._error = exc
         h._taken = True
         h._buffer = None
+        h.tensor_sizes = None
         return h
 
     def done(self) -> bool:
@@ -122,6 +127,12 @@ class NativeHandle:
                     if out.nbytes:
                         lib.hvd_eng_result_copy(
                             self._id, out.ctypes.data_as(ctypes.c_void_p))
+                    nsz = lib.hvd_eng_result_sizes_count(self._id)
+                    if nsz > 0:
+                        sizes_arr = (ctypes.c_longlong * nsz)()
+                        lib.hvd_eng_result_sizes(self._id, sizes_arr)
+                        self.tensor_sizes = [int(sizes_arr[i])
+                                             for i in range(nsz)]
                 if self._postprocess is not None:
                     out = self._postprocess(out)
                 self._result = out

@@ -210,6 +210,11 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_eng_result_shape.restype = None
         lib.hvd_eng_result_copy.argtypes = [ctypes.c_longlong, ctypes.c_void_p]
         lib.hvd_eng_result_copy.restype = ctypes.c_int
+        lib.hvd_eng_result_sizes_count.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_result_sizes_count.restype = ctypes.c_int
+        lib.hvd_eng_result_sizes.argtypes = [
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_eng_result_sizes.restype = None
         lib.hvd_eng_handle_error.argtypes = [ctypes.c_longlong]
         lib.hvd_eng_handle_error.restype = ctypes.c_char_p
         lib.hvd_eng_release.argtypes = [ctypes.c_longlong]

@@ -139,6 +139,11 @@ struct HandleSlot {
   bool in_place = false;
   std::vector<int64_t> shape;
   std::vector<uint8_t> data;
+  // Allgather only: every rank's first-dim size from the negotiated
+  // Response, so the API layer can locate a rank's slice without a second
+  // sizes collective (the reference surfaces the same via TensorShape,
+  // torch/adapter_v2.cc:91-102).
+  std::vector<int64_t> tensor_sizes;
 };
 
 // Tensor-table entry (reference TensorTableEntry, common/common.h:167-184).
@@ -791,7 +796,8 @@ class Engine {
   }
 
   void complete(Entry* e, std::vector<int64_t> shape,
-                std::vector<uint8_t> data) {
+                std::vector<uint8_t> data,
+                std::vector<int64_t> tensor_sizes = {}) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = handles_.find(e->handle);
     if (it == handles_.end()) return;
@@ -799,6 +805,7 @@ class Engine {
     it->second.dtype = e->request.dtype;
     it->second.shape = std::move(shape);
     it->second.data = std::move(data);
+    it->second.tensor_sizes = std::move(tensor_sizes);
   }
 
   // Result already lives in the caller's buffer: no bytes cross the ABI.
@@ -1002,7 +1009,7 @@ class Engine {
     for (int64_t s : response.tensor_sizes) dim0 += s;
     shape[0] = dim0;
     long long nbytes = (long long)out.size();
-    complete(&e, std::move(shape), std::move(out));
+    complete(&e, std::move(shape), std::move(out), response.tensor_sizes);
     return nbytes;
   }
 
@@ -1260,6 +1267,20 @@ void hvd_eng_result_shape(long long h, long long* out) {
   auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
   if (!s) return;
   for (size_t i = 0; i < s->shape.size(); i++) out[i] = s->shape[i];
+}
+
+// Allgather: number of ranks in the negotiated per-rank first-dim list
+// (0 for other ops), and the list itself.
+int hvd_eng_result_sizes_count(long long h) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  return s ? (int)s->tensor_sizes.size() : -1;
+}
+
+void hvd_eng_result_sizes(long long h, long long* out) {
+  auto* s = hvd::g_engine ? hvd::g_engine->slot(h) : nullptr;
+  if (!s) return;
+  for (size_t i = 0; i < s->tensor_sizes.size(); i++)
+    out[i] = s->tensor_sizes[i];
 }
 
 int hvd_eng_result_copy(long long h, void* dst) {

@@ -242,17 +242,18 @@ class _AllgatherFunction(torch.autograd.Function):
     @staticmethod
     def forward(ctx, tensor, name):
         ctx.dim0 = tensor.shape[0]
-        result = synchronize(allgather_async(tensor, name))
+        handle = allgather_async(tensor, name)
+        result = synchronize(handle)
         # Ranks may contribute different dim-0 sizes (reference supports
-        # variable first dims); gather them so backward can locate this
-        # rank's segment. One extra tiny collective, unconditional on every
-        # rank so the schedules stay aligned.
-        if _size() > 1:
-            sizes = synchronize(
-                allgather_async(torch.tensor([tensor.shape[0]])))
+        # variable first dims). The negotiated Response already carries
+        # every rank's first dim and the controller exposes it on the
+        # handle — backward locates this rank's segment locally, with no
+        # second sizes-allgather (the reference reads the same sizes off
+        # the response, torch/adapter_v2.cc:91-102).
+        if handle.tensor_sizes is not None:
             rank = basics.state().topology.rank
-            ctx.offset = int(sizes[:rank].sum())
-        else:
+            ctx.offset = int(sum(handle.tensor_sizes[:rank]))
+        else:  # size-1 fast path resolves without a Response
             ctx.offset = 0
         return result
 

@@ -455,6 +455,28 @@ def scenario_torch(rank, size):
     np.testing.assert_allclose(g_in.grad.numpy(),
                                np.full((rank + 1, 2), float(size)))
 
+    # Exactly ONE collective per autograd allgather: backward's slice
+    # offset comes from the negotiated Response's tensor_sizes on the
+    # handle, not a second sizes-allgather (reference gets the sizes from
+    # the response too, torch/adapter_v2.cc:91-102).
+    import horovod_tpu.torch.mpi_ops as tops
+    gather_calls = []
+    orig_ag = tops.allgather_async
+    tops.allgather_async = (
+        lambda *a, **k: (gather_calls.append(1), orig_ag(*a, **k))[1])
+    try:
+        g_cnt = torch.full((rank + 1, 2), float(rank), requires_grad=True)
+        out_cnt = thvd.allgather(g_cnt, name="tt.gather.count")
+        expect(len(gather_calls) == 1,
+               f"autograd allgather issued {len(gather_calls)} gathers")
+        out_cnt.sum().backward()
+        expect(len(gather_calls) == 1,
+               f"backward issued {len(gather_calls) - 1} extra gathers")
+    finally:
+        tops.allgather_async = orig_ag
+    np.testing.assert_allclose(g_cnt.grad.numpy(),
+                               np.full((rank + 1, 2), float(size)))
+
     bc = thvd.broadcast(x, root_rank=size - 1, name="tt.bc")
     np.testing.assert_allclose(bc.numpy(), np.arange(8) + size - 1)
 
