@@ -33,6 +33,7 @@ number; ResNet-101 is ~1.7x the FLOPs of ResNet-50 — noted, not hidden).
 import json
 import os
 import signal
+import statistics
 import subprocess
 import sys
 import tempfile
@@ -49,7 +50,7 @@ BATCH_PER_CHIP = 128
 IMAGE_SIZE = 224
 WARMUP = 3
 ITERS = 10
-WINDOWS = 5  # report best + spread: tunnel noise is one-sided (slow-only)
+WINDOWS = 5  # headline = median; best + spread also reported (noise is slow-only)
 
 # Supervisor knobs (seconds). Budget covers all probes, attempts, backoffs.
 TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET_S", "1740"))
@@ -164,11 +165,12 @@ def child_bench(status_path):
     signal.alarm(0)
     _phase(status_path, "measure")
 
-    # Best of WINDOWS windows, spread reported: the tunnel adds run-to-run
-    # noise that only ever slows a window down, so the fastest window is
-    # the closest estimate of the chip's actual throughput, and the spread
-    # bounds how much of any round-over-round delta is noise (round-3
-    # verdict item #2).
+    # MEDIAN of WINDOWS windows is the headline (round-4 verdict item #5:
+    # best-of reads high inside the tunnel's ~8% noise band). The tunnel's
+    # noise is one-sided — it only ever slows a window down — so the
+    # fastest window stays reported as best_window (closest estimate of
+    # the chip's un-noised throughput) and the spread bounds how much of
+    # any round-over-round delta is noise.
     window_rates = []
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
@@ -178,7 +180,7 @@ def child_bench(status_path):
         float(loss)
         window_rates.append(batch * ITERS / (time.perf_counter() - t0))
 
-    per_chip = max(window_rates) / n
+    per_chip = statistics.median(window_rates) / n
     spread_pct = 100.0 * (max(window_rates) - min(window_rates)) \
         / max(window_rates)
     _phase(status_path, "ok")
@@ -190,6 +192,7 @@ def child_bench(status_path):
         "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_CHIP, 3),
         "batch_per_chip": BATCH_PER_CHIP,
         "windows": [round(r / n, 1) for r in window_rates],
+        "best_window": round(max(window_rates) / n, 2),
         "window_spread_pct": round(spread_pct, 2),
     }), flush=True)
 
