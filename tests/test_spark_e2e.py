@@ -1,0 +1,84 @@
+"""``horovod_tpu.spark.run`` end to end over process-backed fake executors.
+
+The reference's ``test/test_spark.py:1-110`` runs ``horovod.spark.run`` on
+real local Spark; pyspark + a JVM are environmentally unavailable here
+(verified — tests/test_spark.py docstring), so this drives the SAME code
+path — ``spark/__init__.py::run`` past the import guard: driver service
+startup, closure shipping via cloudpickle, ``parallelize/
+mapPartitionsWithIndex/collect``, per-task registration + env wiring, real
+``hvd.init()`` per executor PROCESS, collectives across executors, rank-
+ordered result collection — with ``tests/fake_pyspark.py`` standing in for
+the Spark runtime (process-per-partition, cloudpickled closures: the same
+execution semantics local Spark provides).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import pytest
+
+
+@pytest.fixture
+def spark_ctx(monkeypatch):
+    import tests.fake_pyspark as fake
+
+    monkeypatch.setitem(sys.modules, "pyspark", fake)
+    # horovod_tpu.spark resolves SparkContext at call time via
+    # ``from pyspark import SparkContext`` — the monkeypatched module
+    # serves it. Fresh context per test; stop() clears the active slot.
+    sc = fake.SparkContext("local[2]")
+    yield sc
+    sc.stop()
+
+
+def _train_fn(scale):
+    """What a user ships to ``spark.run``: init, collectives, a result.
+    Defined at module level ONLY for readability — cloudpickle serializes
+    it by value, the executors never import this test module."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    summed = hvd.allreduce(np.arange(4, dtype=np.float32) + rank,
+                           average=False, name="spark.sum")
+    gathered = hvd.allgather(
+        np.full((rank + 1, 2), float(rank), np.float32), name="spark.gather")
+    hvd.shutdown()
+    return {"rank": rank, "size": size, "scale": scale,
+            "sum": np.asarray(summed).tolist(),
+            "gather_rows": int(np.asarray(gathered).shape[0])}
+
+
+def test_spark_run_end_to_end(spark_ctx):
+    import horovod_tpu.spark as hs
+
+    results = hs.run(_train_fn, args=(7,))
+    assert len(results) == 2
+    # Rank-ordered collection (reference spark/__init__.py:223-227).
+    assert [r["rank"] for r in results] == [0, 1]
+    assert all(r["size"] == 2 for r in results)
+    assert all(r["scale"] == 7 for r in results)
+    # allreduce(sum) over ranks {0, 1}: arange + arange+1.
+    assert results[0]["sum"] == [1.0, 3.0, 5.0, 7.0]
+    assert results[0]["sum"] == results[1]["sum"]
+    # Variable-first-dim allgather: 1 + 2 rows.
+    assert all(r["gather_rows"] == 3 for r in results)
+
+
+def test_spark_run_num_proc_overrides_parallelism(spark_ctx):
+    import horovod_tpu.spark as hs
+
+    results = hs.run(_train_fn, args=(0,), num_proc=3)
+    assert [r["rank"] for r in results] == [0, 1, 2]
+    assert all(r["size"] == 3 for r in results)
+
+
+def test_spark_run_requires_active_context(spark_ctx):
+    import horovod_tpu.spark as hs
+
+    spark_ctx.stop()
+    with pytest.raises(RuntimeError, match="no active SparkContext"):
+        hs.run(_train_fn, args=(0,))
