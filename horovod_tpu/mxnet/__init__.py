@@ -194,16 +194,16 @@ def DistributedEvalMetric(base):
     assert issubclass(base, mx.metric.EvalMetric)
 
     def _gather_per_rank(tensor, name):
-        # Stable names (vs autonames) keep these allgathers eligible for the
+        # Stable names (vs autonames) keep this allgather eligible for the
         # response cache's bitvector fast path instead of evicting training
         # entries with one-shot keys; sequential batches may reuse them.
+        # ONE collective: the per-rank first dims ride the negotiated
+        # Response on the handle (Handle.tensor_sizes), so no separate
+        # dims-allgather is needed to split the result.
         arr = np.ascontiguousarray(tensor.asnumpy())
-        ctl = _controller()
-        dims = np.asarray(ctl.allgather(
-            np.array([arr.shape[0]], dtype=np.int64),
-            name=f"{name}.dims")).reshape(-1)
-        gathered = np.asarray(ctl.allgather(arr, name=f"{name}.data"))
-        splits = np.cumsum(dims)[:-1]
+        handle = _controller().allgather_async(arr, name=f"{name}.data")
+        gathered = np.asarray(handle.wait())
+        splits = np.cumsum(handle.tensor_sizes)[:-1]
         return [mx.nd.array(chunk, dtype=arr.dtype)
                 for chunk in np.split(gathered, splits)]
 

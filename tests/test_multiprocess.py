@@ -253,6 +253,10 @@ def test_star_data_plane(scenario):
     # TF on the Python controller = the tf.py_function fallback path (the
     # native-engine run of this scenario rides the custom op instead).
     "tensorflow",
+    # torch/mxnet re-run here so the Handle.tensor_sizes plumbing (one
+    # collective per autograd allgather; metric gather split) is covered on
+    # BOTH data planes, not just the native engine's slot accessors.
+    "torch", "mxnet",
 ])
 def test_python_engine(scenario):
     # The Python controller (TCP star control plane) remains selectable via
