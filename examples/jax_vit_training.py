@@ -39,6 +39,21 @@ def main():
                         help="steps excluded from throughput timing")
     parser.add_argument("--base-lr", type=float, default=1e-3)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--attention", choices=["xla", "flash"],
+                        default="xla",
+                        help="attention core: plain XLA softmax (default; "
+                        "wins at ViT's s=197 per the round-5 phase probe) "
+                        "or the streaming flash kernel (auto-pads 197→256)")
+    def _positive(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return v
+
+    parser.add_argument("--steps-per-call", type=_positive, default=1,
+                        help="train steps fused into one dispatched "
+                        "program via lax.scan — amortizes the tunnel's "
+                        "per-dispatch latency on small-step models")
     args = parser.parse_args()
 
     hvd.init()
@@ -51,19 +66,28 @@ def main():
     cfg = CONFIGS[args.model]
     if args.remat:
         cfg = dataclasses.replace(cfg, remat=True)
-    model = VisionTransformer(cfg)
+    attention_fn = None
+    if args.attention == "flash":
+        from horovod_tpu.ops.attention import make_attention_fn
+
+        attention_fn = make_attention_fn(causal=False, use_flash=True)
+    model = VisionTransformer(cfg, attention_fn=attention_fn)
 
     rng = np.random.RandomState(hvd.rank())
+    lead = ((args.steps_per_call, batch) if args.steps_per_call > 1
+            else (batch,))
     x = jnp.asarray(rng.rand(
-        batch, cfg.image_size, cfg.image_size, 3).astype(np.float32))
-    y = jnp.asarray(rng.randint(0, cfg.num_classes, size=(batch,)))
+        *lead, cfg.image_size, cfg.image_size, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, cfg.num_classes, size=lead))
 
     variables = model.init(
         jax.random.PRNGKey(0),
         jnp.ones((1, cfg.image_size, cfg.image_size, 3)),
         deterministic=True)
+    # Warmup counts OPTIMIZER steps: with a device-side step loop each
+    # dispatched call advances steps_per_call of them.
     lr = optax.linear_schedule(args.base_lr / 10, args.base_lr * n,
-                               args.warmup_steps)
+                               args.warmup_steps * args.steps_per_call)
     tx = hvd.DistributedOptimizer(optax.adamw(lr), axis_name="data")
     opt_state = tx.init(variables)
 
@@ -76,16 +100,43 @@ def main():
         updates, s = tx.update(grads, s, v)
         return optax.apply_updates(v, updates), s, hvd.allreduce(loss)
 
+    if args.steps_per_call > 1:
+        inner = train_step
+
+        def train_step(v, s, xb, yb):  # noqa: F811 — deliberate rebind
+            # Device-side data loop: ONE dispatched program consumes K
+            # stacked batches (xb/yb carry a leading K axis), the way a
+            # prefetching input pipeline feeds a device loop. On the
+            # tunneled pool each dispatch costs ms-scale host latency —
+            # at ViT-S's ~26 ms steps that was measured as ~18% of wall
+            # clock (artifacts/vit_ceiling_r5.json).
+            def body(carry, batch):
+                v, s, loss = inner(*carry, *batch)
+                return (v, s), loss
+
+            (v, s), losses = jax.lax.scan(body, (v, s), (xb, yb))
+            return v, s, losses[-1]
+
+    batch_spec = (P(None, "data") if args.steps_per_call > 1
+                  else P("data"))
     step_fn = jax.jit(jax.shard_map(
         train_step, mesh=mesh,
-        in_specs=(P(), P(), P("data"), P("data")),
+        in_specs=(P(), P(), batch_spec, batch_spec),
         out_specs=(P(), P(), P()), check_vma=False),
         donate_argnums=(0, 1))
 
     variables = hvd.parallel.replicate(variables, mesh)
     opt_state = hvd.parallel.replicate(opt_state, mesh)
-    xb = hvd.parallel.shard_batch(x, mesh)
-    yb = hvd.parallel.shard_batch(y, mesh)
+    if args.steps_per_call > 1:
+        # Stacked batches: leading axis is the device-side step loop,
+        # axis 1 is the data-parallel batch.
+        from jax.sharding import NamedSharding
+
+        sh = NamedSharding(mesh, P(None, "data"))
+        xb, yb = jax.device_put(x, sh), jax.device_put(y, sh)
+    else:
+        xb = hvd.parallel.shard_batch(x, mesh)
+        yb = hvd.parallel.shard_batch(y, mesh)
 
     loss = None
     for _ in range(args.warmup_steps):
@@ -106,7 +157,7 @@ def main():
     dt = time.perf_counter() - t0
 
     if hvd.rank() == 0:
-        img_sec = timed * batch / dt
+        img_sec = timed * args.steps_per_call * batch / dt
         print(f"vit-{args.model} {cfg.image_size}px: {img_sec:.0f} img/sec "
               f"({img_sec / n:.0f}/chip), loss={float(loss):.3f}")
 

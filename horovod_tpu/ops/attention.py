@@ -590,8 +590,50 @@ def flash_attention(q, k, v, key_mask=None, causal: bool = False,
     both are clamped/halved to divide the sequence length."""
     if interpret is None:
         interpret = _auto_interpret()
-    b, sk = k.shape[0], k.shape[1]
+    b, sq, sk = k.shape[0], q.shape[1], k.shape[1]
     _check_gqa_heads(q, k, v, "flash_attention")
+    # Awkward sequence lengths (e.g. ViT's 197 = 196 patches + CLS, a
+    # PRIME) would make _fit_block degrade to pathological 1-row blocks.
+    # Auto-pad to the next 128 multiple instead: padded keys are masked
+    # out (fully-masked rows emit zeros), padded query rows are sliced
+    # off, and under causal the q/k pads are equal so the diagonal offset
+    # sk - sq is preserved. TPU pads the S x S tiles to the 128 lane
+    # granule anyway — explicit padding costs little extra compute and
+    # buys the streaming kernel (no S^2 materialization) at any length.
+    def _pad_to(n):
+        return (n + 127) // 128 * 128
+
+    def _degenerate(block, seq):
+        # Pad only when the SEQUENCE is the problem: off the 8-sublane
+        # granule, or its divisors force the fitted block far below the
+        # request (fit == block means the caller asked for that size).
+        fit = _fit_block(block, seq)
+        return seq % 8 != 0 or (fit < block and fit < min(64, seq))
+
+    needs_pad = _degenerate(block_q, sq) or _degenerate(block_k, sk)
+    if needs_pad and (not causal or sq == sk):
+        sqp, skp = _pad_to(sq), _pad_to(sk)
+        if causal:  # keep skp - sqp == sk - sq
+            sqp = skp = max(sqp, skp)
+        # Pad only if it actually improves the block fit — e.g. an
+        # explicit block 48 never divides a 128-multiple either, and
+        # padding would just enlarge the degenerate grid.
+        if not (_fit_block(block_q, sqp) > _fit_block(block_q, sq)
+                or _fit_block(block_k, skp) > _fit_block(block_k, sk)):
+            needs_pad = False
+    if needs_pad and (not causal or sq == sk):
+        mask = (jnp.arange(skp) < sk)[None, :]
+        if key_mask is not None:
+            mask = mask & jnp.pad(key_mask.astype(bool),
+                                  ((0, 0), (0, skp - sk)))
+        mask = jnp.broadcast_to(mask, (b, skp))
+        out = _flash(
+            jnp.pad(q, ((0, 0), (0, sqp - sq), (0, 0), (0, 0))),
+            jnp.pad(k, ((0, 0), (0, skp - sk), (0, 0), (0, 0))),
+            jnp.pad(v, ((0, 0), (0, skp - sk), (0, 0), (0, 0))),
+            mask.astype(jnp.float32), causal, sm_scale, block_q, block_k,
+            interpret, True)
+        return out[:, :sq]
     # has_mask is static: with key_mask=None the kernels skip the mask
     # broadcast/where VPU passes entirely (the placeholder ones-mask
     # still rides along so the custom_vjp arity is fixed).

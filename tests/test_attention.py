@@ -242,6 +242,42 @@ def test_flash_block_fallback_non_divisible():
                                rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("s", [197, 67])
+def test_flash_awkward_seq_auto_pads(s, causal):
+    # Prime / non-tileable sequence lengths (ViT's 197 = 196 patches + CLS)
+    # auto-pad to the next 128 multiple instead of degrading _fit_block to
+    # 1-row blocks; padded keys are masked, padded query rows sliced off.
+    q, k, v = _qkv(seed=5, s=s)
+    ref = reference_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_flash_awkward_seq_auto_pad_grads_and_mask():
+    s = 197
+    q, k, v = _qkv(seed=6, s=s)
+    rng = np.random.RandomState(7)
+    mask = jnp.asarray(rng.rand(B, s) > 0.2)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, key_mask=mask) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, key_mask=mask) ** 2).sum()
+
+    np.testing.assert_allclose(
+        float(loss_flash(q, k, v)), float(loss_ref(q, k, v)),
+        rtol=1e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
+
+
 def test_flash_long_context_32k():
     # The whole point of streaming K/V from HBM via BlockSpec index_maps:
     # S=32k runs with a VMEM working set of O(block) — under the old
