@@ -1,0 +1,148 @@
+"""KV-cache decode per-phase time accounting (round-4 verdict item #3).
+
+Traces ``generate()`` (one jitted prefill + lax.scan decode loop) on the
+real chip and buckets every scheduled op by XLA provenance, separating the
+WHILE-BODY (per-token decode work, divided by the token count) from the
+prefill. Decides whether the ~58%-of-weight-streaming-roofline decode rate
+hides a lever or is structural (``artifacts/decode_ceiling_r5.json``).
+
+Run: python examples/decode_phase_profile.py --model 300m --batch-size 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from horovod_tpu.utils.hlo_phases import (add_to_bucket, finalize_buckets,
+                                          hlo_rows, newest_xplane)
+
+# Ordered; first hit wins, so the SPECIFIC attention-module paths (the
+# q/k/v/o projections, which live INSIDE the flax module named
+# "attention") come before the catch-all attention keys. FFN denses are
+# named w_gate/w_up/w_down directly under layer_{i}; norms are
+# attention_norm/ffn_norm/final_norm (matched before the ffn keys would
+# see "ffn_norm" — norm keys listed first among the two).
+PHASES = (
+    ("cache_update", ("dynamic_update_slice", "dynamic-update-slice")),
+    ("qkvo_proj", ("/wq/", "/wk/", "/wv/", "/wo/")),
+    ("attention_cache", ("/attention/", "flash", "rotary", "dynamic_slice")),
+    ("norm", ("attention_norm", "ffn_norm", "final_norm", "norm")),
+    ("ffn", ("/w_gate/", "/w_up/", "/w_down/", "silu")),
+    ("lm_head_embed", ("lm_head", "embed", "one_hot")),
+    ("sampling", ("argmax", "categorical", "random", "threefry",
+                  "reduce_max", "pick")),
+)
+
+
+def classify(tf_op_name: str) -> str:
+    for phase, keys in PHASES:
+        if any(k in tf_op_name for k in keys):
+            return phase
+    return "other"
+
+
+def capture(model_name: str, batch: int, prompt_len: int, new_tokens: int,
+            trace_dir: str) -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import (LLAMA_1B, LLAMA_300M, LLAMA_TINY,
+                                    LlamaLM)
+    from horovod_tpu.models.llama import generate
+
+    hvd.init()
+    cfg = {"tiny": LLAMA_TINY, "300m": LLAMA_300M,
+           "1b": LLAMA_1B}[model_name]
+    model = LlamaLM(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt_len)),
+                      jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), ids[:, :8])
+    # Warm compile outside the trace.
+    out = generate(model, variables, ids, max_new_tokens=new_tokens)
+    int(out[0, -1])
+    t0 = time.perf_counter()
+    with hvd.profiler.trace(trace_dir):
+        out = generate(model, variables, ids, max_new_tokens=new_tokens)
+        int(out[0, -1])
+    wall = time.perf_counter() - t0
+    print(f"capture b{batch} p{prompt_len} n{new_tokens}: "
+          f"{batch * new_tokens / wall:.0f} tok/s during trace",
+          file=sys.stderr)
+    return newest_xplane(trace_dir)
+
+
+def phase_table(xplane: str, new_tokens: int, dump: bool = False) -> dict:
+    # Two tables: while-body ops (per-token work — amortized over the
+    # scan's new_tokens - 1 iterations) and everything else (prefill +
+    # once-per-call work), reported separately.
+    body = {}
+    prefill = {}
+    body_total = other_total = 0.0
+    iters = max(new_tokens - 1, 1)
+    for row in hlo_rows(xplane):
+        op = row["tf_op_name"]
+        in_body = ("while" in op or "body" in row["hlo_op_name"]
+                   or "scan" in op)
+        phase = classify(op)
+        t_ms = row["self_ms"]
+        if in_body:
+            t_ms /= iters
+            body_total += t_ms
+        else:
+            other_total += t_ms
+        add_to_bucket(body if in_body else prefill, phase, t_ms, row)
+        if dump and t_ms > (0.01 if in_body else 0.3):
+            where = "BODY" if in_body else "pre "
+            print(f"{where} {phase:16s} {t_ms:7.3f}ms "
+                  f"{row['bound_by']:9s} {op[:100]}", file=sys.stderr)
+    return {
+        "decode_ms_per_step": round(body_total, 4),
+        "prefill_plus_once_ms": round(other_total, 2),
+        "decode_phases": finalize_buckets(body),
+        "prefill_phases": finalize_buckets(prefill),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="300m")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--max-new-tokens", type=int, default=256,
+                    help="tokens generated in the capture; ALSO the "
+                    "per-step divisor for while-body times — when "
+                    "analyzing an existing --xplane, pass the value the "
+                    "trace was captured with")
+    ap.add_argument("--trace-dir", default=None)
+    ap.add_argument("--xplane", default=None)
+    ap.add_argument("--dump", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    trace_dir = args.trace_dir or (
+        f"/tmp/decode_trace_{args.model}_b{args.batch_size}")
+    xplane = args.xplane or capture(
+        args.model, args.batch_size, args.prompt_len, args.max_new_tokens,
+        trace_dir)
+    table = phase_table(xplane, args.max_new_tokens, dump=args.dump)
+    out = {"model": args.model, "batch": args.batch_size,
+           "prompt_len": args.prompt_len,
+           "max_new_tokens": args.max_new_tokens, "xplane": xplane, **table}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+    print(json.dumps({
+        k: (v if not k.endswith("phases") else
+            {p: b["ms"] for p, b in v.items()})
+        for k, v in out.items() if k != "xplane"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

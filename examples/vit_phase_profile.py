@@ -13,11 +13,12 @@ Run: python examples/vit_phase_profile.py --model s16 --batch-per-chip 64
 from __future__ import annotations
 
 import argparse
-import glob
 import json
-import os
 import sys
 import time
+
+from horovod_tpu.utils.hlo_phases import (add_to_bucket, finalize_buckets,
+                                          hlo_rows, newest_xplane)
 
 # Ordered: first hit wins. Keys match the jax name-stack in hlo_stats'
 # tf_op_name, e.g. "jit(step)/transpose(jvp(VisionTransformer))/layer_3/
@@ -89,50 +90,23 @@ def capture(model_name: str, batch: int, trace_dir: str,
     wall = time.perf_counter() - t0
     print(f"capture b{batch}: {batch * steps / wall:.0f} img/s during trace",
           file=sys.stderr)
-    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
-                      recursive=True)
-    if not paths:
-        raise RuntimeError(f"no xplane under {trace_dir}")
-    return max(paths, key=os.path.getmtime)  # newest capture wins
+    return newest_xplane(trace_dir)
 
 
 def phase_table(xplane: str, steps: int = 5, dump: bool = False) -> dict:
-    from tensorflow.python.profiler.internal import \
-        _pywrap_profiler_plugin as pp
-
-    data, _ = pp.xspace_to_tools_data([xplane], "hlo_stats", {})
-    d = json.loads(data)
-    cols = {c["id"]: i for i, c in enumerate(d["cols"])}
-
-    def val(row, col):
-        v = row["c"][cols[col]]["v"]
-        return v if v is not None else ""
-
     buckets = {}
     total = 0.0
-    for row in d["rows"]:
-        t_ms = float(val(row, "total_self_time") or 0) / 1e3 / steps
-        if not t_ms:
-            continue
-        op = val(row, "tf_op_name")
+    for row in hlo_rows(xplane):
+        t_ms = row["self_ms"] / steps
+        op = row["tf_op_name"]
         phase = classify(op)
         total += t_ms
-        b = buckets.setdefault(phase, {"ms": 0.0, "ops": 0, "top": []})
-        b["ms"] += t_ms
-        b["ops"] += 1
-        b["top"].append((t_ms, val(row, "hlo_op_name"), op[-90:],
-                         val(row, "bound_by")))
+        add_to_bucket(buckets, phase, t_ms, row)
         if dump and t_ms > 0.1:
-            print(f"{phase:12s} {t_ms:6.2f}ms {val(row, 'bound_by'):8s} "
+            print(f"{phase:12s} {t_ms:6.2f}ms {row['bound_by']:8s} "
                   f"{op[:120]}", file=sys.stderr)
-    for b in buckets.values():
-        b["top"] = [
-            {"ms": round(t, 2), "op": n, "prov": p, "bound_by": bb}
-            for t, n, p, bb in sorted(b["top"], reverse=True)[:4]]
-        b["ms"] = round(b["ms"], 2)
     return {"total_ms_per_step": round(total, 1),
-            "phases": dict(sorted(buckets.items(),
-                                  key=lambda kv: -kv[1]["ms"]))}
+            "phases": finalize_buckets(buckets)}
 
 
 def main() -> int:

@@ -1,0 +1,73 @@
+"""Shared scaffolding for the per-phase device-time profilers
+(``examples/{moe,vit,decode}_phase_profile.py``): newest-xplane discovery,
+the hlo_stats row iterator, and bucket finalization. Each profiler keeps
+only its workload capture and its PHASES provenance table.
+
+The tables these produce are the ceiling artifacts
+(``artifacts/{moe,vit,decode}_ceiling_r*.json``): every scheduled op's
+self-time bucketed by XLA provenance (the jax name stack in
+``tf_op_name``)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Iterator
+
+
+def newest_xplane(trace_dir: str) -> str:
+    """The most recent ``*.xplane.pb`` under ``trace_dir`` (recursive)."""
+    paths = glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise RuntimeError(f"no xplane under {trace_dir}")
+    return max(paths, key=os.path.getmtime)
+
+
+def hlo_rows(xplane: str) -> Iterator[dict]:
+    """Yield one dict per hlo_stats row: ``self_ms`` (total over the whole
+    capture), ``tf_op_name``, ``hlo_op_name``, ``bound_by``,
+    ``occurrences``, ``expression``. Zero-self-time rows are skipped."""
+    from tensorflow.python.profiler.internal import \
+        _pywrap_profiler_plugin as pp
+
+    data, _ = pp.xspace_to_tools_data([xplane], "hlo_stats", {})
+    d = json.loads(data)
+    cols = {c["id"]: i for i, c in enumerate(d["cols"])}
+
+    def val(row, col):
+        v = row["c"][cols[col]]["v"]
+        return v if v is not None else ""
+
+    for row in d["rows"]:
+        t_ms = float(val(row, "total_self_time") or 0) / 1e3
+        if not t_ms:
+            continue
+        yield {
+            "self_ms": t_ms,
+            "tf_op_name": val(row, "tf_op_name"),
+            "hlo_op_name": val(row, "hlo_op_name"),
+            "bound_by": val(row, "bound_by"),
+            "occurrences": val(row, "occurrences"),
+            "expression": val(row, "hlo_op_expression"),
+        }
+
+
+def add_to_bucket(buckets: dict, phase: str, t_ms: float, row: dict) -> None:
+    b = buckets.setdefault(phase, {"ms": 0.0, "ops": 0, "top": []})
+    b["ms"] += t_ms
+    b["ops"] += 1
+    b["top"].append((t_ms, row["hlo_op_name"], row["tf_op_name"][-90:],
+                     row["bound_by"]))
+
+
+def finalize_buckets(buckets: dict, top: int = 4) -> dict:
+    """Round, trim each bucket's op list to the ``top`` slowest, and order
+    buckets by time."""
+    for b in buckets.values():
+        b["top"] = [
+            {"ms": round(t, 4), "op": n, "prov": p, "bound_by": bb}
+            for t, n, p, bb in sorted(b["top"], reverse=True)[:top]]
+        b["ms"] = round(b["ms"], 4)
+    return dict(sorted(buckets.items(), key=lambda kv: -kv[1]["ms"]))
