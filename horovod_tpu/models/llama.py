@@ -12,6 +12,7 @@ heads to their K/V group in the grid — no repeat); others get repeated K/V.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 from typing import Any, Callable, Optional
@@ -155,25 +156,89 @@ class LlamaAttention(nn.Module):
         return out_proj(ctx)
 
 
+# Trace-time switch for the Pallas decode-attention fast path. Default on:
+# the kernel consumes the cache in the default major-to-minor layout, which
+# frees XLA to keep the loop-carried cache d-minor and make the per-step
+# one-row cache write a true in-place update (the XLA formulation forces a
+# seq-minor layout whose one-row update rewrites the whole buffer —
+# artifacts/decode_ceiling_r5.json). generate() disables it when the
+# variables are sharded over a multi-device mesh: GSPMD cannot partition
+# the custom call, while the einsum path shards naturally.
+_DECODE_KERNEL = True
+
+
+@contextlib.contextmanager
+def decode_kernel_disabled():
+    """Within this context, single-token cached attention uses the plain
+    XLA einsum path instead of the Pallas kernel (trace-time static)."""
+    global _DECODE_KERNEL
+    prev = _DECODE_KERNEL
+    _DECODE_KERNEL = False
+    try:
+        yield
+    finally:
+        _DECODE_KERNEL = prev
+
+
 def _cached_attention(q, k, v, cache, cache_index):
     """Decode-mode attention: write the s new K/V rows at ``cache_index``,
     attend every query (global position ``cache_index + i``) over the full
-    static window under ``key_pos <= q_pos`` — one code path covers both
-    prefill (s = prompt length at index 0) and single-token steps. Masked
-    logits hit exp(-inf) = 0 exactly, so the softmax equals the one over
-    only the valid prefix. Grouped-query: queries attend their K/V group
-    directly (no repeated K/V in the cache)."""
+    static window under ``key_pos <= q_pos``. Masked logits hit
+    exp(-inf) = 0 exactly, so the softmax equals the one over only the
+    valid prefix. Grouped-query: queries attend their K/V group directly
+    (no repeated K/V in the cache).
+
+    Three code paths, one semantics: single-token steps ride the Pallas
+    decode kernel (see ``_DECODE_KERNEL`` above — it keeps the carried
+    cache in a layout where the row write is in-place); prefill at static
+    index 0 attends over the FRESH rows so no matmul ever consumes the
+    cache buffers (a dot on them would re-pin the seq-minor layout the
+    kernel path exists to avoid); the general chunked-append form (traced
+    or nonzero index with s > 1) keeps the reference masked-window
+    einsum."""
     b, s, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
+    # The cache is stored ROW-FLAT, (B, L, Hkv*D): the decode kernel then
+    # consumes it with no reshape anywhere near the buffers (an XLA-side
+    # split of the flat axis would re-open the layout question; an
+    # in-kernel split of tiled minor dims is not Mosaic-legal).
+    kc = k.astype(cache["k"].dtype)
+    vc = v.astype(cache["v"].dtype)
     k_cache = jax.lax.dynamic_update_slice(
-        cache["k"], k.astype(cache["k"].dtype), (0, cache_index, 0, 0))
+        cache["k"], kc.reshape(b, s, hkv * d), (0, cache_index, 0))
     v_cache = jax.lax.dynamic_update_slice(
-        cache["v"], v.astype(cache["v"].dtype), (0, cache_index, 0, 0))
+        cache["v"], vc.reshape(b, s, hkv * d), (0, cache_index, 0))
     window = k_cache.shape[1]
     scale = 1.0 / np.sqrt(d)
+    if s == 1 and _DECODE_KERNEL:
+        from ..ops.decode_attention import decode_attention
+
+        ctx = decode_attention(q, k_cache, v_cache, cache_index, hkv,
+                               sm_scale=scale)
+        return ctx, {"k": k_cache, "v": v_cache}
+    if s > 1 and isinstance(cache_index, int) and cache_index == 0:
+        # Prefill at index 0: the valid window IS the fresh rows — no
+        # matmul consumes the cache buffers (their layout must stay
+        # friendly to the decode loop's row writes). Attend over the
+        # CACHE-DTYPE rows (kc/vc), so prefill sees exactly the values
+        # every later decode step reads back — one semantics across
+        # paths even when the cache dtype quantizes.
+        qg = q.reshape(b, s, hkv, group, d)
+        logits = jnp.einsum("bshgd,blhd->bshgl", qg, kc).astype(
+            jnp.float32) * scale
+        causal = (jnp.arange(s)[None, :] <= jnp.arange(s)[:, None])
+        logits = jnp.where(causal[None, :, None, None, :], logits,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bshgl,blhd->bshgd", probs, vc)
+        return ctx.reshape(b, s, h, d), {"k": k_cache, "v": v_cache}
+    # General path (einsum over the 4D view; also the s == 1 path under
+    # multi-device sharding — see _DECODE_KERNEL above).
     qg = q.reshape(b, s, hkv, group, d)
-    logits = jnp.einsum("bshgd,blhd->bshgl", qg, k_cache).astype(
+    k4 = k_cache.reshape(b, window, hkv, d)
+    v4 = v_cache.reshape(b, window, hkv, d)
+    logits = jnp.einsum("bshgd,blhd->bshgl", qg, k4).astype(
         jnp.float32) * scale
     q_pos = cache_index + jnp.arange(s)
     key_pos = jnp.arange(window)
@@ -181,7 +246,7 @@ def _cached_attention(q, k, v, cache, cache_index):
     logits = jnp.where(mask[None, :, None, None, :], logits,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    ctx = jnp.einsum("bshgl,blhd->bshgd", probs, v_cache).reshape(b, s, h, d)
+    ctx = jnp.einsum("bshgl,blhd->bshgd", probs, v4).reshape(b, s, h, d)
     return ctx, {"k": k_cache, "v": v_cache}
 
 
@@ -270,14 +335,17 @@ class LlamaLM(nn.Module):
 
 def init_kv_cache(cfg, batch_size: int, max_len: int, dtype=None):
     """Static-shape per-layer K/V cache for autoregressive decoding:
-    ``{layer_i: {"k"/"v": (B, max_len, num_kv_heads, head_dim)}}``. GQA
-    pays off directly here: the cache holds ``num_kv_heads`` rows, an
-    H/Hkv memory saving over repeating K/V (the reason GQA exists).
-    ``cfg`` is any config with dim/num_heads/num_kv_heads/num_layers
-    (``LlamaConfig`` or ``MoeConfig``)."""
+    ``{layer_i: {"k"/"v": (B, max_len, num_kv_heads * head_dim)}}`` —
+    each position's GQA heads stored ROW-FLAT so the Pallas decode kernel
+    consumes the buffers with no reshape (see ``_cached_attention``; the
+    einsum paths view the flat axis as (Hkv, D)). GQA pays off directly
+    here: the cache holds ``num_kv_heads`` head rows, an H/Hkv memory
+    saving over repeating K/V (the reason GQA exists). ``cfg`` is any
+    config with dim/num_heads/num_kv_heads/num_layers (``LlamaConfig`` or
+    ``MoeConfig``)."""
     dtype = dtype or cfg.dtype
     head_dim = cfg.dim // cfg.num_heads
-    shape = (batch_size, max_len, cfg.num_kv_heads, head_dim)
+    shape = (batch_size, max_len, cfg.num_kv_heads * head_dim)
     return {
         f"layer_{i}": {"k": jnp.zeros(shape, dtype),
                        "v": jnp.zeros(shape, dtype)}
@@ -321,21 +389,51 @@ def generate(model, variables, prompt_ids, max_new_tokens: int,
     # greedy is the only STATIC part of the sampling decision: temperature
     # rides in as a traced operand so a temperature sweep shares one
     # compiled program instead of recompiling the prefill+scan per value.
+    #
+    # The Pallas decode-attention fast path can't be partitioned by GSPMD:
+    # when the variables are sharded over a multi-device mesh (the TP
+    # serving path), trace the einsum form instead — it shards naturally.
+    def _multi_device(leaf):
+        sh = getattr(leaf, "sharding", None)
+        if sh is None:
+            return False
+        try:
+            return (len(sh.device_set) > 1
+                    and not sh.is_fully_replicated)
+        except (AttributeError, TypeError):
+            return True  # unknown sharding type: take the safe path
+
+    sharded = any(
+        _multi_device(leaf)
+        for leaf in jax.tree_util.tree_leaves((variables, prompt_ids)))
     new_tokens = _decode(model, variables, prompt_ids, rng,
                          jnp.float32(temperature), int(max_new_tokens),
-                         int(max_len), temperature <= 0.0)
+                         int(max_len), temperature <= 0.0,
+                         _DECODE_KERNEL and not sharded)
     return jnp.concatenate([prompt_ids, new_tokens], axis=1)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "max_len", "greedy"))
+    static_argnames=("model", "max_new_tokens", "max_len", "greedy",
+                     "use_kernel"))
 def _decode(model, variables, prompt_ids, rng, temperature, max_new_tokens,
-            max_len, greedy):
+            max_len, greedy, use_kernel=True):
     """Compiled decode body. Module-level with the model as a STATIC arg
     (flax modules hash by structure): repeated ``generate`` calls with the
     same model/shapes hit the jit cache — a per-call ``@jax.jit`` closure
-    would recompile the prefill+scan program on every invocation."""
+    would recompile the prefill+scan program on every invocation.
+    ``use_kernel`` is part of the jit cache key (a bare global flag would
+    be ignored on a cache hit)."""
+    ctx = (contextlib.nullcontext() if use_kernel
+           else decode_kernel_disabled())
+    with ctx:
+        return _decode_body(model, variables, prompt_ids, rng, temperature,
+                            max_new_tokens, max_len, greedy)
+
+
+def _decode_body(model, variables, prompt_ids, rng, temperature,
+                 max_new_tokens, max_len, greedy):
     cfg = model.config
     b, s = prompt_ids.shape
 

@@ -1,0 +1,147 @@
+"""Pallas decode-step attention over the KV cache (single-token queries).
+
+WHY A KERNEL: the XLA formulation of cached decode attention forces a
+layout trade-off that costs ~47% of the decode step. Attention reduces
+over the cache's seq axis, so XLA lays the loop-carried cache buffers out
+seq-minor (seq on the 128-lane tile axis) — and then each step's one-row
+``dynamic_update_slice`` read-modify-writes every tile of the buffer, a
+full ~6 MB rewrite per layer per step on Llama-300M
+(``artifacts/decode_ceiling_r5.json``; six XLA-level reformulations were
+measured and none escape it — the layout demand follows the reduction
+wherever it's expressed). A Mosaic kernel consumes its operands in the
+DEFAULT major-to-minor layout, so with the in-loop reads kernelized the
+carried cache keeps its natural d-minor layout and the one-row cache
+write becomes a true in-place row update.
+
+The kernel itself is bandwidth-bound by design: grid = (batch,), each
+program streams its row's K/V window (L, Hkv, D) HBM→VMEM once, does the
+masked-softmax matvecs per K/V head group in VMEM (GQA folds the H/Hkv
+query heads of a group into the tiny N dimension), and writes the (Hkv,
+G, D) context. FLOPs are ~2·L·D·H per program — noise next to the cache
+bytes — so achieving memory-rate streaming IS the roofline.
+
+Used by ``horovod_tpu.models.llama._cached_attention`` for s == 1;
+interpret mode runs the same kernel off-TPU (hermetic CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _auto_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(idx_ref, w_ref, k_ref, v_ref, o_ref, *, hkv: int,
+                   group: int, sm_scale: float):
+    # One program per batch row. ``idx_ref`` is the scalar-prefetched
+    # cache index. Blocks: w (1, hkv*d, h) — the query arranged
+    # BLOCK-DIAGONALLY by the host-side wrapper so ONE MXU pass computes
+    # every head's scores (per-head dots have N = g = 2 and are nearly
+    # all latency: measured ~58 us/layer that way); k/v (1, L, hkv, d)
+    # viewed as (L, hkv*d); out (1, h, d). Everything in-kernel is 2D
+    # with 16- or 512-wide minors (Mosaic-friendly) and reductions run
+    # over axis 0.
+    L = k_ref.shape[1]
+    h = w_ref.shape[2]
+    d = o_ref.shape[2]
+    f = k_ref.shape[2]                                 # hkv * d
+    k2 = k_ref[0]                                      # (L, f)
+    v2 = v_ref[0]
+    # Scores for all heads: (L, f) @ (f, h) — the block-diagonal W zeroes
+    # cross-head terms.
+    s = lax.dot_general(k2, w_ref[0], (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32) * sm_scale
+    valid = lax.broadcasted_iota(jnp.int32, (L, h), 0) <= idx_ref[0]
+    s = jnp.where(valid, s, NEG_INF)
+    m = jnp.max(s, axis=0, keepdims=True)
+    p = jnp.exp(s - m)
+    # Fully-masked columns would emit mean(v); valid always includes
+    # position 0 <= cache_index in the decode contract, but zero the
+    # masked rows anyway so the kernel is safe standalone.
+    p = jnp.where(valid, p, 0.0)
+    # Normalize BEFORE the context product — dividing the (h, d) result
+    # would need a (h, 1)-shaped l, and (1, h) -> (h, 1) is a relayout
+    # Mosaic refuses; p / (1, h) broadcasts cleanly.
+    p = p / jnp.maximum(jnp.sum(p, axis=0, keepdims=True), 1e-30)
+    # Context cross product (h, f), then keep each query head's OWN K/V
+    # head block: rows are query heads (h = kv * group + g), columns are
+    # (kv', d) blocks — zero kv' != h // group, then sum the d-strided
+    # blocks with a tiled-identity selector (in-kernel reshapes that
+    # split/merge the tiled minor dims are not Mosaic-legal).
+    full = lax.dot_general(p.astype(v2.dtype), v2, (((0,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)  # (h, f)
+    own = (lax.broadcasted_iota(jnp.int32, (h, f), 0) // group
+           == lax.broadcasted_iota(jnp.int32, (h, f), 1) // d)
+    sel = (lax.broadcasted_iota(jnp.int32, (f, d), 0) % d
+           == lax.broadcasted_iota(jnp.int32, (f, d), 1))
+    ctx = lax.dot_general(jnp.where(own, full, 0.0),
+                          sel.astype(jnp.float32),
+                          (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)   # (h, d)
+    o_ref[0] = ctx.astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, cache_index, num_kv_heads,
+                     sm_scale=None, interpret=None):
+    """Masked single-token attention over the FLAT cache window.
+
+    ``q``: (B, 1, H, D); ``k_cache``/``v_cache``: (B, L, Hkv*D) — the
+    row-flattened GQA cache (flat so no reshape ever touches the cache
+    buffers; splitting the tiled minor dims is not Mosaic-legal in-kernel
+    and an XLA-side reshape would re-open the layout question);
+    ``cache_index``: the query's global position t — keys at positions
+    <= t are attended (the new row must already be written into the
+    cache). H % Hkv == 0 (grouped-query). Returns (B, 1, H, D)."""
+    b, s, h, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode_attention is single-token (s={s})")
+    hkv = num_kv_heads
+    L, f = k_cache.shape[1], k_cache.shape[2]
+    if h % hkv or f != hkv * d:
+        raise ValueError(
+            f"H ({h}) must be a multiple of Hkv ({hkv}) and the flat cache "
+            f"width ({f}) must equal Hkv*D ({hkv * d})")
+    group = h // hkv
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _auto_interpret()
+    idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
+    # Block-diagonal query arrangement (see _decode_kernel): W[b, kv1*d+dd,
+    # h'] = q[b, h', dd] for kv1 == h' // group, else 0. Touches only the
+    # fresh per-step q — never the cache buffers, whose layout freedom is
+    # the whole point of this kernel. Built as broadcast * constant mask
+    # (the mask is loop-invariant and hoists out of the decode scan; an
+    # eye-einsum build measured ~25 us/layer).
+    qt = jnp.swapaxes(q[:, 0], 1, 2)                       # (b, d, h)
+    qt = jnp.broadcast_to(qt[:, None], (b, hkv, d, h)).reshape(b, f, h)
+    blockmask = (jnp.arange(f)[:, None] // d
+                 == jnp.arange(h)[None, :] // group).astype(q.dtype)
+    w = qt * blockmask
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, hkv=hkv, group=group,
+                          sm_scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, f, h), lambda i, idx: (i, 0, 0)),
+                pl.BlockSpec((1, L, f), lambda i, idx: (i, 0, 0)),
+                pl.BlockSpec((1, L, f), lambda i, idx: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, d), lambda i, idx: (i, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+    )(idx, w, k_cache, v_cache)
+    return out.reshape(b, 1, h, d)

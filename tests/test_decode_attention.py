@@ -1,0 +1,72 @@
+"""Pallas decode-step attention (interpret mode on CPU) vs the masked
+reference softmax — the kernel that frees the KV cache from the XLA
+layout/update trade-off (artifacts/decode_ceiling_r5.json)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.ops.decode_attention import decode_attention
+
+
+def _reference(q, k_cache, v_cache, cache_index, hkv):
+    b, s, h, d = q.shape
+    L = k_cache.shape[1]
+    k_cache = k_cache.reshape(b, L, hkv, d)
+    v_cache = v_cache.reshape(b, L, hkv, d)
+    group = h // hkv
+    qg = q.reshape(b, s, hkv, group, d)
+    logits = jnp.einsum("bshgd,blhd->bshgl", qg, k_cache).astype(
+        jnp.float32) / np.sqrt(d)
+    mask = jnp.arange(k_cache.shape[1]) <= cache_index
+    logits = jnp.where(mask[None, None, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bshgl,blhd->bshgd", probs, v_cache).reshape(
+        b, s, h, d)
+
+
+@pytest.mark.parametrize("hkv,h", [(2, 2), (2, 4), (4, 16)])
+@pytest.mark.parametrize("cache_index", [0, 3, 30])
+def test_matches_reference(hkv, h, cache_index):
+    rng = np.random.RandomState(0)
+    b, L, d = 3, 32, 16
+    q = jnp.asarray(rng.randn(b, 1, h, d).astype(np.float32)) * 0.4
+    k = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.4
+    v = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.4
+    out = decode_attention(q, k, v, cache_index, hkv)
+    ref = _reference(q, k, v, cache_index, hkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_traced_cache_index_under_scan():
+    # cache_index is traced in generate()'s decode scan.
+    rng = np.random.RandomState(1)
+    b, L, hkv, h, d = 2, 16, 2, 4, 8
+    q = jnp.asarray(rng.randn(b, 1, h, d).astype(np.float32)) * 0.4
+    k = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.4
+    v = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.4
+
+    @jax.jit
+    def scan_all(q, k, v):
+        def body(c, i):
+            return c, decode_attention(q, k, v, i, hkv)
+        _, outs = jax.lax.scan(body, 0, jnp.arange(4))
+        return outs
+
+    outs = scan_all(q, k, v)
+    for i in range(4):
+        ref = _reference(q, k, v, i, hkv)
+        np.testing.assert_allclose(np.asarray(outs[i]), np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+
+
+def test_validation():
+    q = jnp.zeros((2, 2, 4, 8))
+    k = v = jnp.zeros((2, 16, 2 * 8))
+    with pytest.raises(ValueError, match="single-token"):
+        decode_attention(q, k, v, 0, 2)
+    with pytest.raises(ValueError, match="multiple"):
+        decode_attention(jnp.zeros((2, 1, 3, 8)), k, v, 0, 2)
