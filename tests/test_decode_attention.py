@@ -63,6 +63,22 @@ def test_traced_cache_index_under_scan():
                                    atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("cache_index", [0, 255, 256, 700, 1023])
+def test_multi_tile_accumulation(cache_index):
+    # L > DECODE_BLOCK_L: the online-softmax state must accumulate
+    # correctly across L-tiles, including indices on tile boundaries and
+    # tiles fully above the causal bound (their compute is skipped).
+    rng = np.random.RandomState(2)
+    b, L, hkv, h, d = 2, 1024, 2, 4, 16
+    q = jnp.asarray(rng.randn(b, 1, h, d).astype(np.float32)) * 0.4
+    k = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.4
+    v = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.4
+    out = decode_attention(q, k, v, cache_index, hkv, block_l=256)
+    ref = _reference(q, k, v, cache_index, hkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
 def test_validation():
     q = jnp.zeros((2, 2, 4, 8))
     k = v = jnp.zeros((2, 16, 2 * 8))
