@@ -1,0 +1,91 @@
+"""Autotune efficacy A/B: tuned vs fixed knobs on a gradient-shaped eager
+workload (round-4 verdict item #8).
+
+The reference ships autotuning as a PERFORMANCE feature
+(``parameter_manager.cc:155-223``: Bayesian optimization over fusion
+threshold / cycle time scored on bytes/sec); the in-tree tuner has
+convergence tests but this script produces the efficacy NUMBER: run the
+same multi-tensor workload (a mix of gradient-like sizes enqueued
+together, the shape ``DistributedOptimizer`` produces each step) under
+
+  A) the default fixed knobs,
+  B) deliberately bad fixed knobs (tiny fusion threshold + slow cycle),
+  C) ``HOROVOD_AUTOTUNE=1`` starting from those same bad knobs,
+
+and print per-window steps/sec from rank 0 so B-vs-C shows the tuner
+recovering mid-run, and A-vs-C what tuning is worth against defaults.
+
+Run (the launcher provides the ranks):
+    python -m horovod_tpu.run -np 2 python examples/autotune_efficacy.py
+    HOROVOD_AUTOTUNE=1 python -m horovod_tpu.run -np 2 \
+        python examples/autotune_efficacy.py
+
+On the 1-core CI box both ranks timeshare one CPU, so absolute rates are
+serialization-bound; quote the RELATIVE A/B/C numbers (the knobs change
+negotiation batching, which is CPU-visible even here) with that caveat.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+# Gradient-shaped mix per step: a few big tensors, a tail of small ones
+# (ResNet-ish: conv kernels + biases/norms).
+TENSOR_SIZES = ([1 << 20] * 2 + [1 << 18] * 6 + [1 << 16] * 10
+                + [1 << 12] * 22)  # floats; ~4.3 MiB/step total
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--window", type=int, default=20,
+                    help="steps per reported throughput window")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+    rng = np.random.RandomState(rank)
+    tensors = [rng.rand(n).astype(np.float32) for n in TENSOR_SIZES]
+    step_bytes = sum(4 * n for n in TENSOR_SIZES)
+
+    # Warmup (also primes the response cache bitvectors).
+    for t_i, t in enumerate(tensors):
+        hvd.allreduce(t, average=False, name=f"warm.{t_i}")
+
+    windows = []
+    t0 = time.perf_counter()
+    for it in range(args.steps):
+        handles = [
+            hvd.allreduce_async(t, average=True, name=f"g.{t_i}")
+            for t_i, t in enumerate(tensors)
+        ]
+        for h in handles:
+            hvd.synchronize(h)
+        if (it + 1) % args.window == 0:
+            dt = time.perf_counter() - t0
+            rate = args.window / dt
+            windows.append(round(rate, 2))
+            if rank == 0:
+                mbs = args.window * step_bytes / dt / 1e6
+                print(f"window {len(windows)}: {rate:.2f} steps/s "
+                      f"({mbs:.0f} MB/s)", flush=True)
+            t0 = time.perf_counter()
+
+    if rank == 0 and args.json:
+        print(json.dumps({
+            "autotune": bool(os.environ.get("HOROVOD_AUTOTUNE")),
+            "fusion_threshold": os.environ.get("HOROVOD_FUSION_THRESHOLD"),
+            "cycle_time": os.environ.get("HOROVOD_CYCLE_TIME"),
+            "size": size, "step_bytes": step_bytes,
+            "windows_steps_per_s": windows}), flush=True)
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -146,6 +146,14 @@ class ParameterManager:
     SAMPLES_PER_STEP = 10   # scored cycles per configuration
     CATEGORY_STEPS = 3      # BO steps per categorical value visit
     CATEGORY_SWEEPS = 2     # full passes over the categorical knobs
+    # Tuning FINISHES: after this many scored BO configurations (and the
+    # categorical sweeps are done) the manager pins the best-seen
+    # configuration and stops — the reference's BAYES_OPT_MAX_SAMPLES=20 +
+    # SetAutoTuning(false) + BestValue() contract
+    # (parameter_manager.cc:30,210,473-475). Without termination the
+    # search pays exploration cost for the whole job; with noisy scores
+    # (timeshared CPUs) it can wander indefinitely.
+    BO_MAX_STEPS = 20
 
     def __init__(self, fusion_threshold: int, cycle_time_ms: float,
                  log_path: Optional[str] = None, seed: int = 0,
@@ -170,9 +178,9 @@ class ParameterManager:
         self.cycle_time_ms = float(cycle_time_ms)
         self.categoricals = {k: bool(v) for k, v in categoricals.items()}
         self._warmup_left = self.WARMUP_SAMPLES
-        self._bytes = 0
-        self._seconds = 0.0
-        self._samples = 0
+        self._scores: List[float] = []
+        self._bo_steps = 0
+        self._completed = False
         self._log_path = log_path
         self._log_header_due = log_path is not None
         self._best_score = -np.inf
@@ -196,6 +204,8 @@ class ParameterManager:
         """False when every knob is pinned or settled — record()
         short-circuits, so a fully-pinned (or fully-converged) job never
         pays the per-step GP Cholesky for values it would discard."""
+        if self._completed:
+            return False
         cats_active = bool(self._cat_order) and not self._cats_converged
         return cats_active or not (
             {"fusion_threshold", "cycle_time"} <= self.fixed)
@@ -241,13 +251,15 @@ class ParameterManager:
         if self._warmup_left > 0:
             self._warmup_left -= 1
             return None
-        self._bytes += nbytes
-        self._seconds += seconds
-        self._samples += 1
-        if self._samples < self.SAMPLES_PER_STEP:
+        self._scores.append(nbytes / seconds)
+        if len(self._scores) < self.SAMPLES_PER_STEP:
             return None
 
-        score = self._bytes / self._seconds  # bytes/sec, higher is better
+        # MEDIAN of the per-cycle rates (reference sorts scores_ and takes
+        # scores_[SAMPLES/2], parameter_manager.cc:176-180): a mean lets
+        # one contended cycle on a timeshared host poison the whole
+        # configuration's score.
+        score = float(np.median(self._scores))  # bytes/sec, higher better
         params = (np.log2(self.fusion_threshold), self.cycle_time_ms)
         self._bo.add_sample(params, score)
         if score > self._best_score:
@@ -273,6 +285,25 @@ class ParameterManager:
 
         self._advance_categoricals(score)
 
+        self._bo_steps += 1
+        if self._cats_converged and self._bo_steps >= self.BO_MAX_STEPS:
+            # Tuning complete: pin the best-seen configuration and stop
+            # (reference SetAutoTuning(false) + BestValue(),
+            # parameter_manager.cc:210,113-129). The returned tuple is
+            # the final config the caller pushes down.
+            self._completed = True
+            self.fusion_threshold = self.best_fusion_threshold
+            self.cycle_time_ms = self.best_cycle_time_ms
+            self.categoricals = dict(self.best_categoricals)
+            if self._log_path:
+                with open(self._log_path, "a") as f:
+                    f.write(f"# tuning complete: pinned "
+                            f"{self.fusion_threshold},"
+                            f"{self.cycle_time_ms:.3f} "
+                            f"(best score {self._best_score:.1f})\n")
+            return (self.fusion_threshold, self.cycle_time_ms,
+                    dict(self.categoricals))
+
         nxt = self._bo.suggest()
         # fixed= continuous knobs keep their EXACT initial value (reference
         # TunableParameter::SetValue(value, fixed=true) semantics).
@@ -282,9 +313,7 @@ class ParameterManager:
         self.cycle_time_ms = (
             self._initial_cycle_ms if "cycle_time" in self.fixed
             else float(nxt[1]))
-        self._bytes = 0
-        self._seconds = 0.0
-        self._samples = 0
+        self._scores = []
         self._warmup_left = self.WARMUP_SAMPLES
         return (self.fusion_threshold, self.cycle_time_ms,
                 dict(self.categoricals))

@@ -183,3 +183,38 @@ def test_parameter_manager_fixed_keeps_exact_values():
     for _ in range(60):
         assert pinned.record(nbytes=1 << 20, seconds=0.005) is None
     assert pinned._bo._x == []  # no GP samples accumulated
+
+
+def test_tuning_completes_and_pins_best():
+    """Reference contract (parameter_manager.cc:30,210,473-475): after
+    BAYES_OPT_MAX_SAMPLES scored configurations the search STOPS, the
+    best-seen configuration is pinned, and no further retunes happen —
+    without termination the job pays exploration cost forever (the
+    round-5 efficacy run decayed and never recovered before this)."""
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          seed=4)
+    # Score surface with a clear optimum: reward thresholds near 2^24.
+    def score_for(thr):
+        import math
+        return 1e9 / (1.0 + abs(math.log2(thr) - 24.0))
+
+    last = None
+    configs = 0
+    for _ in range(2000):
+        secs = 1e9 / score_for(pm.fusion_threshold) * 1e-3
+        out = pm.record(nbytes=1 << 20, seconds=secs * (1 << 20) / 1e6)
+        if out is not None:
+            configs += 1
+            last = out
+        if not pm.tunable:
+            break
+    assert not pm.tunable, "tuning never completed"
+    assert configs >= pm.BO_MAX_STEPS
+    # The pinned config IS the best-seen one, and the final record()
+    # return handed it to the caller.
+    assert last[0] == pm.best_fusion_threshold == pm.fusion_threshold
+    assert last[1] == pm.best_cycle_time_ms == pm.cycle_time_ms
+    # Frozen from here on: no more retunes, no GP work.
+    for _ in range(100):
+        assert pm.record(nbytes=1 << 20, seconds=0.005) is None
+    assert pm.fusion_threshold == last[0]
