@@ -27,7 +27,12 @@ def _reference(q, k_cache, v_cache, cache_index, hkv):
         b, s, h, d)
 
 
-@pytest.mark.parametrize("hkv,h", [(2, 2), (2, 4), (4, 16)])
+@pytest.mark.parametrize("hkv,h", [
+    (2, 2),    # MHA (group == 1)
+    (2, 4),
+    (4, 16),
+    (1, 8),    # MQA (one K/V head)
+])
 @pytest.mark.parametrize("cache_index", [0, 3, 30])
 def test_matches_reference(hkv, h, cache_index):
     rng = np.random.RandomState(0)
@@ -77,6 +82,34 @@ def test_multi_tile_accumulation(cache_index):
     ref = _reference(q, k, v, cache_index, hkv)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=1e-4)
+
+
+def test_wide_heads_d128():
+    # Llama-8B head width: d=128, f=1024 — the shape class the L-tiling
+    # exists for (verified compiling at L=8192 on-chip; here parity).
+    rng = np.random.RandomState(3)
+    b, L, hkv, h, d = 1, 64, 2, 8, 128
+    q = jnp.asarray(rng.randn(b, 1, h, d).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(b, L, hkv * d).astype(np.float32)) * 0.3
+    out = decode_attention(q, k, v, 50, hkv)
+    ref = _reference(q, k, v, 50, hkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_bf16_inputs():
+    rng = np.random.RandomState(4)
+    b, L, hkv, h, d = 2, 32, 2, 4, 16
+    q = jnp.asarray(rng.randn(b, 1, h, d) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.randn(b, L, hkv * d) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(rng.randn(b, L, hkv * d) * 0.3, jnp.bfloat16)
+    out = decode_attention(q, k, v, 20, hkv)
+    assert out.dtype == jnp.bfloat16
+    ref = _reference(q, k, v, 20, hkv)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=2e-2, rtol=2e-2)
 
 
 def test_validation():
