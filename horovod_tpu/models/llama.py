@@ -345,6 +345,12 @@ def init_kv_cache(cfg, batch_size: int, max_len: int, dtype=None):
     ``MoeConfig``)."""
     dtype = dtype or cfg.dtype
     head_dim = cfg.dim // cfg.num_heads
+    # Windows past the decode kernel's single-tile VMEM budget get
+    # L-tiled; round them to a 128 multiple so a decent tile DIVISOR
+    # exists (<= +6% extra masked rows; small windows stay exact — no
+    # read amplification where a single tile serves anyway).
+    if max_len > 1024:
+        max_len = (max_len + 127) // 128 * 128
     shape = (batch_size, max_len, cfg.num_kv_heads * head_dim)
     return {
         f"layer_{i}": {"k": jnp.zeros(shape, dtype),

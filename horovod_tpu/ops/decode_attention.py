@@ -35,16 +35,12 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+from .attention import NEG_INF, _auto_interpret
 
 # Default L-tile: 2 * block_l * (Hkv*D) * 2 bytes of streamed K/V per
 # step — 1 MiB at Llama-8B widths (f = 1024), comfortably inside scoped
 # VMEM at any window length.
 DECODE_BLOCK_L = 256
-
-
-def _auto_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _decode_kernel(idx_ref, w_ref, k_ref, v_ref, o_ref, l_ref,
@@ -124,6 +120,26 @@ def _decode_kernel(idx_ref, w_ref, k_ref, v_ref, o_ref, l_ref,
         l_ref[0] = l_scr[0:1]
 
 
+def _pick_block_l(L: int, f: int, itemsize: int, requested: int) -> int:
+    """L-tile choice. A single whole-window tile streams best (tiling
+    measured ~18% slower at L=384 from smaller DMAs + tile overhead), so
+    tile only when the window would blow the VMEM budget — and then pick
+    the largest DIVISOR of L at or under the requested tile (a
+    power-of-2 halving would collapse to pathological tiles for windows
+    without large 2-power factors; ``init_kv_cache`` rounds big windows
+    to a 128 multiple so a decent divisor exists there). For awkward
+    hand-built windows with no usable divisor, a big single tile beats
+    16-row DMAs as long as it fits at all."""
+    window_bytes = 2 * L * f * itemsize
+    if window_bytes <= (4 << 20):
+        return L
+    block_l = next(q for q in range(min(requested, L), 0, -1)
+                   if L % q == 0)
+    if block_l < 64 and window_bytes <= (8 << 20):
+        return L
+    return block_l
+
+
 def decode_attention(q, k_cache, v_cache, cache_index, num_kv_heads,
                      sm_scale=None, block_l: int = DECODE_BLOCK_L,
                      interpret=None):
@@ -149,13 +165,7 @@ def decode_attention(q, k_cache, v_cache, cache_index, num_kv_heads,
     scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _auto_interpret()
-    # Adaptive tiling: a single whole-window tile streams best (tiling
-    # measured ~18% slower at L=384 from smaller DMAs + tile overhead),
-    # so tile only when the window would blow the VMEM budget.
-    if 2 * L * f * k_cache.dtype.itemsize <= (4 << 20):
-        block_l = L
-    while L % block_l:
-        block_l //= 2
+    block_l = _pick_block_l(L, f, k_cache.dtype.itemsize, block_l)
     num_lb = L // block_l
     idx = jnp.asarray(cache_index, jnp.int32).reshape(1)
     # Block-diagonal query arrangement (see _decode_kernel): W[b, kv1*d+dd,
