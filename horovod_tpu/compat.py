@@ -1,0 +1,69 @@
+"""jax API compatibility: one spelling per API everywhere.
+
+The tree targets the jax_graft toolchain; some images bake an older jax
+where two APIs the tree uses spell differently. Importing
+:mod:`horovod_tpu` installs translating aliases so the NEW spelling
+works on both — no behavior change on a jax that already has them:
+
+* ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+  check_vma=False)`` — on old jax the function lives at
+  ``jax.experimental.shard_map.shard_map`` and the knob is
+  ``check_rep``.
+* ``Lowered.as_text(debug_info=True)`` — on old jax rendered through
+  the MLIR location metadata instead of the kwarg.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+
+
+def _install_lowered_debug_info() -> None:
+    """``Lowered.as_text(debug_info=True)`` — the spelling the
+    observability tests use to find ``jax.named_scope`` labels in lowered
+    IR — exists only on newer jax. On older jax the same information is
+    in the MLIR location metadata: render via
+    ``compiler_ir().operation.get_asm(enable_debug_info=True)``."""
+    from jax._src import stages
+
+    if "debug_info" in inspect.signature(
+            stages.Lowered.as_text).parameters:
+        return
+    orig = stages.Lowered.as_text
+
+    @functools.wraps(orig)
+    def as_text(self, dialect=None, *, debug_info=False):
+        if not debug_info:
+            return orig(self, dialect)
+        return self.compiler_ir(dialect).operation.get_asm(
+            enable_debug_info=True)
+
+    stages.Lowered.as_text = as_text
+
+
+def _install_shard_map() -> None:
+    base = getattr(jax, "shard_map", None)
+    if base is not None:
+        if "check_vma" in inspect.signature(base).parameters:
+            return  # modern jax: nothing to do
+        # jax.shard_map exists but predates the check_rep -> check_vma
+        # rename: still needs the kwarg translation below.
+    else:
+        from jax.experimental.shard_map import shard_map as base
+
+    accepted = inspect.signature(base).parameters
+
+    @functools.wraps(base)
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs and "check_vma" not in accepted:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return base(f, *args, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+_install_shard_map()
+_install_lowered_debug_info()

@@ -14,10 +14,12 @@ from .llama import (  # noqa: F401
     LLAMA_300M,
     LLAMA_8B,
     LLAMA_TINY,
+    DecodePath,
     LlamaConfig,
     LlamaLM,
     causal_lm_loss,
     chunked_causal_lm_loss,
+    classify_decode_sharding,
     generate,
     init_kv_cache,
     llama_tp_param_specs,

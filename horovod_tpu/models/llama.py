@@ -161,10 +161,14 @@ class LlamaAttention(nn.Module):
 # frees XLA to keep the loop-carried cache d-minor and make the per-step
 # one-row cache write a true in-place update (the XLA formulation forces a
 # seq-minor layout whose one-row update rewrites the whole buffer —
-# artifacts/decode_ceiling_r5.json). generate() disables it when the
-# variables are sharded over a multi-device mesh: GSPMD cannot partition
-# the custom call, while the einsum path shards naturally.
+# artifacts/decode_ceiling_r5.json). generate() classifies the variables'
+# sharding (see classify_decode_sharding): heads-sharded-on-TP meshes ride
+# the kernel through shard_map (``_DECODE_TP``); exotic shardings fall back
+# to the einsum path, which GSPMD shards naturally.
 _DECODE_KERNEL = True
+# When set, single-token cached attention runs the kernel per-shard inside
+# ``jax.shard_map``: (mesh, head_axis, batch_axis).
+_DECODE_TP = None
 
 
 @contextlib.contextmanager
@@ -180,6 +184,26 @@ def decode_kernel_disabled():
         _DECODE_KERNEL = prev
 
 
+@contextlib.contextmanager
+def _decode_tp_override(value):
+    global _DECODE_TP
+    prev = _DECODE_TP
+    _DECODE_TP = value
+    try:
+        yield
+    finally:
+        _DECODE_TP = prev
+
+
+def decode_kernel_sharded(mesh, head_axis: str, batch_axis=None):
+    """Within this context, single-token cached attention runs the Pallas
+    kernel per-shard inside ``jax.shard_map`` over ``head_axis`` (the TP
+    axis sharding attention heads), with the one-row cache write kept
+    in-place per shard (trace-time static; see
+    ``ops.decode_attention.sharded_decode_step``)."""
+    return _decode_tp_override((mesh, head_axis, batch_axis))
+
+
 def _cached_attention(q, k, v, cache, cache_index):
     """Decode-mode attention: write the s new K/V rows at ``cache_index``,
     attend every query (global position ``cache_index + i``) over the full
@@ -188,14 +212,18 @@ def _cached_attention(q, k, v, cache, cache_index):
     valid prefix. Grouped-query: queries attend their K/V group directly
     (no repeated K/V in the cache).
 
-    Three code paths, one semantics: single-token steps ride the Pallas
+    Four code paths, one semantics: single-token steps ride the Pallas
     decode kernel (see ``_DECODE_KERNEL`` above — it keeps the carried
-    cache in a layout where the row write is in-place); prefill at static
-    index 0 attends over the FRESH rows so no matmul ever consumes the
-    cache buffers (a dot on them would re-pin the seq-minor layout the
-    kernel path exists to avoid); the general chunked-append form (traced
-    or nonzero index with s > 1) keeps the reference masked-window
-    einsum."""
+    cache in a layout where the row write is in-place), per-shard inside
+    ``shard_map`` when the TP mesh shards heads (``_DECODE_TP``); prefill
+    at static index 0 attends over the FRESH rows so no matmul ever
+    consumes the cache buffers (a dot on them would re-pin the seq-minor
+    layout the kernel path exists to avoid); the general chunked-append
+    form (traced or nonzero index with s > 1) keeps the reference
+    masked-window einsum. Each path is labeled with a
+    ``jax.named_scope("hvd.decode.<path>")`` so the chosen path is
+    attributable from HLO metadata and profiler traces
+    (``utils.comm_accounting.decode_path_markers``)."""
     b, s, h, d = q.shape
     hkv = k.shape[2]
     group = h // hkv
@@ -205,17 +233,31 @@ def _cached_attention(q, k, v, cache, cache_index):
     # in-kernel split of tiled minor dims is not Mosaic-legal).
     kc = k.astype(cache["k"].dtype)
     vc = v.astype(cache["v"].dtype)
+    scale = 1.0 / np.sqrt(d)
+    if s == 1 and _DECODE_KERNEL and _DECODE_TP is not None:
+        # TP-sharded serving: cache-row write AND kernel run per-shard
+        # inside shard_map — the outer dynamic_update_slice below never
+        # touches the sharded cache buffers.
+        from ..ops.decode_attention import sharded_decode_step
+
+        mesh, head_axis, batch_axis = _DECODE_TP
+        with jax.named_scope("hvd.decode.kernel_tp"):
+            ctx, k_cache, v_cache = sharded_decode_step(
+                q, kc, vc, cache["k"], cache["v"], cache_index, hkv,
+                mesh=mesh, head_axis=head_axis, batch_axis=batch_axis,
+                sm_scale=scale)
+        return ctx, {"k": k_cache, "v": v_cache}
     k_cache = jax.lax.dynamic_update_slice(
         cache["k"], kc.reshape(b, s, hkv * d), (0, cache_index, 0))
     v_cache = jax.lax.dynamic_update_slice(
         cache["v"], vc.reshape(b, s, hkv * d), (0, cache_index, 0))
     window = k_cache.shape[1]
-    scale = 1.0 / np.sqrt(d)
     if s == 1 and _DECODE_KERNEL:
         from ..ops.decode_attention import decode_attention
 
-        ctx = decode_attention(q, k_cache, v_cache, cache_index, hkv,
-                               sm_scale=scale)
+        with jax.named_scope("hvd.decode.kernel"):
+            ctx = decode_attention(q, k_cache, v_cache, cache_index, hkv,
+                                   sm_scale=scale)
         return ctx, {"k": k_cache, "v": v_cache}
     if s > 1 and isinstance(cache_index, int) and cache_index == 0:
         # Prefill at index 0: the valid window IS the fresh rows — no
@@ -224,29 +266,31 @@ def _cached_attention(q, k, v, cache, cache_index):
         # CACHE-DTYPE rows (kc/vc), so prefill sees exactly the values
         # every later decode step reads back — one semantics across
         # paths even when the cache dtype quantizes.
-        qg = q.reshape(b, s, hkv, group, d)
-        logits = jnp.einsum("bshgd,blhd->bshgl", qg, kc).astype(
-            jnp.float32) * scale
-        causal = (jnp.arange(s)[None, :] <= jnp.arange(s)[:, None])
-        logits = jnp.where(causal[None, :, None, None, :], logits,
-                           jnp.finfo(jnp.float32).min)
-        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-        ctx = jnp.einsum("bshgl,blhd->bshgd", probs, vc)
+        with jax.named_scope("hvd.decode.prefill"):
+            qg = q.reshape(b, s, hkv, group, d)
+            logits = jnp.einsum("bshgd,blhd->bshgl", qg, kc).astype(
+                jnp.float32) * scale
+            causal = (jnp.arange(s)[None, :] <= jnp.arange(s)[:, None])
+            logits = jnp.where(causal[None, :, None, None, :], logits,
+                               jnp.finfo(jnp.float32).min)
+            probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+            ctx = jnp.einsum("bshgl,blhd->bshgd", probs, vc)
         return ctx.reshape(b, s, h, d), {"k": k_cache, "v": v_cache}
     # General path (einsum over the 4D view; also the s == 1 path under
-    # multi-device sharding — see _DECODE_KERNEL above).
-    qg = q.reshape(b, s, hkv, group, d)
-    k4 = k_cache.reshape(b, window, hkv, d)
-    v4 = v_cache.reshape(b, window, hkv, d)
-    logits = jnp.einsum("bshgd,blhd->bshgl", qg, k4).astype(
-        jnp.float32) * scale
-    q_pos = cache_index + jnp.arange(s)
-    key_pos = jnp.arange(window)
-    mask = key_pos[None, :] <= q_pos[:, None]          # (s, window)
-    logits = jnp.where(mask[None, :, None, None, :], logits,
-                       jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    ctx = jnp.einsum("bshgl,blhd->bshgd", probs, v4).reshape(b, s, h, d)
+    # exotic multi-device sharding — see _DECODE_KERNEL above).
+    with jax.named_scope("hvd.decode.einsum"):
+        qg = q.reshape(b, s, hkv, group, d)
+        k4 = k_cache.reshape(b, window, hkv, d)
+        v4 = v_cache.reshape(b, window, hkv, d)
+        logits = jnp.einsum("bshgd,blhd->bshgl", qg, k4).astype(
+            jnp.float32) * scale
+        q_pos = cache_index + jnp.arange(s)
+        key_pos = jnp.arange(window)
+        mask = key_pos[None, :] <= q_pos[:, None]          # (s, window)
+        logits = jnp.where(mask[None, :, None, None, :], logits,
+                           jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        ctx = jnp.einsum("bshgl,blhd->bshgd", probs, v4).reshape(b, s, h, d)
     return ctx, {"k": k_cache, "v": v_cache}
 
 
@@ -359,9 +403,134 @@ def init_kv_cache(cfg, batch_size: int, max_len: int, dtype=None):
     }
 
 
+@dataclasses.dataclass(frozen=True)
+class DecodePath:
+    """Verdict of :func:`classify_decode_sharding`: which single-token
+    decode path :func:`generate` traces, and why. ``generate`` records
+    its last verdict in ``LAST_DECODE_PATH`` so harnesses and bench rows
+    can prove which path ran (the HLO-metadata twin is
+    ``utils.comm_accounting.decode_path_markers``)."""
+
+    path: str                       # "kernel" | "kernel_tp" | "einsum"
+    reason: str
+    mesh: Any = None
+    head_axis: Optional[str] = None
+    batch_axis: Optional[str] = None
+
+
+#: Last :class:`DecodePath` chosen by :func:`generate` (None before any
+#: call). Read-only attribution for harnesses; not used for dispatch.
+LAST_DECODE_PATH: Optional[DecodePath] = None
+
+
+def _multi_device(leaf) -> bool:
+    sh = getattr(leaf, "sharding", None)
+    if sh is None:
+        return False
+    try:
+        return (len(sh.device_set) > 1
+                and not sh.is_fully_replicated)
+    except (AttributeError, TypeError):
+        return True  # unknown sharding type: take the safe path
+
+
+def classify_decode_sharding(variables, prompt_ids,
+                             num_kv_heads: int) -> DecodePath:
+    """Pick the single-token decode path from the variables' shardings.
+
+    Three-way dispatch (the blanket ``sharded -> einsum`` fallback this
+    replaces threw away a measured ~47%-of-step win exactly on the
+    multi-chip serving path):
+
+    * nothing is sharded over a multi-device mesh → ``"kernel"`` (the
+      single-device Pallas fast path, as before);
+    * the Megatron TP pattern — attention projections sharded on the
+      heads dim only, all on ONE mesh axis whose size divides
+      ``num_kv_heads``, batch replicated or sharded on one other axis —
+      → ``"kernel_tp"``: attention is per-head independent, so the
+      kernel runs per-shard inside ``shard_map``
+      (``ops.decode_attention.sharded_decode_step``) with in-place
+      per-shard cache-row writes;
+    * anything exotic (sequence-sharded prompt, uneven head splits,
+      mixed meshes, non-Named shardings) → ``"einsum"``, which GSPMD
+      shards naturally.
+    """
+    from ..parallel.mesh import common_mesh, sharding_axes
+
+    leaves = jax.tree_util.tree_leaves((variables, prompt_ids))
+    if not any(_multi_device(leaf) for leaf in leaves):
+        return DecodePath("kernel", "replicated: single-device kernel")
+    mesh = common_mesh((variables, prompt_ids))
+    if mesh is None:
+        return DecodePath(
+            "einsum", "unknown sharding types or mixed meshes")
+
+    # Megatron TP pattern: wq/wk/wv kernels (dim, heads, head_dim) may
+    # shard ONLY dim 1, wo (heads, head_dim, dim) only dim 0 — all on
+    # one axis.
+    head_axes = set()
+    clean = True
+
+    def visit(path, leaf):
+        nonlocal clean
+        names = {getattr(p, "key", str(p)) for p in path}
+        if "kernel" not in names:
+            return
+        proj = names & {"wq", "wk", "wv", "wo"}
+        if not proj:
+            return
+        axes = sharding_axes(leaf)
+        if axes is None:
+            clean = _multi_device(leaf) is False and clean
+            return
+        head_dim = 0 if "wo" in proj else 1
+        for i, dim_axes in enumerate(axes):
+            if i == head_dim:
+                if len(dim_axes) > 1:
+                    clean = False
+                head_axes.update(dim_axes)
+            elif dim_axes:
+                clean = False
+
+    jax.tree_util.tree_map_with_path(visit, variables)
+    if not clean:
+        return DecodePath(
+            "einsum", "attention params sharded off the heads dim")
+    if len(head_axes) != 1:
+        return DecodePath(
+            "einsum",
+            "attention heads not sharded on exactly one mesh axis "
+            f"(axes={sorted(head_axes)})")
+    (head_axis,) = head_axes
+    tp = mesh.shape[head_axis]
+    if num_kv_heads % tp:
+        return DecodePath(
+            "einsum", f"uneven head split: Hkv ({num_kv_heads}) % "
+            f"tp ({tp}) != 0")
+
+    batch_axis = None
+    if _multi_device(prompt_ids):
+        p_axes = sharding_axes(prompt_ids)
+        if p_axes is None or any(p_axes[1:]) or len(p_axes[0]) > 1:
+            return DecodePath(
+                "einsum", "prompt sharded off the batch dim "
+                "(sequence-sharded cache is exotic)")
+        if p_axes[0]:
+            (batch_axis,) = p_axes[0]
+            if (batch_axis == head_axis
+                    or prompt_ids.shape[0] % mesh.shape[batch_axis]):
+                return DecodePath(
+                    "einsum", f"batch axis {batch_axis!r} unusable "
+                    "(clashes with head axis or uneven split)")
+    return DecodePath(
+        "kernel_tp",
+        f"heads sharded on {head_axis!r} (tp={tp}): shard_mapped kernel",
+        mesh, head_axis, batch_axis)
+
+
 def generate(model, variables, prompt_ids, max_new_tokens: int,
              max_len: Optional[int] = None, temperature: float = 0.0,
-             rng=None):
+             rng=None, unroll: int = 1):
     """Autoregressive decoding with the KV cache: prefill the prompt in one
     call, then ``lax.scan`` single-token steps — the whole loop is two
     compiled programs regardless of length (no per-token dispatch).
@@ -372,9 +541,16 @@ def generate(model, variables, prompt_ids, max_new_tokens: int,
     ``softmax(logits / temperature)`` using ``rng``. Returns
     ``(B, prompt + max_new_tokens)`` ids (prompt included).
 
+    ``unroll``: tokens decoded per ``lax.scan`` iteration (the loop body
+    is replicated; the cache takes one in-place row write per token
+    either way). >1 amortizes the fixed per-iteration while-loop cost
+    that dominates small-batch decode (``artifacts/decode_ceiling_r6``);
+    identical tokens at any value.
+
     This is the inference counterpart of the training path the framework
     benchmarks; for serving without this framework see ``docs/inference.md``
-    (checkpoints are plain pytrees)."""
+    (checkpoints are plain pytrees; sharding-path dispatch is described
+    in ``docs/decode-serving.md``)."""
     cfg = model.config
     b, s = prompt_ids.shape
     if max_len is None:
@@ -396,50 +572,53 @@ def generate(model, variables, prompt_ids, max_new_tokens: int,
     # rides in as a traced operand so a temperature sweep shares one
     # compiled program instead of recompiling the prefill+scan per value.
     #
-    # The Pallas decode-attention fast path can't be partitioned by GSPMD:
-    # when the variables are sharded over a multi-device mesh (the TP
-    # serving path), trace the einsum form instead — it shards naturally.
-    def _multi_device(leaf):
-        sh = getattr(leaf, "sharding", None)
-        if sh is None:
-            return False
-        try:
-            return (len(sh.device_set) > 1
-                    and not sh.is_fully_replicated)
-        except (AttributeError, TypeError):
-            return True  # unknown sharding type: take the safe path
-
-    sharded = any(
-        _multi_device(leaf)
-        for leaf in jax.tree_util.tree_leaves((variables, prompt_ids)))
+    # Sharding classifier (see classify_decode_sharding): heads-on-TP
+    # meshes keep the Pallas fast path through shard_map; only exotic
+    # shardings trace the einsum form, which GSPMD shards naturally.
+    global LAST_DECODE_PATH
+    info = classify_decode_sharding(variables, prompt_ids,
+                                    cfg.num_kv_heads)
+    if not _DECODE_KERNEL:
+        info = DecodePath("einsum", "decode_kernel_disabled()")
+    LAST_DECODE_PATH = info
     new_tokens = _decode(model, variables, prompt_ids, rng,
                          jnp.float32(temperature), int(max_new_tokens),
-                         int(max_len), temperature <= 0.0,
-                         _DECODE_KERNEL and not sharded)
+                         int(max_len), temperature <= 0.0, info.path,
+                         info.mesh, info.head_axis, info.batch_axis,
+                         int(unroll))
     return jnp.concatenate([prompt_ids, new_tokens], axis=1)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("model", "max_new_tokens", "max_len", "greedy",
-                     "use_kernel"))
+                     "path", "mesh", "head_axis", "batch_axis", "unroll"))
 def _decode(model, variables, prompt_ids, rng, temperature, max_new_tokens,
-            max_len, greedy, use_kernel=True):
+            max_len, greedy, path="kernel", mesh=None, head_axis=None,
+            batch_axis=None, unroll=1):
     """Compiled decode body. Module-level with the model as a STATIC arg
     (flax modules hash by structure): repeated ``generate`` calls with the
     same model/shapes hit the jit cache — a per-call ``@jax.jit`` closure
     would recompile the prefill+scan program on every invocation.
-    ``use_kernel`` is part of the jit cache key (a bare global flag would
-    be ignored on a cache hit)."""
-    ctx = (contextlib.nullcontext() if use_kernel
-           else decode_kernel_disabled())
+    ``path`` (+ mesh/axes for the shard_mapped kernel; Mesh hashes by
+    devices and axis names) is part of the jit cache key — a bare global
+    flag would be ignored on a cache hit."""
+    if path == "kernel_tp":
+        ctx = decode_kernel_sharded(mesh, head_axis, batch_axis)
+    elif path == "kernel":
+        # Clear any AMBIENT decode_kernel_sharded() context: the traced
+        # program must match this cache key (path="kernel", mesh=None),
+        # not whatever context the caller happens to hold.
+        ctx = _decode_tp_override(None)
+    else:
+        ctx = decode_kernel_disabled()
     with ctx:
         return _decode_body(model, variables, prompt_ids, rng, temperature,
-                            max_new_tokens, max_len, greedy)
+                            max_new_tokens, max_len, greedy, unroll)
 
 
 def _decode_body(model, variables, prompt_ids, rng, temperature,
-                 max_new_tokens, max_len, greedy):
+                 max_new_tokens, max_len, greedy, unroll=1):
     cfg = model.config
     b, s = prompt_ids.shape
 
@@ -463,9 +642,13 @@ def _decode_body(model, variables, prompt_ids, rng, temperature,
         nxt = pick(logits[:, -1], step_rng)
         return (nxt, cache, rng), nxt
 
-    # lax.scan handles the zero-length xs of max_new_tokens == 1.
+    # lax.scan handles the zero-length xs of max_new_tokens == 1. unroll
+    # replicates the body per while iteration (decode_floor_probe: the
+    # fixed per-iteration platform cost is what bounds small-batch
+    # decode) — token stream identical at any unroll.
     (_, _, _), rest = jax.lax.scan(
-        body, (first, cache, rng), jnp.arange(max_new_tokens - 1))
+        body, (first, cache, rng), jnp.arange(max_new_tokens - 1),
+        unroll=min(unroll, max(max_new_tokens - 1, 1)))
     return jnp.concatenate([first[:, None], rest.T], axis=1)
 
 

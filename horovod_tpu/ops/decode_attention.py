@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
 
 from .attention import NEG_INF, _auto_interpret
 
@@ -211,3 +212,69 @@ def decode_attention(q, k_cache, v_cache, cache_index, num_kv_heads,
     # no cache involvement ((1, h) -> (h, 1) is not Mosaic-legal).
     out = ctx_dh / jnp.maximum(l, 1e-30)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype).reshape(b, 1, h, d)
+
+
+def sharded_decode_step(q, k_new, v_new, k_cache, v_cache, cache_index,
+                        num_kv_heads, *, mesh, head_axis,
+                        batch_axis=None, sm_scale=None,
+                        block_l: int = DECODE_BLOCK_L, interpret=None):
+    """One TP-sharded decode step: per-shard cache-row write + per-shard
+    Pallas kernel, inside ``jax.shard_map`` over the heads axis.
+
+    Attention is per-head independent and Megatron TP shards heads
+    (``models.llama.llama_tp_param_specs``: wq/wk/wv column-parallel on
+    the head axis), so the kernel is valid per shard: each program holds
+    H/tp query heads and the matching Hkv/tp K/V head rows of the
+    row-flat cache, writes ITS OWN one-row cache update, and runs the
+    unmodified single-device kernel on its slice. No collective runs
+    inside the step — the head concat is the ``out_spec``, and the psum
+    after wo stays GSPMD's job. GSPMD cannot partition the custom call
+    itself; shard_map sidesteps that by making every shard a complete
+    single-device kernel invocation, which also keeps the per-shard
+    cache buffer in the kernel-friendly layout where the row write is a
+    true in-place update (the whole point — see module docstring).
+
+    ``q``: (B, 1, H, D); ``k_new``/``v_new``: (B, 1, Hkv, D) fresh rows
+    ALREADY cast to the cache dtype; ``k_cache``/``v_cache``:
+    (B, L, Hkv*D) row-flat. ``mesh``: the device mesh; ``head_axis``:
+    the mesh axis sharding heads (tp = its size must divide Hkv);
+    ``batch_axis``: optional mesh axis sharding the batch dim (dp x tp
+    serving). Returns ``(ctx, k_cache, v_cache)`` with the new rows
+    written — the caller never touches the cache buffers itself.
+    """
+    b, s, h, d = q.shape
+    if s != 1:
+        raise ValueError(f"sharded_decode_step is single-token (s={s})")
+    hkv = num_kv_heads
+    tp = mesh.shape[head_axis]
+    if hkv % tp or h % hkv:
+        raise ValueError(
+            f"heads not shardable over {head_axis!r} (size {tp}): need "
+            f"Hkv ({hkv}) % tp == 0 and H ({h}) % Hkv == 0")
+    if batch_axis is not None and b % mesh.shape[batch_axis]:
+        raise ValueError(
+            f"batch ({b}) not divisible by {batch_axis!r} axis size "
+            f"({mesh.shape[batch_axis]})")
+    scale = sm_scale if sm_scale is not None else 1.0 / (d ** 0.5)
+    head_spec = P(batch_axis, None, head_axis, None)
+    cache_spec = P(batch_axis, None, head_axis)
+
+    def local_step(q_l, kn_l, vn_l, kc_l, vc_l, idx):
+        bl = kn_l.shape[0]
+        kc_l = lax.dynamic_update_slice(
+            kc_l, kn_l.reshape(bl, 1, -1), (0, idx, 0))
+        vc_l = lax.dynamic_update_slice(
+            vc_l, vn_l.reshape(bl, 1, -1), (0, idx, 0))
+        ctx = decode_attention(q_l, kc_l, vc_l, idx, hkv // tp,
+                               sm_scale=scale, block_l=block_l,
+                               interpret=interpret)
+        return ctx, kc_l, vc_l
+
+    return jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(head_spec, head_spec, head_spec, cache_spec, cache_spec,
+                  P()),
+        out_specs=(head_spec, cache_spec, cache_spec),
+        check_vma=False,
+    )(q, k_new, v_new, k_cache, v_cache,
+      jnp.asarray(cache_index, jnp.int32))

@@ -19,6 +19,7 @@ from .pipeline import (  # noqa: F401
 )
 from .mesh import (  # noqa: F401
     DATA_AXIS,
+    common_mesh,
     make_mesh,
     make_multislice_mesh,
     mesh,
@@ -27,5 +28,6 @@ from .mesh import (  # noqa: F401
     data_sharding,
     replicated_sharding,
     shard_batch,
+    sharding_axes,
     replicate,
 )

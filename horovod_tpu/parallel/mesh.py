@@ -131,6 +131,51 @@ def reset_mesh() -> None:
         _global_mesh = None
 
 
+def sharding_axes(x) -> Optional[tuple]:
+    """Per-dimension mesh-axis names of an array placed with a
+    ``NamedSharding``: a tuple of axis-name tuples, one per dim (``()``
+    = that dim is replicated). Returns ``None`` when the value carries
+    no ``NamedSharding`` (host numpy, tracers, other sharding types) —
+    callers treat that as "unknown", not "replicated".
+
+    The decode-path classifier (``models.llama``) uses this to recognize
+    the Megatron TP pattern (heads sharded on exactly one axis) without
+    hard-coding axis names."""
+    sh = getattr(x, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None or not isinstance(sh, NamedSharding):
+        return None
+    ndim = getattr(x, "ndim", None)
+    if ndim is None:
+        return None
+    out = []
+    for i in range(ndim):
+        entry = spec[i] if i < len(spec) else None
+        if entry is None:
+            out.append(())
+        elif isinstance(entry, str):
+            out.append((entry,))
+        else:
+            out.append(tuple(entry))
+    return tuple(out)
+
+
+def common_mesh(tree) -> Optional[Mesh]:
+    """The single ``Mesh`` shared by every ``NamedSharding`` leaf of
+    ``tree``; ``None`` when no leaf carries one OR the leaves disagree
+    (mixed meshes are "exotic" to every consumer of this helper)."""
+    found = None
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            continue
+        if found is None:
+            found = sh.mesh
+        elif sh.mesh != found:
+            return None
+    return found
+
+
 def data_sharding(m: Optional[Mesh] = None, *dims_after_batch: Optional[str]) -> NamedSharding:
     """Sharding for a batch: leading dim split over every mesh axis named
     ``data``-like; remaining dims follow ``dims_after_batch`` (default

@@ -159,6 +159,32 @@ def payload_by_op(colls: List[Collective]) -> Dict[str, int]:
 
 
 # ---------------------------------------------------------------------------
+# Decode-path attribution.
+
+#: The ``jax.named_scope`` labels ``models.llama._cached_attention`` wraps
+#: each decode path in. They survive compilation as HLO op metadata
+#: (``op_name="jit(..)/../hvd.decode.kernel_tp/.."``) — so a compiled
+#: decode program PROVES which path it traced, independent of any
+#: Python-side record (``models.llama.LAST_DECODE_PATH`` is the cheap
+#: twin). The same labels show up as ``tf_op_name`` prefixes in profiler
+#: traces, so phase tables attribute attention time per path too.
+DECODE_PATH_MARKERS = ("hvd.decode.kernel_tp", "hvd.decode.kernel",
+                       "hvd.decode.einsum", "hvd.decode.prefill")
+
+
+def decode_path_markers(compiled_or_text) -> Dict[str, int]:
+    """Count each decode-path scope marker in compiled HLO (pass a
+    ``jit(f).lower(...).compile()`` object or its ``as_text()``). A
+    decode program that really runs the shard_mapped kernel shows
+    ``kernel_tp`` > 0 and ``einsum`` == 0; the blanket fallback shows the
+    reverse — the bench's TP-decode row asserts exactly that."""
+    text = (compiled_or_text if isinstance(compiled_or_text, str)
+            else compiled_or_text.as_text())
+    return {m: len(re.findall(re.escape(m) + r"(?!\w)", text))
+            for m in DECODE_PATH_MARKERS}
+
+
+# ---------------------------------------------------------------------------
 # Ring-model wire bytes (per device, send direction).
 
 
