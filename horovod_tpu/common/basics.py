@@ -24,7 +24,9 @@ import os
 import threading
 from typing import Optional, Sequence
 
+from . import config as config_mod
 from . import hvd_logging as logging
+from . import retry
 from .config import Config
 from .topology import Topology, detect
 
@@ -63,6 +65,36 @@ _state: Optional[HorovodTpuState] = None
 _state_lock = threading.Lock()
 
 
+def _preflight_coordinator(coord: str, attempts: int = 3,
+                           timeout: float = 2.0) -> None:
+    """Cheap TCP health probe of the distributed coordinator before the
+    expensive ``jax.distributed.initialize``: a dead/unroutable coordinator
+    is reported in seconds with a precise message instead of surfacing as a
+    wedged init. Non-fatal — the retried initialize is the authority (the
+    coordinator may legitimately come up a moment later)."""
+    import socket
+
+    from .wire import parse_addr
+
+    try:
+        host, port_no = parse_addr(coord)
+    except ValueError:
+        return  # let initialize() produce its own error for a bad address
+
+    def _dial():
+        socket.create_connection((host, port_no), timeout=timeout).close()
+
+    try:
+        retry.retry_call(_dial, attempts=attempts, backoff=0.2, jitter=0.0,
+                         describe=f"preflight probe of coordinator {coord}",
+                         retry_on=(OSError,))
+    except retry.RetryError as exc:
+        logging.warning(
+            "preflight: distributed coordinator %s not reachable yet (%s); "
+            "proceeding — jax.distributed.initialize will retry/timeout",
+            coord, exc.last)
+
+
 def _maybe_init_jax_distributed() -> None:
     """Join the JAX distributed runtime when the launcher requested SPMD
     multi-host mode (``horovodrun --spmd``).
@@ -73,7 +105,11 @@ def _maybe_init_jax_distributed() -> None:
     device set, ``hvd.parallel.mesh()`` spans all hosts, and collectives
     inside ``jit`` ride ICI within a slice and DCN across slices — no
     per-tensor controller needed (the SPMD program itself is the negotiation,
-    SURVEY.md §5)."""
+    SURVEY.md §5).
+
+    Hardened (round-6 outage, artifacts/tpu_outage_r6.md): preflight-probed
+    and retried with exponential backoff under ``HOROVOD_TPU_INIT_RETRIES``/
+    ``_BACKOFF`` instead of wedging on the first dead coordinator."""
     coord = os.environ.get("HOROVOD_SPMD_COORDINATOR")
     if not coord:
         return
@@ -94,19 +130,140 @@ def _maybe_init_jax_distributed() -> None:
     if already:
         return
     kwargs = {}
-    start_timeout = os.environ.get("HOROVOD_START_TIMEOUT")
-    if start_timeout:
+    raw_timeout = (os.environ.get("HOROVOD_START_TIMEOUT") or "").strip()
+    if raw_timeout:
+        # One parser for every HOROVOD_START_TIMEOUT consumer
+        # (config.start_timeout_seconds): garbage falls back to the same
+        # 120s default the rendezvous windows use, instead of being
+        # silently dropped here and honored there. An EXPLICIT <=0 keeps
+        # the historical meaning: drop the kwarg and let
+        # jax.distributed.initialize apply its own (300s) default.
         try:
-            val = int(float(start_timeout))
+            explicit_off = float(raw_timeout) <= 0
         except (ValueError, OverflowError):
-            val = 0  # tolerate garbage like the other two parsers
-        if val > 0:
-            kwargs["initialization_timeout"] = val
-    jax.distributed.initialize(
-        coordinator_address=coord,
-        num_processes=int(size),
-        process_id=int(rank),
-        **kwargs)
+            explicit_off = False
+        if not explicit_off:
+            kwargs["initialization_timeout"] = int(
+                config_mod.start_timeout_seconds())
+    if int(rank) != 0:
+        # Rank 0 HOSTS the coordinator service inside initialize();
+        # probing it from rank 0 before the call would always fail.
+        _preflight_coordinator(coord)
+
+    def _reset_distributed_state():
+        """Best-effort teardown of a HALF-initialized jax.distributed: a
+        failed connect leaves global_state.client assigned (State.initialize
+        sets it before connecting), so without a reset every retry would
+        trip the 'should only be called once' guard and mask the real
+        error."""
+        try:
+            jax.distributed.shutdown()
+            return
+        except Exception:
+            pass
+        try:
+            from jax._src import distributed as _dist
+
+            _dist.global_state.client = None
+            _dist.global_state.service = None
+        except Exception:
+            pass
+
+    def _attempt():
+        from .. import fault
+
+        fault.hook("init_distributed")
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(size),
+                process_id=int(rank),
+                **kwargs)
+        except Exception:
+            _reset_distributed_state()
+            raise
+
+    attempts, backoff = retry.init_retry_env()
+    retry.retry_call(_attempt, attempts=attempts, backoff=backoff,
+                     seed=int(rank), describe="jax.distributed.initialize")
+
+
+def _acquire_backend() -> bool:
+    """Force JAX backend (TPU runtime) acquisition under the init retry
+    policy, so a wedged/flaky backend init fails fast and retries instead
+    of hanging the rank forever (the round-6 failure mode).
+
+    Returns whether the backend is usable. False means NOTHING may touch
+    jax device APIs again this process — an abandoned wedged attempt may
+    still hold xla_bridge's backend lock, so any re-entry (including
+    topology's device probe) would hang unboundedly.
+
+    With ``HOROVOD_TPU_INIT_FALLBACK_CPU=1`` an exhausted retry budget
+    degrades — loudly — to a CPU dryrun backend so the job can still run
+    parity/debug work while the pool is down."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax always present in this image
+        return False  # same tolerance as topology._device_counts
+
+    from .. import fault as fault_mod
+
+    # Bounded BY DEFAULT: the r6 outage was an init that hung rather than
+    # raised — with no deadline the retry/fallback machinery would never
+    # even engage. 300s is ~10x a healthy cold TPU init; 0 disables.
+    per_attempt = config_mod._env_float("HOROVOD_TPU_INIT_TIMEOUT", 300.0)
+
+    def _attempt():
+        fault_mod.hook("init")
+        # device_count materializes the platform backend (the call that
+        # wedged in artifacts/tpu_outage_r6.md).
+        return retry.run_with_deadline(
+            jax.local_device_count, per_attempt, "jax backend init")
+
+    attempts, backoff = retry.init_retry_env()
+    try:
+        retry.retry_call(_attempt, attempts=attempts, backoff=backoff,
+                         seed=int(os.environ.get("HOROVOD_RANK", "0") or 0),
+                         describe="jax backend acquisition")
+        return True
+    except retry.RetryError as exc:
+        from .config import _env_bool
+
+        if _env_bool("HOROVOD_TPU_INIT_FALLBACK_CPU"):
+            logging.error(
+                "jax backend acquisition failed after %d attempts; "
+                "HOROVOD_TPU_INIT_FALLBACK_CPU=1 — DEGRADING TO THE CPU "
+                "DRYRUN BACKEND. This process will NOT use accelerators; "
+                "results are for parity/debugging only.", attempts)
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            jax.config.update("jax_platforms", "cpu")
+            # The fallback itself must stay deadline-bounded: an abandoned
+            # wedged attempt may still hold xla_bridge's backend lock, and
+            # an unbounded call here would wedge the very path built to
+            # never wedge.
+            try:
+                retry.run_with_deadline(
+                    jax.local_device_count, per_attempt or 120.0,
+                    "CPU fallback backend init")
+                return True
+            except retry.DeadlineExceeded:
+                logging.error(
+                    "CPU fallback is unreachable too: the wedged init "
+                    "attempt still holds the JAX backend lock. Continuing "
+                    "on the host-only eager tier; jax device APIs are "
+                    "UNUSABLE in this process.")
+                return False
+        if isinstance(exc.last, fault_mod.FaultInjected):
+            raise  # injected wedges are test assertions: never swallow
+        # Bounded, loud, and non-fatal — the pre-hardening contract
+        # (topology._device_counts) tolerated a dead backend by reporting
+        # 0 devices; the eager host tier still works without accelerators.
+        logging.error(
+            "jax backend acquisition failed after %d bounded attempts "
+            "(%s); continuing WITHOUT accelerator devices — set "
+            "HOROVOD_TPU_INIT_FALLBACK_CPU=1 to degrade to a CPU dryrun "
+            "backend, or fix the TPU pool and relaunch", attempts, exc.last)
+        return False
 
 
 def init(ranks: Optional[Sequence[int]] = None) -> None:
@@ -132,7 +289,10 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
 
         maybe_install_from_env()
         _maybe_init_jax_distributed()
-        topology = detect(ranks)
+        backend_ok = _acquire_backend()
+        # After a failed acquisition the device probe must not re-enter
+        # jax (a wedged attempt may still hold the backend lock).
+        topology = detect(ranks, probe_devices=backend_ok)
         logging.set_rank(topology.rank)
         _state = HorovodTpuState(config, topology)
         # Engine selection for the multi-process eager tier: the native C++

@@ -24,6 +24,48 @@ DEFAULT_FUSION_THRESHOLD_BYTES = 64 * 1024 * 1024
 DEFAULT_CYCLE_TIME_MS = 5.0
 DEFAULT_CACHE_CAPACITY = 1024
 DEFAULT_STALL_CHECK_SECONDS = 60.0
+DEFAULT_START_TIMEOUT_SECONDS = 120.0
+# Liveness bound for the post-rendezvous control plane: a blocked recv that
+# sees NO frame (not even a heartbeat) for this long declares the peer dead
+# instead of hanging forever (the reference's timeout-less sockets could).
+DEFAULT_COMM_TIMEOUT_SECONDS = 120.0
+
+
+def start_timeout_seconds(
+        default: float = DEFAULT_START_TIMEOUT_SECONDS) -> float:
+    """THE parser for ``HOROVOD_START_TIMEOUT`` (reference horovodrun
+    --start-timeout). Garbage and non-positive values fall back to
+    ``default`` — every consumer (rendezvous accept/connect windows in
+    ``controller/service.py``, ``jax.distributed.initialize`` in
+    ``common/basics.py``) must agree, or the two planes time out at
+    different moments and the slower one wins by hanging."""
+    try:
+        val = float(os.environ.get("HOROVOD_START_TIMEOUT", ""))
+    except (ValueError, OverflowError):
+        return default
+    return val if val > 0 else default
+
+
+def comm_timeout_seconds() -> float:
+    """``HOROVOD_COMM_TIMEOUT_SECONDS``: per-recv liveness deadline on the
+    eager control plane. 0 (or negative) disables the deadline entirely —
+    the pre-fault-tolerance behavior."""
+    val = _env_float("HOROVOD_COMM_TIMEOUT_SECONDS",
+                     DEFAULT_COMM_TIMEOUT_SECONDS)
+    return val if val > 0 else 0.0
+
+
+def heartbeat_interval_seconds() -> float:
+    """``HOROVOD_HEARTBEAT_INTERVAL_SECONDS``: idle-cycle heartbeat frame
+    period (0 disables). Defaults to a quarter of the comm timeout capped
+    at 10s, so a live-but-quiet peer always beats the deadline with slack
+    for scheduler noise. With the deadline disabled entirely
+    (HOROVOD_COMM_TIMEOUT_SECONDS=0) heartbeats default OFF too — nothing
+    would consume them; the env var still forces them on if wanted."""
+    timeout = comm_timeout_seconds()
+    default = min(10.0, timeout / 4.0) if timeout else 0.0
+    val = _env_float("HOROVOD_HEARTBEAT_INTERVAL_SECONDS", default)
+    return val if val > 0 else 0.0
 
 
 def ring_data_plane_enabled() -> bool:
@@ -89,6 +131,10 @@ class Config:
     stall_check_disable: bool = False
     stall_check_seconds: float = DEFAULT_STALL_CHECK_SECONDS
     stall_shutdown_seconds: float = 0.0  # 0 = never force shutdown
+    # Liveness: per-recv control-plane deadline (0 = no deadline) and idle
+    # heartbeat period (0 = no heartbeats). See docs/fault-tolerance.md.
+    comm_timeout_seconds: float = DEFAULT_COMM_TIMEOUT_SECONDS
+    heartbeat_interval_seconds: float = 10.0
     # Autotuner (reference parameter_manager.cc).
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -121,6 +167,8 @@ class Config:
             stall_shutdown_seconds=_env_float(
                 "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0
             ),
+            comm_timeout_seconds=comm_timeout_seconds(),
+            heartbeat_interval_seconds=heartbeat_interval_seconds(),
             autotune=_env_bool("HOROVOD_AUTOTUNE"),
             autotune_log=autotune_log,
             log_level=os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower(),

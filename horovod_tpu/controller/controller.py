@@ -49,7 +49,9 @@ from ..common.message import (
 )
 from ..common.response_cache import ResponseCache
 from ..common.topology import Topology
-from .service import CoordinatorService, WorkerClient
+from ..common.wire import RemoteAbortError
+from .. import fault
+from .service import CoordinatorService, PeerFailureError, WorkerClient
 
 _OP_NAMES = {
     RequestType.ALLREDUCE: "ALLREDUCE",
@@ -97,6 +99,10 @@ class Controller:
         self._autoname_counter: Dict[str, int] = {}
         self._shutdown_requested = False
         self._closed = threading.Event()
+        # The diagnosed transport failure, if any: ops enqueued AFTER the
+        # job died resolve with the same descriptive error as the ops that
+        # were in flight, not a bare "has been shut down".
+        self._failure: Optional[BaseException] = None
         self._stall_warned: Dict[str, float] = {}
         # Live (autotunable) copies of the two continuous knobs (reference
         # ParameterManager owns these, parameter_manager.h:35-43).
@@ -166,15 +172,21 @@ class Controller:
 
         addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
         if topology.rank == 0:
-            self._service = CoordinatorService(addr, topology.size)
+            self._service = CoordinatorService(
+                addr, topology.size,
+                comm_timeout=config.comm_timeout_seconds)
             self._client = None
             # Coordinator's MessageTable (reference global_state.h:34):
             # name -> {rank: Request}; plus first-seen stamps for stall check.
             self._message_table: Dict[str, Dict[int, Request]] = {}
             self._first_seen: Dict[str, float] = {}
+            self._service.start_heartbeats(config.heartbeat_interval_seconds)
         else:
             self._service = None
-            self._client = WorkerClient(addr, topology.rank)
+            self._client = WorkerClient(
+                addr, topology.rank,
+                comm_timeout=config.comm_timeout_seconds)
+            self._client.start_heartbeats(config.heartbeat_interval_seconds)
 
         self._thread = threading.Thread(
             target=self._run_loop, name="hvd-controller", daemon=True)
@@ -209,8 +221,14 @@ class Controller:
         handle = self.handles.allocate()
         entry = _Pending(name, array, req, handle, average, postprocess)
         with self._lock:
-            if self._closed.is_set() or self._shutdown_requested:
-                handle.set_error(ShutdownError("Horovod has been shut down"))
+            # _failure is part of the closed predicate: _fail_all runs
+            # (and clears the table) BEFORE _run_loop's finally sets
+            # _closed — an enqueue landing in that window would sit in a
+            # dead table forever.
+            if (self._closed.is_set() or self._shutdown_requested
+                    or self._failure is not None):
+                handle.set_error(self._failure or ShutdownError(
+                    "Horovod has been shut down"))
                 return handle
             if name in self._table:
                 # Reference IncrementTensorCount duplicate-name error
@@ -352,14 +370,7 @@ class Controller:
                         time.sleep(delay)
         except Exception as exc:  # transport failure: fail all pending work
             logging.error("controller loop failed: %s", exc)
-            if not isinstance(exc, RuntimeError):
-                # Raw transport errors (a peer died: ConnectionError, EOF)
-                # surface as the engine-error RuntimeError the native
-                # engine raises, so callers see ONE failure contract.
-                exc = RuntimeError(
-                    f"Horovod controller failed: {exc} "
-                    "(a peer process likely died)")
-            self._fail_all(exc)
+            self._fail_all(self._diagnose_failure(exc))
         finally:
             self._closed.set()
             for ring in (self._ring, self._local_ring, self._cross_ring):
@@ -369,6 +380,51 @@ class Controller:
                 self._service.close()
             if self._client:
                 self._client.close()
+
+    def _inflight_summary(self) -> str:
+        """Which ops were pending when the job died — attached to every
+        failed handle so the operator sees WHAT was lost, not just that
+        something was."""
+        with self._lock:
+            names = sorted(self._table)
+        if not names:
+            return "none"
+        shown = ", ".join(repr(n) for n in names[:8])
+        if len(names) > 8:
+            shown += f", ... ({len(names)} total)"
+        return shown
+
+    def _diagnose_failure(self, exc: BaseException) -> RuntimeError:
+        """Turn a raw transport failure into ONE descriptive engine error,
+        and — on the coordinator — broadcast the diagnosis as a coordinated
+        abort so every surviving rank fails the same way immediately
+        instead of waiting out its own timeout."""
+        inflight = self._inflight_summary()
+        if isinstance(exc, PeerFailureError):
+            # Coordinator diagnosed a specific dead worker.
+            msg = (f"Horovod controller failed: rank {exc.rank} died or "
+                   f"became unreachable ({exc.cause}); in-flight ops: "
+                   f"{inflight}")
+            if self._service is not None:
+                self._service.send_abort_all(
+                    msg, dead_rank=exc.rank,
+                    op=None if inflight == "none" else inflight)
+            return RuntimeError(msg)
+        if isinstance(exc, RemoteAbortError):
+            # The coordinator told us who died and what was pending there.
+            return RuntimeError(f"Horovod controller failed: job aborted by "
+                                f"coordinator: {exc}")
+        if self._client is not None and isinstance(exc, (ConnectionError,
+                                                         OSError)):
+            return RuntimeError(
+                f"Horovod controller failed: lost contact with the "
+                f"coordinator (rank 0): {exc}; in-flight ops: {inflight}")
+        if not isinstance(exc, RuntimeError):
+            # Raw transport errors surface as the engine-error RuntimeError
+            # the native engine raises, so callers see ONE failure contract.
+            return RuntimeError(f"Horovod controller failed: {exc} "
+                                "(a peer process likely died)")
+        return exc
 
     def _build_tick(self) -> dict:
         with self._lock:
@@ -404,6 +460,7 @@ class Controller:
         }
 
     def _cycle(self) -> None:
+        fault.hook("cycle")  # chaos seam: kill/delay/raise at cycle N
         tick = self._build_tick()
         if self.topo.rank == 0:
             t0 = time.monotonic()
@@ -606,12 +663,17 @@ class Controller:
                 response, cache_put=self._cache_enabled)
 
         if rlist.shutdown or self._shutdown_requested:
-            self._fail_all(ShutdownError("Horovod has been shut down"))
+            # Close BEFORE failing: once _fail_all empties the table, a
+            # concurrently-enqueued op must take the closed branch, not
+            # land in a table nobody will ever serve.
             self._closed.set()
+            self._fail_all(ShutdownError("Horovod has been shut down"))
         return executed_bytes
 
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
+            if self._failure is None and not isinstance(exc, ShutdownError):
+                self._failure = exc
             entries = list(self._table.values())
             self._table.clear()
             self._queue.clear()

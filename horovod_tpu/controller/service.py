@@ -12,44 +12,106 @@ The same connections carry the host-tensor data phases (the reference's MPI
 CPU ops, ``common/ops/mpi_operations.cc``): the protocol is strict lockstep —
 every rank walks the identical response list in the identical order — so
 control and data frames never interleave ambiguously.
+
+Liveness (no reference analogue — later Horovod grew this as Elastic):
+after rendezvous every wire gets a per-recv deadline
+(``HOROVOD_COMM_TIMEOUT_SECONDS``) and both sides run a heartbeat thread
+(``HOROVOD_HEARTBEAT_INTERVAL_SECONDS``) so a blocked recv can tell a slow
+peer (heartbeats still arriving) from a dead one (deadline fires). A
+coordinator that diagnoses a dead worker broadcasts ABORT frames so every
+surviving rank fails its pending work with the diagnosis instead of
+waiting out its own timeout.
 """
 
 from __future__ import annotations
 
 import socket
+import threading
 import time
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 from ..common import hvd_logging as logging
-from ..common.wire import Wire
+from ..common.config import (
+    comm_timeout_seconds,
+    heartbeat_interval_seconds,
+    start_timeout_seconds,
+)
+from ..common.wire import CommTimeoutError, Wire, parse_addr  # noqa: F401
+# parse_addr re-exported: existing callers import it from here. The
+# rendezvous windows read the launcher-exported HOROVOD_START_TIMEOUT
+# through the one shared parser, config.start_timeout_seconds.
 
 
-def _start_timeout() -> float:
-    """Rendezvous window, launcher-exported (reference horovodrun
-    --start-timeout; run/run.py:285-342)."""
-    import os
+class PeerFailureError(RuntimeError):
+    """A specific peer's connection died or timed out: carries WHICH rank,
+    so the coordinator can broadcast a diagnosis instead of a bare EOF."""
 
-    try:
-        val = float(os.environ.get("HOROVOD_START_TIMEOUT", "120"))
-    except ValueError:
-        return 120.0
-    # Non-positive would mean an already-expired window (ring.cc applies the
-    # same v > 0 guard, so both planes fall back identically).
-    return val if val > 0 else 120.0
+    def __init__(self, rank: int, cause: BaseException):
+        self.rank = rank
+        self.cause = cause
+        super().__init__(f"lost contact with rank {rank}: {cause}")
 
 
-def parse_addr(addr: str) -> Tuple[str, int]:
-    host, _, port = addr.rpartition(":")
-    return host or "127.0.0.1", int(port)
+class _HeartbeatMixin:
+    """Idle-cycle liveness frames over one or many wires. Heartbeats are
+    skipped transparently by ``Wire.recv_bytes``, so they may interleave
+    anywhere in the lockstep protocol; send errors are ignored — death is
+    diagnosed on the recv side, where the rank context lives."""
+
+    _hb_thread: Optional[threading.Thread] = None
+    _hb_stop: Optional[threading.Event] = None
+
+    def _hb_wires(self):
+        raise NotImplementedError
+
+    def start_heartbeats(self, interval: Optional[float] = None) -> None:
+        if self._hb_thread is not None:
+            return
+        if interval is None:
+            interval = heartbeat_interval_seconds()
+        if not interval or interval <= 0:
+            return
+        self._hb_stop = threading.Event()
+
+        def _beat(stop=self._hb_stop):
+            while not stop.wait(interval):
+                for wire in self._hb_wires():
+                    try:
+                        # Non-blocking: one stalled peer must not starve
+                        # heartbeats to the healthy ones.
+                        wire.try_send_heartbeat()
+                    except Exception:
+                        pass  # recv side owns the diagnosis
+
+        self._hb_thread = threading.Thread(
+            target=_beat, name="hvd-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+        self._hb_thread = None
+        self._hb_stop = None
 
 
-class CoordinatorService:
-    """Rank 0's side: accept one connection per worker rank."""
+class CoordinatorService(_HeartbeatMixin):
+    """Rank 0's side: accept one connection per worker rank.
+
+    The hello is validated before a connection is admitted: an
+    out-of-range or duplicate rank id (or a connection that never sends a
+    well-formed hello within the rendezvous window) is rejected and closed
+    — silently overwriting ``self.wires[rank]`` would leak the previous
+    socket and corrupt the connected count."""
 
     def __init__(self, bind_addr: str, size: int,
-                 accept_timeout: Optional[float] = None):
+                 accept_timeout: Optional[float] = None,
+                 comm_timeout: Optional[float] = None):
         if accept_timeout is None:
-            accept_timeout = _start_timeout()
+            accept_timeout = start_timeout_seconds()
+        if comm_timeout is None:
+            comm_timeout = comm_timeout_seconds()
         host, port = parse_addr(bind_addr)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -60,49 +122,110 @@ class CoordinatorService:
         while len(self.wires) < size - 1:
             self._listener.settimeout(max(0.1, deadline - time.monotonic()))
             try:
-                conn, _ = self._listener.accept()
+                conn, peer = self._listener.accept()
             except socket.timeout:
                 raise TimeoutError(
                     f"coordinator: only {len(self.wires)}/{size - 1} workers "
                     f"connected within {accept_timeout}s")
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # A connected-but-silent client (port scanner, k8s TCP probe)
+            # must neither wedge the rendezvous NOR eat the whole remaining
+            # accept window: real workers send their hello immediately, so
+            # a few seconds is generous.
+            conn.settimeout(
+                min(5.0, max(0.1, deadline - time.monotonic())))
             wire = Wire(conn)
-            hello = wire.recv_obj()
-            rank = int(hello["rank"])
+            try:
+                hello = wire.recv_obj()
+                rank = int(hello["rank"])
+            except Exception as exc:
+                logging.warning(
+                    "coordinator: rejecting connection from %s "
+                    "(bad hello: %s)", peer, exc)
+                wire.close()
+                continue
+            if not 1 <= rank < size:
+                logging.warning(
+                    "coordinator: rejecting hello with out-of-range rank %d "
+                    "(job size %d)", rank, size)
+                wire.close()
+                continue
+            if rank in self.wires:
+                logging.warning(
+                    "coordinator: rejecting duplicate hello for rank %d "
+                    "(keeping the first connection)", rank)
+                wire.close()
+                continue
+            conn.settimeout(None)
             self.wires[rank] = wire
             logging.debug("coordinator: rank %d connected", rank)
+        for wire in self.wires.values():
+            wire.set_deadline(comm_timeout)
 
     def recv_from(self, rank: int) -> Any:
-        return self.wires[rank].recv_obj()
+        try:
+            return self.wires[rank].recv_obj()
+        except (CommTimeoutError, ConnectionError, OSError) as exc:
+            raise PeerFailureError(rank, exc) from exc
 
     def recv_bytes_from(self, rank: int) -> bytes:
-        return self.wires[rank].recv_bytes()
+        try:
+            return self.wires[rank].recv_bytes()
+        except (CommTimeoutError, ConnectionError, OSError) as exc:
+            raise PeerFailureError(rank, exc) from exc
 
     def send_to(self, rank: int, obj: Any) -> None:
-        self.wires[rank].send_obj(obj)
+        try:
+            self.wires[rank].send_obj(obj)
+        except (ConnectionError, OSError) as exc:
+            raise PeerFailureError(rank, exc) from exc
 
     def send_bytes_to(self, rank: int, payload: bytes) -> None:
-        self.wires[rank].send_bytes(payload)
+        try:
+            self.wires[rank].send_bytes(payload)
+        except (ConnectionError, OSError) as exc:
+            raise PeerFailureError(rank, exc) from exc
 
     def send_all(self, obj: Any) -> None:
         for rank in sorted(self.wires):
-            self.wires[rank].send_obj(obj)
+            self.send_to(rank, obj)
+
+    def send_abort_all(self, message: str, dead_rank: Optional[int] = None,
+                       op: Optional[str] = None) -> None:
+        """Best-effort coordinated abort: every surviving worker's next
+        recv — control or data phase — raises RemoteAbortError with this
+        diagnosis."""
+        for rank in sorted(self.wires):
+            if rank == dead_rank:
+                continue
+            try:
+                self.wires[rank].send_abort(message, dead_rank=dead_rank,
+                                            op=op)
+            except Exception:
+                pass  # that worker is dying too; nothing more to do
+
+    def _hb_wires(self):
+        return list(self.wires.values())
 
     def close(self) -> None:
+        self.stop_heartbeats()
         for wire in self.wires.values():
             wire.close()
         self._listener.close()
 
 
-class WorkerClient:
+class WorkerClient(_HeartbeatMixin):
     """A non-zero rank's side: one persistent connection, with connect
     retries while the coordinator comes up (the reference's task services
     retry registration the same way, ``run/common/service/driver_service.py``)."""
 
     def __init__(self, addr: str, rank: int,
-                 connect_timeout: Optional[float] = None):
+                 connect_timeout: Optional[float] = None,
+                 comm_timeout: Optional[float] = None):
         if connect_timeout is None:
-            connect_timeout = _start_timeout()
+            connect_timeout = start_timeout_seconds()
+        if comm_timeout is None:
+            comm_timeout = comm_timeout_seconds()
         host, port = parse_addr(addr)
         deadline = time.monotonic() + connect_timeout
         last_err: Optional[Exception] = None
@@ -120,6 +243,14 @@ class WorkerClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.wire = Wire(sock)
         self.wire.send_obj({"rank": rank})
+        if comm_timeout:
+            # The coordinator stays silent (no replies, no heartbeats)
+            # until EVERY worker has connected: grant the first frame the
+            # whole remaining rendezvous window on top of the liveness
+            # deadline, or an early-connecting worker on a slow multi-host
+            # launch would declare a healthy coordinator dead.
+            self.wire.set_deadline(comm_timeout,
+                                   first=comm_timeout + connect_timeout)
 
     def send(self, obj: Any) -> None:
         self.wire.send_obj(obj)
@@ -133,5 +264,9 @@ class WorkerClient:
     def recv_bytes(self) -> bytes:
         return self.wire.recv_bytes()
 
+    def _hb_wires(self):
+        return [self.wire]
+
     def close(self) -> None:
+        self.stop_heartbeats()
         self.wire.close()

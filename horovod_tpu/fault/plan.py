@@ -1,0 +1,180 @@
+"""Seeded, deterministic fault injection for the control plane.
+
+Elastic/fault-tolerant behavior is only trustworthy if every failure mode
+is reproducible in CPU-only tests: "kill rank 1 at cycle 20", "drop the
+next tick frame", "wedge backend init twice" must mean the same thing on
+every run. A :class:`FaultPlan` is a list of rules loaded once per process
+from ``HOROVOD_FAULT_PLAN`` (inline JSON, or ``@/path/to/plan.json``);
+hooks in ``Wire.send/recv`` (sites ``wire_send``/``wire_recv``), the
+controller cycle loop (``cycle``), and backend/distributed init (``init``)
+consult it. All counting is per-site and deterministic; the only use of
+randomness is optional delay jitter, drawn from a ``random.Random(seed)``
+so two runs with the same plan sleep the same amounts.
+
+Rule fields (JSON object per rule):
+
+    site     "wire_send" | "wire_recv" | "cycle" | "init" (backend
+             acquisition) | "init_distributed" (jax.distributed join) —
+             the two init paths count separately so a plan's "at"/"times"
+             don't shift with the launch mode
+    action   "kill"  — SIGKILL this process (a real crash, no cleanup)
+             "exit"  — os._exit(1) (a crash that still reports non-zero)
+             "delay" — sleep ``seconds`` (± ``jitter`` fraction, seeded)
+             "drop"  — wire_send only: silently skip sending the frame
+             "raise" — raise FaultInjected(``message``)
+             "wedge" — init only: raise InitWedged for the first ``times``
+                       attempts, succeed afterwards
+    at       fire on the at-th event at this site (1-based); "wedge"
+             ignores it (always the first ``times`` attempts)
+    times    how many consecutive events fire (default 1)
+    rank     only apply in the process with this HOROVOD_RANK (default all)
+    seconds  delay duration (action "delay")
+    jitter   ± fraction of ``seconds`` (seeded; default 0 = deterministic)
+    message  error text for action "raise"
+
+The hot path (``fault.hook(site)``) is a no-op returning ``None`` when no
+plan is configured — one module-global read and a ``None`` check — so the
+wire fast path pays nothing in production.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import signal
+import threading
+import time
+from typing import Dict, List, Optional
+
+VALID_SITES = ("wire_send", "wire_recv", "cycle", "init",
+               "init_distributed")
+_INIT_SITES = ("init", "init_distributed")
+VALID_ACTIONS = ("kill", "exit", "delay", "drop", "raise", "wedge")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an action "raise" rule (and the base of InitWedged)."""
+
+
+class InitWedged(FaultInjected):
+    """Injected init failure (action "wedge"): the shape of a TPU backend
+    that hangs or errors K times before coming healthy (artifacts/
+    tpu_outage_r6.md) — retried by ``common/retry.py``."""
+
+
+@dataclasses.dataclass
+class FaultRule:
+    site: str
+    action: str
+    at: Optional[int] = None
+    times: int = 1
+    rank: Optional[int] = None
+    seconds: float = 0.0
+    jitter: float = 0.0
+    message: str = ""
+
+    def __post_init__(self):
+        if self.site not in VALID_SITES:
+            raise ValueError(f"unknown fault site {self.site!r} "
+                             f"(valid: {VALID_SITES})")
+        if self.action not in VALID_ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r} "
+                             f"(valid: {VALID_ACTIONS})")
+        if self.action == "wedge" and self.site not in _INIT_SITES:
+            raise ValueError('action "wedge" only applies to the init '
+                             f'sites {_INIT_SITES}')
+        if self.action == "drop" and self.site != "wire_send":
+            raise ValueError('action "drop" only applies to site '
+                             '"wire_send"')
+        if self.action != "wedge" and self.at is None:
+            # Without "at" the rule would never fire — a chaos run that
+            # silently tests nothing. Fail at load, not at runtime.
+            raise ValueError(
+                f'rule {self.site}/{self.action} needs "at" (the 1-based '
+                'event number to fire on); only "wedge" may omit it')
+
+    def fires_at(self, count: int) -> bool:
+        """Whether this rule fires on the ``count``-th event (1-based)."""
+        if self.action == "wedge":
+            return count <= self.times
+        if self.at is None:
+            return False
+        return self.at <= count < self.at + self.times
+
+
+class FaultPlan:
+    """The rules that apply to THIS process, with per-site event counters."""
+
+    def __init__(self, rules: List[FaultRule], seed: int = 0,
+                 rank: Optional[int] = None):
+        self.seed = seed
+        self.rank = rank
+        self.rules = [r for r in rules
+                      if r.rank is None or r.rank == rank]
+        self._counts: Dict[str, int] = {}
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_json(cls, text: str,
+                  rank: Optional[int] = None) -> "FaultPlan":
+        spec = json.loads(text)
+        if isinstance(spec, list):  # bare rule list shorthand
+            spec = {"faults": spec}
+        rules = [FaultRule(**entry) for entry in spec.get("faults", [])]
+        return cls(rules, seed=int(spec.get("seed", 0)), rank=rank)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        raw = os.environ.get("HOROVOD_FAULT_PLAN")
+        if not raw or not raw.strip():
+            return None
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        rank_env = os.environ.get("HOROVOD_RANK")
+        rank = int(rank_env) if rank_env and rank_env.strip() else None
+        return cls.from_json(raw, rank=rank)
+
+    def count(self, site: str) -> int:
+        """Events seen so far at ``site`` (for tests/introspection)."""
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def fire(self, site: str) -> Optional[str]:
+        """Record one event at ``site`` and execute any matching rule.
+
+        Returns ``"drop"`` when the caller must skip the operation;
+        executes delay/kill/exit inline; raises for "raise"/"wedge".
+        """
+        with self._lock:
+            count = self._counts.get(site, 0) + 1
+            self._counts[site] = count
+            fired = [r for r in self.rules
+                     if r.site == site and r.fires_at(count)]
+            delays = [r.seconds * (1.0 + r.jitter * self._rng.uniform(-1, 1)
+                                   if r.jitter else 1.0)
+                      for r in fired if r.action == "delay"]
+        result: Optional[str] = None
+        for delay in delays:  # sleep outside the lock
+            if delay > 0:
+                time.sleep(delay)
+        for rule in fired:
+            if rule.action == "kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+            elif rule.action == "exit":
+                os._exit(1)
+            elif rule.action == "drop":
+                result = "drop"
+            elif rule.action == "wedge":
+                raise InitWedged(
+                    rule.message
+                    or f"fault injection: init wedged (attempt {count} of "
+                       f"{rule.times} injected failures)")
+            elif rule.action == "raise":
+                raise FaultInjected(
+                    rule.message
+                    or f"fault injection: raise at {site} event {count}")
+        return result

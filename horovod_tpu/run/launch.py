@@ -75,7 +75,8 @@ def _is_local(host: str) -> bool:
 def build_rank_env(base: Dict[str, str], rank: int, size: int,
                    local_rank: int, local_size: int, cross_rank: int,
                    cross_size: int, controller_addr: str, secret: str,
-                   bind_chips: bool, spmd: bool = False) -> Dict[str, str]:
+                   bind_chips: bool, spmd: bool = False,
+                   restart_epoch: int = 0) -> Dict[str, str]:
     env = dict(base)
     env.update({
         "HOROVOD_RANK": str(rank),
@@ -85,6 +86,9 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
         "HOROVOD_CROSS_RANK": str(cross_rank),
         "HOROVOD_CROSS_SIZE": str(cross_size),
         "HOROVOD_SECRET_KEY": secret,
+        # Supervision attempt number (--max-restarts): training scripts
+        # key restart-vs-fresh on this (utils.checkpoint.restart_epoch()).
+        "HOROVOD_RESTART_EPOCH": str(restart_epoch),
     })
     # Ranks we spawn watch their parent and die when orphaned (local: this
     # launcher; remote: the ssh session's shell). HOROVOD_PARENT_WATCHDOG=0
@@ -275,6 +279,44 @@ def _stream(prefix: str, pipe, out) -> None:
 
 
 def run(args: argparse.Namespace) -> int:
+    """Supervised launch: run the job, and on a non-zero exit tear it down,
+    back off, and relaunch up to ``--max-restarts`` times with
+    ``HOROVOD_RESTART_EPOCH`` bumped (elastic-lite: training scripts resume
+    from their latest ``utils/checkpoint.py`` checkpoint — later Horovod
+    solved this as Elastic Horovod; on TPU the supervisor restarts whole
+    processes instead of rebuilding rings in place)."""
+    max_restarts = getattr(args, "max_restarts", 0)
+    backoff = max(0.0, getattr(args, "restart_backoff", 1.0))
+    epoch = 0
+    interrupted = threading.Event()
+    while True:
+        code = _run_attempt(args, restart_epoch=epoch,
+                            interrupted=interrupted)
+        if interrupted.is_set():
+            # Operator-initiated teardown (SIGINT/SIGTERM) is not a fault;
+            # never auto-restart over the operator's intent.
+            return code
+        if code == 0 or epoch >= max_restarts:
+            if code != 0 and max_restarts > 0:
+                sys.stderr.write(
+                    f"horovodrun: giving up after {epoch} restart(s); "
+                    f"final exit code {code}\n")
+            return code
+        epoch += 1
+        delay = min(30.0, backoff * (2.0 ** (epoch - 1)))
+        sys.stderr.write(
+            f"horovodrun: job failed with exit code {code}; restarting "
+            f"(attempt {epoch}/{max_restarts}) in {delay:.1f}s with "
+            f"HOROVOD_RESTART_EPOCH={epoch}\n")
+        # Event.wait, not time.sleep: a SIGINT during the backoff (the
+        # still-installed handler sets `interrupted`) must cancel the
+        # relaunch, not schedule one more multi-hour attempt.
+        if interrupted.wait(delay):
+            return code
+
+
+def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
+                 interrupted: Optional[threading.Event] = None) -> int:
     hosts = parse_hosts(args.hosts, args.np)
     size = args.np
     secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
@@ -396,7 +438,7 @@ def run(args: argparse.Namespace) -> int:
         env = build_rank_env(
             dict(os.environ), rank, size, local_rank, local_size,
             cross_rank, len(groups), coord_addr, secret, args.bind_chips,
-            spmd=args.spmd)
+            spmd=args.spmd, restart_epoch=restart_epoch)
         env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
         if not args.spmd:
             env["HOROVOD_RING_ADDRS"] = ring_addrs_env
@@ -437,6 +479,8 @@ def run(args: argparse.Namespace) -> int:
         spawn(*a)
 
     def _terminate_all(signum=None, frame=None):
+        if signum is not None and interrupted is not None:
+            interrupted.set()  # operator signal: suppress supervised restart
         for p in procs:
             if p.poll() is None:
                 p.terminate()
@@ -504,6 +548,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="seconds to wait for all ranks to start and "
                              "rendezvous before aborting (reference "
                              "horovodrun --start-timeout)")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="on a non-zero rank exit, tear the job down "
+                             "and relaunch up to N times with exponential "
+                             "backoff and HOROVOD_RESTART_EPOCH bumped; "
+                             "training scripts resume from their latest "
+                             "checkpoint (elastic-lite; default 0 = no "
+                             "restarts)")
+    parser.add_argument("--restart-backoff", type=float, default=1.0,
+                        help="base seconds for the exponential restart "
+                             "backoff (doubles per restart, capped at 30s)")
     parser.add_argument("--disable-cache", action="store_true",
                         help="skip the ssh-preflight result cache "
                              "(reference horovodrun --disable-cache)")

@@ -7,9 +7,11 @@ import just to enumerate NICs. ``nic_discovery`` imports the shared pieces
 from here (single implementation); the wire framing below must stay
 byte-compatible with ``common/wire.py``:
 
-    [4-byte big-endian length][32-byte HMAC-SHA256][pickled payload]
+    [1-byte kind][4-byte big-endian length][32-byte HMAC-SHA256][payload]
 
-keyed by ``HOROVOD_SECRET_KEY`` (hex) from the environment.
+keyed by ``HOROVOD_SECRET_KEY`` (hex) from the environment, HMAC over
+kind+payload. The probe protocol only uses kind 0 (DATA) and skips
+kind 1 (HEARTBEAT) frames like the package Wire does.
 """
 
 from __future__ import annotations
@@ -26,9 +28,11 @@ import threading
 from typing import List, Optional, Sequence, Tuple
 
 PROBE_TIMEOUT = 3.0
-_LEN = struct.Struct(">I")
+_HDR = struct.Struct(">BI")  # wire._HDR: frame kind, payload length
 _DIGEST_LEN = 32
 _MAX_FRAME = 1 << 31  # wire.MAX_FRAME: bound BEFORE reading the payload
+_FRAME_DATA = 0
+_FRAME_HEARTBEAT = 1
 
 
 def _secret() -> bytes:
@@ -40,8 +44,9 @@ def _secret() -> bytes:
 
 def _send_obj(sock: socket.socket, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    digest = hmac.new(_secret(), payload, hashlib.sha256).digest()
-    sock.sendall(_LEN.pack(len(payload)) + digest + payload)
+    digest = hmac.new(_secret(), bytes((_FRAME_DATA,)) + payload,
+                      hashlib.sha256).digest()
+    sock.sendall(_HDR.pack(_FRAME_DATA, len(payload)) + digest + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -56,16 +61,22 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_obj(sock: socket.socket):
-    header = _recv_exact(sock, _LEN.size + _DIGEST_LEN)
-    (length,) = _LEN.unpack(header[:_LEN.size])
-    if length > _MAX_FRAME:
-        raise RuntimeError(f"oversized probe frame ({length} bytes)")
-    payload = _recv_exact(sock, length)
-    if not hmac.compare_digest(header[_LEN.size:],
-                               hmac.new(_secret(), payload,
-                                        hashlib.sha256).digest()):
-        raise RuntimeError("HMAC digest mismatch on probe frame")
-    return pickle.loads(payload)
+    while True:
+        header = _recv_exact(sock, _HDR.size + _DIGEST_LEN)
+        kind, length = _HDR.unpack(header[:_HDR.size])
+        if length > _MAX_FRAME:
+            raise RuntimeError(f"oversized probe frame ({length} bytes)")
+        payload = _recv_exact(sock, length)
+        if not hmac.compare_digest(header[_HDR.size:],
+                                   hmac.new(_secret(),
+                                            bytes((kind,)) + payload,
+                                            hashlib.sha256).digest()):
+            raise RuntimeError("HMAC digest mismatch on probe frame")
+        if kind == _FRAME_HEARTBEAT:
+            continue
+        if kind != _FRAME_DATA:
+            raise RuntimeError(f"unexpected probe frame kind {kind}")
+        return pickle.loads(payload)
 
 
 def list_interfaces() -> List[Tuple[str, str]]:

@@ -37,7 +37,11 @@ _POLL_INTERVAL_S = 1.0
 _GRACE_S = 5.0
 
 _lock = threading.Lock()
-_installed = False
+# Keyed on the installing pid, not a bare bool: after a fork the child
+# inherits the module state but NOT the watchdog thread (threads don't
+# survive fork), so a bool would leave forked ranks unwatched while
+# install() refuses to re-arm.
+_installed_pid: "int | None" = None
 
 
 def _set_pdeathsig(signum: int) -> bool:
@@ -54,13 +58,14 @@ def _set_pdeathsig(signum: int) -> bool:
 
 def install(poll_interval: float = _POLL_INTERVAL_S,
             grace: float = _GRACE_S) -> bool:
-    """Arm the watchdog against the CURRENT parent. Idempotent; returns
-    whether a watchdog is armed. No-op (False) when already orphaned at
-    install time — with the original parent unknowable, killing would be
-    a guess."""
-    global _installed
+    """Arm the watchdog against the CURRENT parent. Idempotent per
+    process (a forked child re-arms against ITS parent); returns whether
+    a watchdog is armed. No-op (False) when already orphaned at install
+    time — with the original parent unknowable, killing would be a
+    guess."""
+    global _installed_pid
     with _lock:
-        if _installed:
+        if _installed_pid == os.getpid():
             return True
         parent = os.getppid()
         if parent <= 1:
@@ -88,7 +93,7 @@ def install(poll_interval: float = _POLL_INTERVAL_S,
 
         threading.Thread(target=_watch, name="hvd-parent-watchdog",
                          daemon=True).start()
-        _installed = True
+        _installed_pid = os.getpid()
         return True
 
 

@@ -3,6 +3,8 @@ broadcast-consistency contract)."""
 
 from .checkpoint import (  # noqa: F401
     latest_checkpoint,
+    restart_epoch,
     restore_checkpoint,
+    restore_latest,
     save_checkpoint,
 )

@@ -54,6 +54,33 @@ def restore_checkpoint(path: str, like: Optional[Any] = None,
     return restored
 
 
+def restart_epoch() -> int:
+    """Supervision attempt number (``horovodrun --max-restarts`` bumps
+    ``HOROVOD_RESTART_EPOCH`` on every relaunch; 0 on the first launch and
+    outside the launcher). Training scripts branch on this to resume from
+    the latest checkpoint instead of reinitializing."""
+    try:
+        return max(0, int(os.environ.get("HOROVOD_RESTART_EPOCH", "0")))
+    except ValueError:
+        return 0
+
+
+def restore_latest(directory: str, like: Optional[Any] = None,
+                   prefix: str = "ckpt_", root_rank: int = 0,
+                   broadcast: bool = True):
+    """Elastic-lite resume: ``(path, tree)`` of the newest checkpoint under
+    ``directory``, or ``(None, None)`` when there is nothing to resume —
+    the restart-from-checkpoint half of ``horovodrun --max-restarts``."""
+    path = latest_checkpoint(directory, prefix)
+    if path is None:
+        return None, None
+    tree = restore_checkpoint(path, like=like, root_rank=root_rank,
+                              broadcast=broadcast)
+    logging.info("resumed from checkpoint %s (restart epoch %d)",
+                 path, restart_epoch())
+    return path, tree
+
+
 def latest_checkpoint(directory: str, prefix: str = "ckpt_") -> Optional[str]:
     """Newest ``<directory>/<prefix><step>`` path, or None."""
     if not os.path.isdir(directory):

@@ -399,6 +399,23 @@ def scenario_peer_death(rank, size):
         raise AssertionError("allreduce with a dead peer did not raise")
 
 
+def scenario_fault_survivor(rank, size):
+    # Chaos harness (tests/test_fault_tolerance.py): generate steady
+    # eager traffic until the injected fault (kill-rank-at-cycle-N /
+    # dropped frames, HOROVOD_FAULT_PLAN) fails the job. Survivors must
+    # get a DESCRIPTIVE engine error — which rank died, what was in
+    # flight — within the comm timeout; the killed rank never gets here.
+    try:
+        for i in range(100000):
+            out = np.asarray(hvd.allreduce(np.ones(64, np.float32) * i,
+                                           average=False, name=f"ft.{i}"))
+            np.testing.assert_allclose(out, float(size) * i)
+    except RuntimeError as exc:
+        print(f"fault error surfaced: {exc}", flush=True)
+    else:
+        raise AssertionError("injected fault did not surface")
+
+
 def scenario_stall(rank, size):
     # Reference test/test_stall.py: one rank joins late; the coordinator must
     # warn (HOROVOD_STALL_CHECK_TIME_SECONDS=1 set by the parent) and the op
@@ -1080,6 +1097,7 @@ SCENARIOS = {
     "stall": scenario_stall,
     "stall_shutdown": scenario_stall_shutdown,
     "peer_death": scenario_peer_death,
+    "fault_survivor": scenario_fault_survivor,
     "allreduce": scenario_allreduce,
     "fusion": scenario_fusion,
     "allgather": scenario_allgather,

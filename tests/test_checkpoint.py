@@ -6,7 +6,9 @@ import numpy as np
 import horovod_tpu as hvd
 from horovod_tpu.utils import (
     latest_checkpoint,
+    restart_epoch,
     restore_checkpoint,
+    restore_latest,
     save_checkpoint,
 )
 
@@ -31,3 +33,28 @@ def test_latest_checkpoint(tmp_path):
         save_checkpoint(str(tmp_path / f"ckpt_{step}"), {"x": jnp.ones(1)})
     latest = latest_checkpoint(str(tmp_path))
     assert latest is not None and latest.endswith("ckpt_200")
+
+
+def test_restore_latest_and_restart_epoch(tmp_path, monkeypatch):
+    """Elastic-lite resume surface for horovodrun --max-restarts: newest
+    checkpoint wins; a fresh directory is (None, None); the restart epoch
+    parses defensively."""
+    hvd.init()
+    assert restore_latest(str(tmp_path)) == (None, None)
+    for step in (3, 40):
+        save_checkpoint(str(tmp_path / f"ckpt_{step}"),
+                        {"step": jnp.int32(step), "w": jnp.ones(2) * step})
+    like = {"step": jnp.zeros((), jnp.int32), "w": jnp.zeros(2)}
+    path, tree = restore_latest(str(tmp_path), like=like)
+    assert path.endswith("ckpt_40")
+    assert int(tree["step"]) == 40
+    np.testing.assert_array_equal(np.asarray(tree["w"]), 40.0)
+
+    monkeypatch.delenv("HOROVOD_RESTART_EPOCH", raising=False)
+    assert restart_epoch() == 0
+    monkeypatch.setenv("HOROVOD_RESTART_EPOCH", "2")
+    assert restart_epoch() == 2
+    monkeypatch.setenv("HOROVOD_RESTART_EPOCH", "garbage")
+    assert restart_epoch() == 0
+    monkeypatch.setenv("HOROVOD_RESTART_EPOCH", "-3")
+    assert restart_epoch() == 0

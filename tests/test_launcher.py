@@ -44,6 +44,73 @@ def test_launch_failure_propagates():
     res = _run_launcher(
         ["-np", "2", sys.executable, "-c", "import sys; sys.exit(3)"])
     assert res.returncode == 3
+    # Without --max-restarts there is no supervision: one attempt only.
+    assert "restarting" not in res.stderr
+
+
+def test_max_restarts_retries_until_success(tmp_path):
+    """Supervision (elastic-lite): the job fails on restart epochs 0 and 1,
+    succeeds on epoch 2; --max-restarts 3 must relaunch with
+    HOROVOD_RESTART_EPOCH bumped each time and exit 0."""
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        "epoch = int(os.environ['HOROVOD_RESTART_EPOCH'])\n"
+        "print(f'attempt epoch={epoch}', flush=True)\n"
+        "sys.exit(0 if epoch >= 2 else 17)\n")
+    res = _run_launcher(["-np", "2", "--max-restarts", "3",
+                         "--restart-backoff", "0.05",
+                         sys.executable, str(script)])
+    assert res.returncode == 0, res.stdout + res.stderr
+    for epoch in (0, 1, 2):
+        assert f"attempt epoch={epoch}" in res.stdout
+    assert "restarting (attempt 1/3)" in res.stderr
+    assert "restarting (attempt 2/3)" in res.stderr
+    assert "HOROVOD_RESTART_EPOCH=2" in res.stderr
+
+
+def test_max_restarts_exhausted_propagates_failure(tmp_path):
+    script = tmp_path / "always_fails.py"
+    script.write_text("import sys; sys.exit(9)\n")
+    res = _run_launcher(["-np", "1", "--max-restarts", "1",
+                         "--restart-backoff", "0.05",
+                         sys.executable, str(script)])
+    assert res.returncode == 9
+    assert "restarting (attempt 1/1)" in res.stderr
+    assert "giving up after 1 restart" in res.stderr
+
+
+def test_restart_resumes_from_latest_checkpoint(tmp_path):
+    """The restart-from-checkpoint contract end to end: epoch 0 saves
+    ckpt_5 then crashes; epoch 1 resumes from it via restore_latest and
+    finishes."""
+    ckdir = tmp_path / "ckpts"
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os, sys\n"
+        "import numpy as np, jax.numpy as jnp\n"
+        "import horovod_tpu as hvd\n"
+        "from horovod_tpu.utils import (restart_epoch, restore_latest,\n"
+        "                               save_checkpoint)\n"
+        "hvd.init()\n"
+        f"ckdir = {str(ckdir)!r}\n"
+        "path, tree = restore_latest(ckdir, like={'step': jnp.zeros((), "
+        "jnp.int32), 'w': jnp.zeros(4)})\n"
+        "if tree is None:\n"
+        "    assert restart_epoch() == 0\n"
+        "    tree = {'step': jnp.int32(5), 'w': jnp.ones(4) * 2.5}\n"
+        "    save_checkpoint(os.path.join(ckdir, 'ckpt_5'), tree)\n"
+        "    sys.exit(13)  # simulated crash after the checkpoint\n"
+        "assert restart_epoch() == 1, restart_epoch()\n"
+        "assert int(tree['step']) == 5 and float(tree['w'][0]) == 2.5\n"
+        "print(f'resumed step={int(tree[\"step\"])} "
+        "epoch={restart_epoch()}', flush=True)\n"
+        "hvd.shutdown()\n")
+    res = _run_launcher(["-np", "1", "--max-restarts", "1",
+                         "--restart-backoff", "0.05",
+                         sys.executable, str(script)], timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "resumed step=5 epoch=1" in res.stdout
 
 
 def test_ssh_preflight_unreachable_host_fails_fast():

@@ -85,6 +85,33 @@ def _probe(env_value):
     return out.stdout.strip()
 
 
+def test_forked_child_rearms():
+    """_installed is keyed on os.getpid(): after a fork the child inherits
+    the flag but NOT the watchdog thread, so install() must re-arm there
+    instead of refusing."""
+    body = r"""
+import os, sys, threading
+import horovod_tpu.run.watchdog as w
+assert w.install(poll_interval=5.0)
+assert w.install()  # idempotent in the same process
+pid = os.fork()
+if pid == 0:  # child: no watchdog thread survived the fork
+    alive = [t.name for t in threading.enumerate()]
+    assert "hvd-parent-watchdog" not in alive, alive
+    assert w.install(poll_interval=5.0), "child failed to re-arm"
+    alive = [t.name for t in threading.enumerate()]
+    assert "hvd-parent-watchdog" in alive, alive
+    os._exit(0)
+_, status = os.waitpid(pid, 0)
+sys.exit(os.waitstatus_to_exitcode(status))
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run([sys.executable, "-c", body], env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
 def test_env_gate():
     assert _probe(None) == "False"      # standalone runs are never watched
     assert _probe("0") == "False"       # explicit opt-out

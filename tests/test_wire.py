@@ -9,6 +9,7 @@ cases directly — tampering, wrong key, truncation, oversized frames, and a
 randomized sweep of mismatch injections.
 """
 
+import os
 import pickle
 import socket
 import struct
@@ -22,12 +23,31 @@ from horovod_tpu.common.message import (
     ResponseType,
     construct_response,
 )
-from horovod_tpu.common.wire import DIGEST_LEN, AuthError, Wire
+from horovod_tpu.common.wire import (
+    DIGEST_LEN,
+    FRAME_DATA,
+    AuthError,
+    CommTimeoutError,
+    RemoteAbortError,
+    Wire,
+)
 
 
 def _pair(secret=b"k" * 32):
     a, b = socket.socketpair()
     return Wire(a, secret), Wire(b, secret), a, b
+
+
+def _frame(secret, payload, kind=FRAME_DATA, digest=None):
+    """Raw frame bytes in the wire layout:
+    [kind][len][HMAC(kind+payload)][payload]."""
+    import hashlib
+    import hmac as hmac_mod
+
+    if digest is None:
+        digest = hmac_mod.new(secret, bytes((kind,)) + payload,
+                              hashlib.sha256).digest()
+    return struct.pack(">BI", kind, len(payload)) + digest + payload
 
 
 def test_roundtrip_bytes_and_obj():
@@ -50,11 +70,30 @@ def test_tampered_payload_rejected():
     import hashlib
     import hmac as hmac_mod
 
-    digest = hmac_mod.new(b"k" * 32, payload, hashlib.sha256).digest()
+    digest = hmac_mod.new(b"k" * 32, bytes((FRAME_DATA,)) + payload,
+                          hashlib.sha256).digest()
     bad = bytearray(payload)
     bad[10] ^= 0xFF
-    a.sendall(struct.pack(">I", len(bad)) + digest + bytes(bad))
+    a.sendall(_frame(b"k" * 32, bytes(bad), digest=digest))
     assert w2.recv_bytes() == payload  # the honest frame passes
+    with pytest.raises(AuthError, match="HMAC"):
+        w2.recv_bytes()
+
+
+def test_tampered_kind_rejected():
+    # Flipping the kind byte of an honest DATA frame (to forge an abort)
+    # must fail the HMAC — the kind is authenticated.
+    from horovod_tpu.common.wire import FRAME_ABORT
+
+    w1, w2, a, _ = _pair()
+    payload = b"y" * 16
+    import hashlib
+    import hmac as hmac_mod
+
+    data_digest = hmac_mod.new(b"k" * 32, bytes((FRAME_DATA,)) + payload,
+                               hashlib.sha256).digest()
+    a.sendall(struct.pack(">BI", FRAME_ABORT, len(payload)) + data_digest
+              + payload)
     with pytest.raises(AuthError, match="HMAC"):
         w2.recv_bytes()
 
@@ -79,9 +118,128 @@ def test_truncated_stream_raises_not_hangs():
 
 def test_oversized_frame_rejected_before_allocation():
     _, w2, a, _ = _pair()
-    a.sendall(struct.pack(">I", (1 << 31) + 5) + b"\x00" * DIGEST_LEN)
+    a.sendall(struct.pack(">BI", FRAME_DATA, (1 << 31) + 5)
+              + b"\x00" * DIGEST_LEN)
     with pytest.raises(AuthError, match="oversized"):
         w2.recv_bytes()
+
+
+def test_heartbeats_skipped_transparently():
+    # Heartbeat frames are liveness-only: interleaved anywhere, the
+    # protocol payload stream is unchanged.
+    w1, w2, *_ = _pair()
+    w1.send_heartbeat()
+    w1.send_bytes(b"first")
+    w1.send_heartbeat()
+    w1.send_heartbeat()
+    w1.send_obj({"second": 2})
+    assert w2.recv_bytes() == b"first"
+    assert w2.recv_obj() == {"second": 2}
+
+
+def test_abort_frame_raises_on_any_recv():
+    w1, w2, *_ = _pair()
+    w1.send_abort("rank 1 died during negotiation", dead_rank=1,
+                  op="allreduce.noname.0")
+    with pytest.raises(RemoteAbortError, match="rank 1 died") as ei:
+        w2.recv_bytes()
+    assert ei.value.dead_rank == 1
+    assert ei.value.op == "allreduce.noname.0"
+
+
+def test_first_frame_grace_outlives_steady_deadline():
+    # Rendezvous grace: a worker that connected early gets `first` seconds
+    # for the FIRST frame (silent coordinator still accepting peers), then
+    # drops to the steady liveness deadline.
+    import threading
+    import time
+
+    w1, w2, *_ = _pair()
+    w2.set_deadline(0.25, first=2.0)
+    t = threading.Thread(target=lambda: (time.sleep(0.6),
+                                         w1.send_bytes(b"post-rendezvous")))
+    t.start()
+    # 0.6s > steady deadline but < grace: must succeed.
+    assert w2.recv_bytes() == b"post-rendezvous"
+    t.join()
+    # Grace is one-shot: the next silent wait fails at the steady bound.
+    t0 = time.monotonic()
+    with pytest.raises(CommTimeoutError):
+        w2.recv_bytes()
+    assert time.monotonic() - t0 < 1.5
+
+
+def test_send_blocking_is_not_a_liveness_failure():
+    # settimeout applies to send() too: a full send buffer must neither
+    # abort the job nor desync the stream — the frame completes once the
+    # peer drains.
+    import threading
+    import time
+
+    a, b = socket.socketpair()
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 8192)
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 8192)
+    w1, w2 = Wire(a, b"k" * 32), Wire(b, b"k" * 32)
+    w1.set_deadline(0.1)  # send() will hit this while the reader sleeps
+    payload = os.urandom(4 << 20)
+    got = []
+
+    def read_late():
+        time.sleep(0.5)  # several send timeouts elapse first
+        got.append(w2.recv_bytes())
+
+    t = threading.Thread(target=read_late)
+    t.start()
+    w1.send_bytes(payload)  # must not raise
+    t.join(timeout=30)
+    assert got and got[0] == payload
+
+
+def test_try_send_heartbeat_never_blocks_on_full_buffer():
+    # The heartbeat thread uses the non-blocking variant: a peer that
+    # stopped draining must be SKIPPED (False), not block the loop.
+    import time
+
+    a, b = socket.socketpair()
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4096)
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+    w1, w2 = Wire(a, b"k" * 32), Wire(b, b"k" * 32)
+    assert w1.try_send_heartbeat() is True  # empty buffer: beats flow
+    # Fill the pipe without a reader.
+    a.setblocking(False)
+    try:
+        while True:
+            a.send(b"\x00" * 4096)
+    except BlockingIOError:
+        pass
+    a.settimeout(None)
+    t0 = time.monotonic()
+    assert w1.try_send_heartbeat() is False  # full: skip instantly
+    assert time.monotonic() - t0 < 0.5
+    w1.close()
+    w2.close()
+
+
+def test_recv_deadline_fires_and_heartbeats_defer_it():
+    import threading
+    import time
+
+    w1, w2, *_ = _pair()
+    w2.set_deadline(0.3)
+    with pytest.raises(CommTimeoutError, match="HOROVOD_COMM_TIMEOUT"):
+        w2.recv_bytes()
+    # A live-but-quiet peer beats the deadline with heartbeats: 3 beats at
+    # 0.15s spacing under a 0.3s deadline, then the real frame.
+    def _beat():
+        for _ in range(3):
+            time.sleep(0.15)
+            w1.send_heartbeat()
+        w1.send_bytes(b"late but alive")
+
+    t = threading.Thread(target=_beat)
+    t.start()
+    assert w2.recv_bytes() == b"late but alive"
+    t.join()
 
 
 def test_garbage_pickle_fails_loudly():
