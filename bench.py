@@ -201,7 +201,21 @@ def child_bench(status_path):
         "windows": [round(r / n, 1) for r in window_rates],
         "best_window": round(max(window_rates) / n, 2),
         "window_spread_pct": round(spread_pct, 2),
+        "metrics": _controller_metrics(),
     }), flush=True)
+
+
+def _controller_metrics():
+    """Controller-health snapshot for the bench record (cycle p50/p99,
+    fused bytes, cache hit rate): BENCH_*.json then shows whether the
+    control plane, not just the math, was healthy during the run. Fields
+    are None on SPMD-only runs (no eager controller ticking)."""
+    try:
+        from horovod_tpu import metrics as hvd_metrics
+
+        return hvd_metrics.controller_health()
+    except Exception as exc:  # telemetry must never fail the bench row
+        return {"error": str(exc)[:200]}
 
 
 # --------------------------------------------------------------------------
@@ -309,10 +323,16 @@ def child_row(name, status_path):
         row = {"metric": name, "value": float(m.group(1)),
                "unit": spec["unit"], "cmd": " ".join(
                    ["python", spec["script"]] + spec["args"])}
+    row.setdefault("metrics", _controller_metrics())
     print(json.dumps(row), flush=True)
 
 
 def child_main(mode):
+    if mode != "probe":
+        # Measurement children run with telemetry on so the row's
+        # `metrics` field (controller cycle p50/p99, fused bytes, cache
+        # hit rate) is populated; the probe stays minimal.
+        os.environ.setdefault("HOROVOD_METRICS", "1")
     timeout = PROBE_TIMEOUT_S if mode == "probe" else ATTEMPT_TIMEOUT_S
     # Kernel-default SIGALRM action (hard kill) on purpose: a Python handler
     # cannot run while the hang holds the GIL inside native backend-init code.

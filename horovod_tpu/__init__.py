@@ -63,4 +63,5 @@ from .jax import (  # noqa: F401
     broadcast_optimizer_state,
 )
 from . import parallel  # noqa: F401
+from . import metrics  # noqa: F401  (hvd.metrics.snapshot() et al.)
 from .common import profiler  # noqa: F401

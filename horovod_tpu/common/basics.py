@@ -29,6 +29,22 @@ from . import hvd_logging as logging
 from . import retry
 from .config import Config
 from .topology import Topology, detect
+from .. import metrics
+
+_m = None
+
+
+def _init_metrics():
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            cpu_fallback=metrics.counter(
+                "hvd_init_cpu_fallback_total",
+                "HOROVOD_TPU_INIT_FALLBACK_CPU degradations to the CPU "
+                "dryrun backend."))
+    return _m
 
 
 class HorovodTpuState:
@@ -46,6 +62,7 @@ class HorovodTpuState:
         self.controller = None  # control plane + eager collectives
         self.timeline = None
         self.parameter_manager = None
+        self.metrics_exporter = None  # per-rank Prometheus endpoint
 
     def close(self) -> None:
         with self.mutex:
@@ -54,11 +71,19 @@ class HorovodTpuState:
             self.shut_down = True
             self.initialized = False
             if self.controller is not None:
+                if getattr(self.controller, "_failure", None) is not None:
+                    # Unclean shutdown: the job died but nothing dumped yet
+                    # (or the dump is stale) — rewrite the postmortem with
+                    # the full ring as of teardown.
+                    metrics.dump_flight_recorder("unclean_shutdown")
                 self.controller.shutdown()
                 self.controller = None
             if self.timeline is not None:
                 self.timeline.close()
                 self.timeline = None
+            if self.metrics_exporter is not None:
+                self.metrics_exporter.close()
+                self.metrics_exporter = None
 
 
 _state: Optional[HorovodTpuState] = None
@@ -230,6 +255,10 @@ def _acquire_backend() -> bool:
         from .config import _env_bool
 
         if _env_bool("HOROVOD_TPU_INIT_FALLBACK_CPU"):
+            if metrics.on():
+                _init_metrics().cpu_fallback.inc()
+            metrics.record_event("init_fallback_cpu", attempts=attempts,
+                                 error=str(exc.last)[:200])
             logging.error(
                 "jax backend acquisition failed after %d attempts; "
                 "HOROVOD_TPU_INIT_FALLBACK_CPU=1 — DEGRADING TO THE CPU "
@@ -295,6 +324,15 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         topology = detect(ranks, probe_devices=backend_ok)
         logging.set_rank(topology.rank)
         _state = HorovodTpuState(config, topology)
+        if metrics.on():
+            metrics.record_event(
+                "init", size=topology.size,
+                restart_epoch=config_mod._env_int(
+                    "HOROVOD_RESTART_EPOCH", 0))
+            # Scrape endpoint at HOROVOD_METRICS_PORT + rank (None when the
+            # port knob is unset — snapshot() keeps working without it).
+            _state.metrics_exporter = metrics.maybe_start_exporter(
+                topology.rank)
         # Engine selection for the multi-process eager tier: the native C++
         # engine (negotiation + fusion + cache + timeline in engine.cc over
         # the TCP ring) is the default whenever the launcher exported ring

@@ -78,6 +78,21 @@ def ring_data_plane_enabled() -> bool:
         os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"
 
 
+def env_rank() -> Optional[int]:
+    """``HOROVOD_RANK`` as Optional[int]; unset/empty/garbage -> None.
+    THE parser for every consumer (metrics rank labels, flight-recorder
+    paths, fault-plan rank filters) — they must agree on what a
+    malformed launch environment means, and none of them may crash on
+    it."""
+    val = os.environ.get("HOROVOD_RANK")
+    if val is None or not val.strip():
+        return None
+    try:
+        return int(val)
+    except ValueError:
+        return None
+
+
 def _env_bool(name: str, default: bool = False) -> bool:
     val = os.environ.get(name)
     if val is None:

@@ -26,8 +26,29 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 from . import hvd_logging as logging
 from .config import _env_float, _env_int
+from .. import metrics
 
 BACKOFF_MAX_SECONDS = 30.0
+
+_m = None
+
+
+def _retry_metrics():
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            failures=metrics.counter(
+                "hvd_retry_attempt_failures_total",
+                "Failed attempts inside retry_call (init hardening)."),
+            backoff=metrics.counter(
+                "hvd_retry_backoff_seconds_total",
+                "Total seconds slept backing off between retry attempts."),
+            giveups=metrics.counter(
+                "hvd_retry_giveups_total",
+                "retry_call budgets exhausted (RetryError raised)."))
+    return _m
 
 
 class RetryError(RuntimeError):
@@ -72,6 +93,11 @@ def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
             return fn()
         except retry_on as exc:
             last = exc
+            if metrics.on():
+                _retry_metrics().failures.inc()
+                metrics.record_event("retry", what=describe, attempt=attempt,
+                                     attempts=attempts,
+                                     error=str(exc)[:200])
             if attempt == attempts:
                 break
             delay = min(backoff_max, backoff * (2.0 ** (attempt - 1)))
@@ -81,7 +107,13 @@ def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
                 "%s failed (attempt %d/%d): %s; retrying in %.1fs",
                 describe, attempt, attempts, exc, max(0.0, delay))
             if delay > 0:
+                if metrics.on():
+                    _retry_metrics().backoff.inc(delay)
                 sleep(delay)
+    if metrics.on():
+        _retry_metrics().giveups.inc()
+        metrics.record_event("retry_giveup", what=describe,
+                             attempts=attempts, error=str(last)[:200])
     raise RetryError(describe, attempts, last) from last
 
 

@@ -21,6 +21,9 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import hvd_logging as logging
+from .. import metrics
+
 # Activity vocabulary (reference common/common.h:30-51, with the CUDA/MPI
 # entries replaced by their TPU analogues).
 NEGOTIATE_ALLREDUCE = "NEGOTIATE_ALLREDUCE"
@@ -39,6 +42,23 @@ XLA_COLLECTIVE = "XLA_COLLECTIVE"
 TCP_COLLECTIVE = "TCP_COLLECTIVE"
 CYCLE_START = "CYCLE_START"
 
+_m = None
+
+
+def _tl_metrics():
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            emitted=metrics.counter(
+                "hvd_timeline_events_total",
+                "Timeline events enqueued to the writer thread."),
+            dropped=metrics.counter(
+                "hvd_timeline_events_dropped_total",
+                "Timeline events dropped on writer-queue overflow."))
+    return _m
+
 
 class Timeline:
     """Async chrome-trace writer. All public methods are thread-safe and
@@ -56,6 +76,11 @@ class Timeline:
         self._file = open(filename, "w")
         self._file.write("[\n")
         self._closed = False
+        self._dropped = 0  # overflow count; surfaced at close()
+        # Own lock, NOT self._lock: _tensor_pid emits while holding
+        # self._lock, so an overflow inside that call must not re-acquire
+        # it (non-reentrant -> self-deadlock).
+        self._drop_lock = threading.Lock()
         self._writer = threading.Thread(
             target=self._writer_loop, name="hvd-timeline-writer", daemon=True
         )
@@ -73,8 +98,16 @@ class Timeline:
             self._queue.put_nowait(event)
         except queue.Full:
             # Drop rather than block the hot path (the reference's lock-free
-            # queue has the same overflow policy by construction).
-            pass
+            # queue has the same overflow policy by construction) — but
+            # never silently: count the loss, warn once at close, and stamp
+            # the total into the trace metadata.
+            with self._drop_lock:
+                self._dropped += 1
+            if metrics.on():
+                _tl_metrics().dropped.inc()
+        else:
+            if metrics.on():
+                _tl_metrics().emitted.inc()
 
     def _writer_loop(self) -> None:
         while True:
@@ -152,8 +185,17 @@ class Timeline:
         self._closed = True
         self._queue.put(Timeline._SHUTDOWN)
         self._writer.join(timeout=5.0)
+        if self._dropped:
+            # One-time, not per-drop: a saturated queue would otherwise
+            # flood the log from the hot path it exists to protect.
+            logging.warning(
+                "timeline: dropped %d event(s) on writer-queue overflow — "
+                "the trace at %s is incomplete (dropped_events in the "
+                "trace_end metadata records the count)",
+                self._dropped, self._filename)
         # Chrome tracing accepts a trailing comma-less final entry; emit a
         # terminator metadata record then close the array.
-        self._file.write(json.dumps({"name": "trace_end", "ph": "M", "pid": 0}))
+        self._file.write(json.dumps({"name": "trace_end", "ph": "M", "pid": 0,
+                                     "args": {"dropped_events": self._dropped}}))
         self._file.write("\n]\n")
         self._file.close()

@@ -51,6 +51,7 @@ from ..common.response_cache import ResponseCache
 from ..common.topology import Topology
 from ..common.wire import RemoteAbortError
 from .. import fault
+from .. import metrics
 from .service import CoordinatorService, PeerFailureError, WorkerClient
 
 _OP_NAMES = {
@@ -58,6 +59,50 @@ _OP_NAMES = {
     RequestType.ALLGATHER: "ALLGATHER",
     RequestType.BROADCAST: "BROADCAST",
 }
+
+_m = None
+
+
+def _ctl_metrics():
+    """Lazy-registered controller series (no import-time registration)."""
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            cycle=metrics.histogram(
+                "hvd_controller_cycle_seconds",
+                "Controller cycle duration (tick build + negotiation + "
+                "data phases)."),
+            tensors=metrics.counter(
+                "hvd_controller_tensors_total",
+                "Tensors executed by the eager controller."),
+            fused_bytes=metrics.counter(
+                "hvd_controller_fused_bytes_total",
+                "Payload bytes executed via (possibly fused) responses."),
+            cache_hits=metrics.counter(
+                "hvd_controller_cache_hits_total",
+                "Response-cache hits at tick build."),
+            cache_misses=metrics.counter(
+                "hvd_controller_cache_misses_total",
+                "Requests that missed the response cache and negotiated."),
+            stalls=metrics.counter(
+                "hvd_controller_stall_warnings_total",
+                "Stall warnings issued by the coordinator."),
+            aborts=metrics.counter(
+                "hvd_controller_aborts_total",
+                "Times _fail_all failed pending work on a transport "
+                "failure."),
+            ops=metrics.counter(
+                "hvd_collective_ops_total",
+                "Eager collectives enqueued, by op and dtype.",
+                ("op", "dtype")),
+            op_bytes=metrics.counter(
+                "hvd_collective_bytes_total",
+                "Eager collective payload bytes enqueued, by op and dtype.",
+                ("op", "dtype")),
+        )
+    return _m
 
 
 class _Pending:
@@ -110,6 +155,11 @@ class Controller:
         self._cycle_time_ms = config.cycle_time_ms
         self._param_manager = None
         self._pending_tune = None
+        # Telemetry piggyback: workers attach a registry snapshot to every
+        # Nth tick so rank 0's endpoint shows the whole job (the period is
+        # read once — re-reading env per cycle would be a hot-path cost).
+        self._metrics_push_cycles = metrics.push_cycles()
+        self._cycles_since_push = 0
 
         # Native ring data plane (C++ core): enabled when the launcher
         # exported per-rank ring addresses and HOROVOD_CPU_OPS != "star".
@@ -218,6 +268,13 @@ class Controller:
             request_rank=self.topo.rank, request_type=request_type,
             tensor_name=name, tensor_dtype=str(array.dtype),
             tensor_shape=tuple(array.shape), root_rank=root_rank)
+        if metrics.on():
+            m = _ctl_metrics()
+            dtype = str(array.dtype)
+            m.ops.labels(kind, dtype).inc()
+            m.op_bytes.labels(kind, dtype).inc(array.nbytes)
+            metrics.record_sampled_event("enqueue", op=kind, name=name,
+                                         nbytes=int(array.nbytes))
         handle = self.handles.allocate()
         entry = _Pending(name, array, req, handle, average, postprocess)
         with self._lock:
@@ -405,6 +462,9 @@ class Controller:
             msg = (f"Horovod controller failed: rank {exc.rank} died or "
                    f"became unreachable ({exc.cause}); in-flight ops: "
                    f"{inflight}")
+            metrics.record_event("abort", dead_rank=exc.rank,
+                                 cause=str(exc.cause)[:300],
+                                 inflight=inflight)
             if self._service is not None:
                 self._service.send_abort_all(
                     msg, dead_rank=exc.rank,
@@ -412,10 +472,14 @@ class Controller:
             return RuntimeError(msg)
         if isinstance(exc, RemoteAbortError):
             # The coordinator told us who died and what was pending there.
+            metrics.record_event("remote_abort", dead_rank=exc.dead_rank,
+                                 op=exc.op, message=str(exc)[:300])
             return RuntimeError(f"Horovod controller failed: job aborted by "
                                 f"coordinator: {exc}")
         if self._client is not None and isinstance(exc, (ConnectionError,
                                                          OSError)):
+            metrics.record_event("coordinator_lost", error=str(exc)[:300],
+                                 inflight=inflight)
             return RuntimeError(
                 f"Horovod controller failed: lost contact with the "
                 f"coordinator (rank 0): {exc}; in-flight ops: {inflight}")
@@ -427,6 +491,7 @@ class Controller:
         return exc
 
     def _build_tick(self) -> dict:
+        hits = 0
         with self._lock:
             names = self._queue
             self._queue = []
@@ -443,6 +508,7 @@ class Controller:
                        if self._cache_enabled else None)
                 if bit is not None:
                     self._bit_pending[bit] = name
+                    hits += 1
                     continue
                 if self._cache_enabled:
                     stale = self._cache.stale_bit(entry.request)
@@ -452,6 +518,12 @@ class Controller:
             for bit in self._bit_pending:
                 cache_mask |= 1 << bit
             shutdown = self._shutdown_requested
+        if metrics.on() and self._cache_enabled and (hits or uncached):
+            m = _ctl_metrics()
+            if hits:
+                m.cache_hits.inc(hits)
+            if uncached:
+                m.cache_misses.inc(len(uncached))
         return {
             "rank": self.topo.rank,
             "cache_mask": cache_mask,
@@ -461,6 +533,8 @@ class Controller:
 
     def _cycle(self) -> None:
         fault.hook("cycle")  # chaos seam: kill/delay/raise at cycle N
+        mon = metrics.on()
+        t_start = time.monotonic() if mon else 0.0
         tick = self._build_tick()
         if self.topo.rank == 0:
             t0 = time.monotonic()
@@ -478,9 +552,18 @@ class Controller:
                     self._fusion_threshold, self._cycle_time_ms = tuned[:2]
                     self._pending_tune = tuned
         else:
+            if mon:
+                self._cycles_since_push += 1
+                if self._cycles_since_push >= self._metrics_push_cycles:
+                    # Cumulative snapshot, not a true delta: idempotent, so
+                    # a push lost to a dropped frame heals on the next one.
+                    self._cycles_since_push = 0
+                    tick["metrics"] = metrics.snapshot()
             self._client.send(tick)
             reply = self._client.recv()
             self._process_reply(reply)
+        if mon:
+            _ctl_metrics().cycle.observe(time.monotonic() - t_start)
 
     # ------------------------------------------------------- coordinator side
 
@@ -489,6 +572,12 @@ class Controller:
         ticks = {0: my_tick}
         for rank in range(1, size):
             ticks[rank] = self._service.recv_from(rank)
+
+        if metrics.on():
+            for rank in range(1, size):
+                snap = ticks[rank].get("metrics")
+                if snap:
+                    metrics.ingest_remote(rank, snap)
 
         shutdown = any(t["requests"].shutdown for t in ticks.values())
         invalid_mask = 0
@@ -599,12 +688,19 @@ class Controller:
                         int(self.cfg.stall_check_seconds), name,
                         ", ".join(map(str, missing)))
                     self._stall_warned[name] = now
+                    if metrics.on():
+                        _ctl_metrics().stalls.inc()
+                        metrics.record_event(
+                            "stall", op=name, age_seconds=round(age, 3),
+                            missing_ranks=missing)
                 if (self.cfg.stall_shutdown_seconds > 0
                         and age > self.cfg.stall_shutdown_seconds):
                     logging.error(
                         "Stall duration exceeded "
                         "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS: aborting job "
                         "(stalled op: %s)", name)
+                    metrics.record_event("stall_shutdown", op=name,
+                                         age_seconds=round(age, 3))
                     with self._lock:
                         self._shutdown_requested = True
 
@@ -681,6 +777,14 @@ class Controller:
         for entry in entries:
             if not entry.handle.done():
                 entry.handle.set_error(exc)
+        if not isinstance(exc, ShutdownError) and metrics.on():
+            # Postmortem artifact: the recorder's tail now holds the abort
+            # diagnosis (dead rank, in-flight ops) this exc carries.
+            _ctl_metrics().aborts.inc()
+            metrics.record_event("fail_all", error=str(exc)[:500],
+                                 pending=len(entries),
+                                 inflight=[e.name for e in entries[:16]])
+            metrics.dump_flight_recorder("fail_all")
 
     # ------------------------------------------------------------ data plane
 
@@ -717,7 +821,12 @@ class Controller:
                                  tensor_sizes=list(response.tensor_sizes)))
         if self.timeline:
             self.timeline.end(tname)
-        return sum(e.array.nbytes for e in entries)
+        nbytes = sum(e.array.nbytes for e in entries)
+        if metrics.on():
+            m = _ctl_metrics()
+            m.tensors.inc(len(entries))
+            m.fused_bytes.inc(nbytes)
+        return nbytes
 
     def _finish(self, entry: _Pending, out: np.ndarray) -> None:
         if entry.postprocess is not None:

@@ -134,9 +134,9 @@ class FaultPlan:
         if raw.startswith("@"):
             with open(raw[1:]) as f:
                 raw = f.read()
-        rank_env = os.environ.get("HOROVOD_RANK")
-        rank = int(rank_env) if rank_env and rank_env.strip() else None
-        return cls.from_json(raw, rank=rank)
+        from ..common.config import env_rank
+
+        return cls.from_json(raw, rank=env_rank())
 
     def count(self, site: str) -> int:
         """Events seen so far at ``site`` (for tests/introspection)."""

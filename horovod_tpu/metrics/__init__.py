@@ -1,0 +1,266 @@
+"""Runtime telemetry plane: metrics registry, Prometheus endpoint, and
+crash flight recorder.
+
+Three layers (see ``docs/metrics.md`` for the catalog and recipes):
+
+1. A process-wide default :class:`~horovod_tpu.metrics.registry.MetricsRegistry`
+   (``counter()``/``gauge()``/``histogram()`` below) that instrumentation
+   across the stack registers into **lazily** — never at import time.
+2. A per-rank scrape endpoint (``HOROVOD_METRICS_PORT``, port + rank
+   offset) rendering the registry as Prometheus text; rank 0 also renders
+   every worker's snapshot (piggybacked on controller ticks every
+   ``HOROVOD_METRICS_PUSH_CYCLES`` cycles) with a ``rank`` label — one
+   scrape shows the whole job. ``snapshot()`` returns the same data as a
+   plain dict, usable with the endpoint disabled.
+3. A crash flight recorder (``HOROVOD_FLIGHT_RECORDER=<path>``): a
+   bounded ring of structured events dumped as JSONL when the job fails.
+
+**Zero-overhead-by-default contract**: with none of the env knobs set,
+every hot-path instrumentation site reduces to ``if metrics.on():`` — a
+cached module-global boolean (re-read only on fork, like
+``horovod_tpu.fault``) — and the registry stays empty. ``enable()``
+flips it programmatically (tests, ``bench.py``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from ..common.config import _env_bool, _env_int, env_rank
+from .exporter import MetricsExporter, start_exporter  # noqa: F401
+from .recorder import FlightRecorder, expand_rank_path
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_buckets,
+    quantile,
+    render_prometheus,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsExporter",
+    "FlightRecorder", "on", "enable", "counter", "gauge", "histogram",
+    "default_registry", "snapshot", "render_all", "ingest_remote",
+    "remote_snapshots", "maybe_start_exporter", "record_event",
+    "record_sampled_event", "dump_flight_recorder", "flight_recorder_path",
+    "controller_health", "push_cycles", "quantile", "render_prometheus",
+    "log_buckets", "start_exporter", "reset_for_tests", "expand_rank_path",
+]
+
+# Tri-state enabled cache. Unlike horovod_tpu.fault's per-call pid check,
+# the invalidation rides os.register_at_fork: on this platform getpid()
+# is a real (un-vDSO'd) syscall costing ~10us, which would alone blow the
+# <1% controller-cycle overhead budget. Spawned ranks get a fresh module;
+# forked ranks re-resolve on their first hook after the fork callback.
+_on: Optional[bool] = None
+_lock = threading.Lock()
+
+_registry = MetricsRegistry()
+_remote: Dict[int, Dict[str, dict]] = {}
+_recorder: Optional[FlightRecorder] = None
+
+
+def _invalidate_in_child() -> None:
+    global _on, _recorder
+    _on = None
+    _recorder = None  # child must re-read its own HOROVOD_RANK
+
+
+os.register_at_fork(after_in_child=_invalidate_in_child)
+
+
+def on() -> bool:
+    """Whether telemetry is active — THE hot-path guard. With the cache
+    resolved this is one global read and a None check."""
+    if _on is not None:
+        return _on
+    return _resolve_on()
+
+
+def _resolve_on() -> bool:
+    global _on
+    with _lock:
+        if _on is None:
+            # Repo-wide knob semantics, not raw truthiness: "0"/"false"
+            # means OFF (the _env_bool convention) and a non-positive
+            # port means no endpoint, hence no implicit enable either.
+            _on = (_env_bool("HOROVOD_METRICS")
+                   or _env_int("HOROVOD_METRICS_PORT", 0) > 0
+                   or bool((os.environ.get("HOROVOD_FLIGHT_RECORDER")
+                            or "").strip()))
+    return _on
+
+
+def enable() -> None:
+    """Turn telemetry on programmatically (no env needed)."""
+    global _on
+    with _lock:
+        _on = True
+
+
+def reset_for_tests() -> None:
+    """Forget everything: enabled cache, registry, remote snapshots,
+    recorder, and the instrumented modules' cached metric namespaces.
+    Tests share one interpreter; isolation lives here.
+
+    Instrumented modules cache a SimpleNamespace of resolved metric
+    children in a module-global ``_m`` (the package-wide convention);
+    after a registry clear those would point at orphaned objects, so the
+    scan drops every such cache — no hand-maintained module list to rot
+    when a future PR instruments another module."""
+    import sys
+    from types import SimpleNamespace
+
+    global _on, _recorder
+    with _lock:
+        _on = None
+        _recorder = None
+        _remote.clear()
+    _registry.clear()
+    for name, mod in list(sys.modules.items()):
+        if (name.startswith("horovod_tpu") and mod is not None
+                and isinstance(getattr(mod, "_m", None), SimpleNamespace)):
+            mod._m = None
+
+
+def default_registry() -> MetricsRegistry:
+    return _registry
+
+
+def counter(name: str, help: str = "", labelnames=()) -> Counter:
+    return _registry.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames=()) -> Gauge:
+    return _registry.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames=(),
+              buckets=None) -> Histogram:
+    return _registry.histogram(name, help, labelnames, buckets=buckets)
+
+
+def snapshot() -> Dict[str, dict]:
+    """This rank's registry as a plain dict (JSON/pickle-clean)."""
+    return _registry.snapshot()
+
+
+def _local_rank() -> Optional[int]:
+    return env_rank()
+
+
+def ingest_remote(rank: int, snap: Dict[str, dict]) -> None:
+    """Store a worker's piggybacked snapshot for the rank-0 cluster view.
+    Snapshots are cumulative, so a lost push is healed by the next one."""
+    with _lock:
+        _remote[int(rank)] = snap
+
+
+def remote_snapshots() -> Dict[int, Dict[str, dict]]:
+    with _lock:
+        return dict(_remote)
+
+
+def render_all() -> str:
+    """Prometheus exposition of the local registry plus every ingested
+    remote snapshot — what the scrape endpoint serves."""
+    return render_prometheus(_registry.snapshot(), _local_rank(),
+                             remote_snapshots())
+
+
+def push_cycles() -> int:
+    """Worker piggyback period, in controller cycles."""
+    return max(1, _env_int("HOROVOD_METRICS_PUSH_CYCLES", 50))
+
+
+def maybe_start_exporter(rank: int) -> Optional[MetricsExporter]:
+    """Start this rank's endpoint at HOROVOD_METRICS_PORT + rank (None
+    when unset/garbage — snapshot() keeps working without it)."""
+    base = _env_int("HOROVOD_METRICS_PORT", 0)
+    if base <= 0:
+        return None
+    return start_exporter(base + rank, render_all)
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder facade
+
+
+def _get_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record_event(kind: str, **fields) -> None:
+    """Append one structured event to the ring. No-op when telemetry is
+    off — callers may skip their own ``on()`` check for rare events."""
+    if not on():
+        return
+    _get_recorder().record(kind, **fields)
+
+
+def record_sampled_event(kind: str, **fields) -> None:
+    """Sampled variant for high-rate sites (1st + every Nth occurrence,
+    N = HOROVOD_FLIGHT_RECORDER_SAMPLE)."""
+    if not on():
+        return
+    _get_recorder().record_sampled(kind, **fields)
+
+
+def flight_recorder_path() -> Optional[str]:
+    return os.environ.get("HOROVOD_FLIGHT_RECORDER") or None
+
+
+def dump_flight_recorder(reason: str,
+                         path: Optional[str] = None) -> Optional[str]:
+    """Dump the ring as JSONL; returns the written path or None when no
+    path is configured. Called from ``Controller._fail_all``, abort
+    handling, and unclean shutdown — and safe to call repeatedly (each
+    dump rewrites the file with the full current ring)."""
+    path = path or flight_recorder_path()
+    if not path or not on():
+        return None
+    return _get_recorder().dump(path, reason)
+
+
+# ---------------------------------------------------------------------------
+# Derived views
+
+
+def _counter_total(snap: Dict[str, dict], name: str) -> Optional[float]:
+    entry = snap.get(name)
+    if not entry:
+        return None
+    return sum(v for _, v in entry.get("values", []))
+
+
+def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
+    """Compact controller-health summary (bench.py rows, dashboards):
+    cycle-time p50/p99, fused bytes, response-cache hit rate. Fields are
+    None when the series hasn't been populated (e.g. SPMD-only runs with
+    no eager controller)."""
+    snap = snap if snap is not None else snapshot()
+    hits = _counter_total(snap, "hvd_controller_cache_hits_total")
+    misses = _counter_total(snap, "hvd_controller_cache_misses_total")
+    hit_rate = None
+    if hits is not None or misses is not None:
+        total = (hits or 0.0) + (misses or 0.0)
+        hit_rate = round((hits or 0.0) / total, 4) if total else None
+    cycle = snap.get("hvd_controller_cycle_seconds")
+    p50 = quantile(cycle, 0.5)
+    p99 = quantile(cycle, 0.99)
+    return {
+        "cycle_seconds_p50": round(p50, 6) if p50 is not None else None,
+        "cycle_seconds_p99": round(p99, 6) if p99 is not None else None,
+        "fused_bytes_total": _counter_total(
+            snap, "hvd_controller_fused_bytes_total"),
+        "cache_hit_rate": hit_rate,
+    }

@@ -1,0 +1,82 @@
+"""Per-rank Prometheus scrape endpoint on a stdlib http.server.
+
+``HOROVOD_METRICS_PORT=<base>`` gives every rank its own endpoint at
+``base + rank`` (same-host ranks must not fight over one port; the
+launcher prints the resolved URLs at startup). The server runs on a
+daemon thread and serves:
+
+* ``GET /metrics`` — Prometheus text exposition of this rank's registry;
+  on rank 0 it also includes every worker's piggybacked snapshot with a
+  per-rank ``rank`` label (the cluster view).
+
+No dependency beyond the stdlib — the scrape path must work in the same
+hermetic environment the tests run in.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from ..common import hvd_logging as logging
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsExporter:
+    """Serve ``render()``'s output at /metrics until ``close()``."""
+
+    def __init__(self, port: int, render: Callable[[], str],
+                 host: str = ""):
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?", 1)[0] != "/metrics":
+                    self.send_error(404, "try /metrics")
+                    return
+                try:
+                    body = exporter._render().encode("utf-8")
+                except Exception as exc:  # render must never kill the server
+                    self.send_error(500, f"render failed: {exc}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", CONTENT_TYPE)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # scrapes are not log news
+                pass
+
+        self._render = render
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="hvd-metrics-exporter",
+            daemon=True)
+        self._thread.start()
+        logging.debug("metrics exporter listening on :%d/metrics", self.port)
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def start_exporter(port: int, render: Callable[[], str],
+                   host: str = "") -> Optional[MetricsExporter]:
+    """Best-effort start: a busy port logs an error instead of failing
+    init — telemetry must never take down the job it observes."""
+    try:
+        return MetricsExporter(port, render, host=host)
+    except OSError as exc:
+        logging.error(
+            "metrics exporter: cannot bind port %d (%s); endpoint disabled "
+            "for this rank — adjust HOROVOD_METRICS_PORT", port, exc)
+        return None

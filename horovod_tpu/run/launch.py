@@ -34,6 +34,21 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..common.wire import make_secret
+from .. import metrics
+
+_m = None
+
+
+def _launcher_metrics():
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(restarts=metrics.counter(
+            "hvd_launcher_restarts_total",
+            "Supervised relaunches performed by horovodrun "
+            "--max-restarts."))
+    return _m
 
 
 def parse_hosts(hosts: Optional[str], np_: int) -> List[Tuple[str, int]]:
@@ -289,19 +304,33 @@ def run(args: argparse.Namespace) -> int:
     backoff = max(0.0, getattr(args, "restart_backoff", 1.0))
     epoch = 0
     interrupted = threading.Event()
+
+    def _exit(code: int) -> int:
+        # The supervisor's registry/ring live in THIS process — no rank
+        # ever exports them. A supervised run that restarted dumps its own
+        # flight recorder so the restart history survives the terminal.
+        # The launcher has no HOROVOD_RANK, so the dump lands on the bare
+        # path (or a "{rank}" placeholder expands to "launcher") — never
+        # clobbering a rank's postmortem.
+        if epoch > 0:
+            metrics.record_event("launcher_exit", exit_code=code,
+                                 restarts=epoch)
+            metrics.dump_flight_recorder("launcher_exit")
+        return code
+
     while True:
         code = _run_attempt(args, restart_epoch=epoch,
                             interrupted=interrupted)
         if interrupted.is_set():
             # Operator-initiated teardown (SIGINT/SIGTERM) is not a fault;
             # never auto-restart over the operator's intent.
-            return code
+            return _exit(code)
         if code == 0 or epoch >= max_restarts:
             if code != 0 and max_restarts > 0:
                 sys.stderr.write(
                     f"horovodrun: giving up after {epoch} restart(s); "
                     f"final exit code {code}\n")
-            return code
+            return _exit(code)
         epoch += 1
         delay = min(30.0, backoff * (2.0 ** (epoch - 1)))
         sys.stderr.write(
@@ -312,7 +341,14 @@ def run(args: argparse.Namespace) -> int:
         # still-installed handler sets `interrupted`) must cancel the
         # relaunch, not schedule one more multi-hour attempt.
         if interrupted.wait(delay):
-            return code
+            epoch -= 1  # cancelled during backoff: this restart never ran
+            return _exit(code)
+        # Counted only once the backoff survives: a restart that was
+        # cancelled mid-backoff must not appear in the restart history.
+        if metrics.on():
+            _launcher_metrics().restarts.inc()
+            metrics.record_event("launcher_restart", epoch=epoch,
+                                 exit_code=code)
 
 
 def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
@@ -364,6 +400,32 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
             rank += 1
         if rank >= size:
             break
+
+    # Telemetry endpoints: each rank serves /metrics at base + rank
+    # (common/basics.py). Print the resolved URLs so operators never
+    # compute the port offset by hand; rank 0's endpoint additionally
+    # aggregates every worker's piggybacked snapshot (rank-labeled).
+    metrics_base = os.environ.get("HOROVOD_METRICS_PORT")
+    if metrics_base:
+        try:
+            base_port = int(metrics_base)
+        except ValueError:
+            base_port = 0
+        if base_port > 0:
+            for r, host, _, _, _ in assignments:
+                sys.stderr.write(
+                    f"horovodrun: rank {r} metrics at "
+                    f"http://{_public_host(host)}:{base_port + r}/metrics\n")
+            if args.verbose:
+                sys.stderr.write(
+                    "horovodrun: cluster view (every rank's series, "
+                    "rank-labeled) at http://"
+                    f"{_public_host(assignments[0][1])}:{base_port}"
+                    "/metrics\n")
+        else:
+            sys.stderr.write(
+                "horovodrun: ignoring unparseable HOROVOD_METRICS_PORT="
+                f"{metrics_base!r}; metrics endpoints disabled\n")
 
     # Per-rank addresses for the native C++ ring data plane (eager tier only;
     # SPMD workers have no ring). Local-only jobs bind loopback with

@@ -416,6 +416,68 @@ def scenario_fault_survivor(rank, size):
         raise AssertionError("injected fault did not surface")
 
 
+def scenario_fault_metrics(rank, size):
+    # Telemetry acceptance (tests/test_metrics.py): steady eager traffic
+    # until the injected fault (dropped frames, HOROVOD_FAULT_PLAN) kills
+    # the job. Survivors print their registry snapshot — the parent
+    # asserts the deadline-trip counter incremented and the flight
+    # recorder (HOROVOD_FLIGHT_RECORDER) dumped a parseable JSONL whose
+    # tail names the dead rank.
+    import json as _json
+    try:
+        for i in range(100000):
+            out = np.asarray(hvd.allreduce(np.ones(32, np.float32) * i,
+                                           average=False, name=f"fm.{i}"))
+            np.testing.assert_allclose(out, float(size) * i)
+    except RuntimeError as exc:
+        print(f"fault error surfaced: {exc}", flush=True)
+        print("METRICS_SNAPSHOT " + _json.dumps(hvd.metrics.snapshot()),
+              flush=True)
+    else:
+        raise AssertionError("injected fault did not surface")
+
+
+def scenario_metrics_cluster(rank, size):
+    # Rank-0 cluster view: workers piggyback registry snapshots on ticks
+    # (HOROVOD_METRICS_PUSH_CYCLES); rank 0's exporter must serve every
+    # rank's series rank-labeled. The parent sets HOROVOD_METRICS_PORT, so
+    # this also exercises the real HTTP endpoint (acceptance criterion).
+    import time as _time
+    import urllib.request
+
+    for i in range(30):
+        out = np.asarray(hvd.allreduce(np.ones(8, np.float32),
+                                       average=False, name=f"mc.{i}"))
+        np.testing.assert_allclose(out, float(size))
+    if rank == 0:
+        port = int(os.environ["HOROVOD_METRICS_PORT"])
+        deadline = _time.monotonic() + 30
+        body = ""
+        while _time.monotonic() < deadline:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            if all(f'rank="{r}"' in body for r in range(size)):
+                break
+            _time.sleep(0.2)  # workers keep ticking; pushes still landing
+        else:
+            raise AssertionError(
+                "cluster view never showed every rank:\n" + body[-2000:])
+        expect("hvd_wire_frames_sent_total" in body, "wire series missing")
+        expect("hvd_controller_cycle_seconds_bucket" in body,
+               "cycle histogram missing")
+        expect("hvd_collective_ops_total" in body,
+               "collective op series missing")
+        expect("# TYPE hvd_controller_cycle_seconds histogram" in body,
+               "TYPE line missing")
+        print("CLUSTER_VIEW_OK", flush=True)
+    # Final barrier keeps every worker's controller ticking until rank 0
+    # has verified the view.
+    out = np.asarray(hvd.allreduce(np.ones(2, np.float32), average=False,
+                                   name="mc.done"))
+    np.testing.assert_allclose(out, float(size))
+
+
 def scenario_stall(rank, size):
     # Reference test/test_stall.py: one rank joins late; the coordinator must
     # warn (HOROVOD_STALL_CHECK_TIME_SECONDS=1 set by the parent) and the op
@@ -1098,6 +1160,8 @@ SCENARIOS = {
     "stall_shutdown": scenario_stall_shutdown,
     "peer_death": scenario_peer_death,
     "fault_survivor": scenario_fault_survivor,
+    "fault_metrics": scenario_fault_metrics,
+    "metrics_cluster": scenario_metrics_cluster,
     "allreduce": scenario_allreduce,
     "fusion": scenario_fusion,
     "allgather": scenario_allgather,

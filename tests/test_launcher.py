@@ -40,6 +40,25 @@ def test_launch_np2():
     assert "[1]: rank 1 of 2 ok" in res.stdout
 
 
+def test_metrics_urls_logged_at_startup(monkeypatch):
+    """With HOROVOD_METRICS_PORT set, horovodrun prints each rank's
+    resolved endpoint (port + rank offset) so operators never compute it
+    by hand; --verbose adds the rank-0 cluster-view URL."""
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", "39500")
+    res = _run_launcher(["-np", "2", "--verbose", sys.executable, "-c",
+                         "print('ok')"], timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "rank 0 metrics at http://127.0.0.1:39500/metrics" in res.stderr
+    assert "rank 1 metrics at http://127.0.0.1:39501/metrics" in res.stderr
+    assert "cluster view" in res.stderr
+    assert ":39500/metrics" in res.stderr.split("cluster view", 1)[1]
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", "nonsense")
+    res = _run_launcher(["-np", "1", sys.executable, "-c", "print('ok')"],
+                        timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ignoring unparseable HOROVOD_METRICS_PORT" in res.stderr
+
+
 def test_launch_failure_propagates():
     res = _run_launcher(
         ["-np", "2", sys.executable, "-c", "import sys; sys.exit(3)"])

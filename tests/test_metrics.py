@@ -1,0 +1,559 @@
+"""Runtime telemetry plane: registry semantics, Prometheus exposition
+(golden file), exporter endpoint, flight recorder, zero-overhead-off
+contract, and the FaultPlan-driven chaos acceptance (deadline-trip
+counter + postmortem JSONL naming the dead rank).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import metrics
+from horovod_tpu.metrics import MetricsRegistry, render_prometheus
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "mp_worker.py")
+GOLDEN = os.path.join(HERE, "golden", "metrics_exposition.golden")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics(monkeypatch):
+    """Tests share one interpreter: isolate the process-global registry,
+    the enabled-flag cache, and the telemetry env knobs."""
+    for var in ("HOROVOD_METRICS", "HOROVOD_METRICS_PORT",
+                "HOROVOD_FLIGHT_RECORDER", "HOROVOD_RANK"):
+        monkeypatch.delenv(var, raising=False)
+    metrics.reset_for_tests()
+    yield
+    metrics.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+
+
+def test_counter_gauge_histogram_basics():
+    r = MetricsRegistry()
+    c = r.counter("hvd_c_total", "c")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    g = r.gauge("hvd_g", "g")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5
+    h = r.histogram("hvd_h_seconds", "h", buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = r.snapshot()["hvd_h_seconds"]
+    assert snap["buckets"] == [1.0, 10.0]
+    [[_, val]] = snap["values"]
+    assert val["counts"] == [1, 1, 1] and val["count"] == 3
+    assert val["sum"] == pytest.approx(55.5)
+
+
+def test_labels_positional_and_kw_resolve_same_child():
+    r = MetricsRegistry()
+    c = r.counter("hvd_l_total", "", ("op", "dtype"))
+    c.labels("allreduce", "float32").inc(2)
+    c.labels(op="allreduce", dtype="float32").inc()
+    assert c.labels("allreduce", "float32").value == 3
+    with pytest.raises(ValueError, match="expected 2"):
+        c.labels("allreduce")
+    with pytest.raises(ValueError, match="has labels"):
+        c.inc()
+
+
+def test_registry_get_or_create_and_conflicts():
+    r = MetricsRegistry()
+    a = r.counter("hvd_x_total", "x")
+    assert r.counter("hvd_x_total") is a  # idempotent re-registration
+    with pytest.raises(ValueError, match="conflicting"):
+        r.gauge("hvd_x_total")
+    with pytest.raises(ValueError, match="conflicting"):
+        r.counter("hvd_x_total", labelnames=("k",))
+    # Histograms: same buckets (any order) is idempotent; different
+    # buckets would silently mis-bin the second site's observations.
+    h = r.histogram("hvd_x_seconds", buckets=(0.01, 0.001))
+    assert r.histogram("hvd_x_seconds", buckets=(0.001, 0.01)) is h
+    assert r.histogram("hvd_x_seconds") is h  # default buckets = reuse
+    with pytest.raises(ValueError, match="buckets"):
+        r.histogram("hvd_x_seconds", buckets=(60.0, 600.0))
+
+
+def test_thread_safety_exact_final_counts():
+    """N writer threads, exact final counts — the lock-per-mutation
+    contract (a bare += loses increments under preemption)."""
+    r = MetricsRegistry()
+    c = r.counter("hvd_t_total", "", ("worker",))
+    h = r.histogram("hvd_t_seconds", "", buckets=(0.5,))
+    shared = c.labels("shared")
+    n_threads, n_incs = 8, 5000
+
+    def work(i):
+        mine = c.labels(str(i))
+        for _ in range(n_incs):
+            shared.inc()
+            mine.inc(2)
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert shared.value == n_threads * n_incs
+    for i in range(n_threads):
+        assert c.labels(str(i)).value == 2 * n_incs
+    snap = r.snapshot()["hvd_t_seconds"]
+    [[_, val]] = snap["values"]
+    assert val["count"] == n_threads * n_incs
+    assert val["counts"][0] == n_threads * n_incs
+
+
+def test_snapshot_is_plain_json_clean_dict():
+    r = MetricsRegistry()
+    r.counter("hvd_j_total", "", ("k",)).labels("v").inc()
+    r.histogram("hvd_j_seconds", buckets=(1.0,)).observe(0.2)
+    snap = r.snapshot()
+    assert snap == json.loads(json.dumps(snap))  # survives JSON round trip
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+
+
+def _golden_fill():
+    r = MetricsRegistry()
+    frames = r.counter("hvd_wire_frames_sent_total",
+                       "Control-plane frames sent, by frame kind.",
+                       ("kind",))
+    frames.labels("data").inc(42)
+    frames.labels("heartbeat").inc(7)
+    r.gauge("hvd_example_inflight", "In-flight operations.").set(3)
+    h = r.histogram("hvd_controller_cycle_seconds",
+                    "Controller cycle duration.",
+                    buckets=(0.001, 0.01, 0.1, 1.0))
+    for v in (0.0005, 0.004, 0.004, 0.03, 2.5):
+        h.observe(v)
+    esc = r.counter("hvd_escape_test_total",
+                    'Help with \\ backslash and\nnewline.', ("name",))
+    esc.labels('weird"value\n').inc()
+    return r
+
+
+def test_prometheus_exposition_matches_golden_file():
+    """Byte-exact golden: HELP/TYPE lines, cumulative histogram buckets
+    with +Inf, label escaping, rank labels, and the remote (cluster-view)
+    rendering order are all pinned."""
+    local = _golden_fill().snapshot()
+    remote = {1: {"hvd_wire_frames_sent_total":
+                  local["hvd_wire_frames_sent_total"]}}
+    rendered = render_prometheus(local, 0, remote)
+    with open(GOLDEN) as f:
+        assert rendered == f.read()
+
+
+def test_quantile_estimation():
+    r = MetricsRegistry()
+    h = r.histogram("hvd_q_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0):
+        h.observe(v)
+    entry = r.snapshot()["hvd_q_seconds"]
+    p50 = metrics.quantile(entry, 0.5)
+    assert 0.1 <= p50 <= 1.0  # inside the bucket holding the median
+    assert metrics.quantile(entry, 0.99) > 1.0
+    assert metrics.quantile(None, 0.5) is None
+    r.histogram("hvd_q2_seconds", buckets=(1.0,))  # registered, no samples
+    assert metrics.quantile(r.snapshot()["hvd_q2_seconds"], 0.5) is None
+
+
+def test_controller_health_summary():
+    metrics.enable()
+    metrics.counter("hvd_controller_cache_hits_total").inc(30)
+    metrics.counter("hvd_controller_cache_misses_total").inc(10)
+    metrics.counter("hvd_controller_fused_bytes_total").inc(4096)
+    h = metrics.histogram("hvd_controller_cycle_seconds",
+                          buckets=(0.001, 0.01, 0.1))
+    for _ in range(10):
+        h.observe(0.004)
+    health = metrics.controller_health()
+    assert health["cache_hit_rate"] == pytest.approx(0.75)
+    assert health["fused_bytes_total"] == 4096
+    assert 0.001 <= health["cycle_seconds_p50"] <= 0.01
+    assert health["cycle_seconds_p99"] <= 0.1
+
+
+# ---------------------------------------------------------------------------
+# Enabled-flag contract (zero overhead off / env + programmatic on)
+
+
+def test_disabled_by_default_and_wire_registers_nothing():
+    assert metrics.on() is False
+    from horovod_tpu.common.wire import Wire
+
+    a, b = socket.socketpair()
+    try:
+        wa, wb = Wire(a), Wire(b)
+        wa.send_obj({"ping": 1})
+        assert wb.recv_obj() == {"ping": 1}
+    finally:
+        a.close()
+        b.close()
+    # The hot path must not have touched the registry.
+    assert metrics.default_registry().names() == []
+
+
+def test_env_knobs_enable(monkeypatch):
+    monkeypatch.setenv("HOROVOD_METRICS", "1")
+    metrics.reset_for_tests()
+    assert metrics.on() is True
+    metrics.reset_for_tests()
+    monkeypatch.delenv("HOROVOD_METRICS")
+    monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", "/tmp/x.jsonl")
+    assert metrics.on() is True
+
+
+def test_env_knobs_explicit_off_values_stay_off(monkeypatch):
+    """_env_bool semantics, not raw truthiness: 0/false disables, and a
+    non-positive port must not implicitly enable the registry."""
+    for var, off in (("HOROVOD_METRICS", "0"),
+                     ("HOROVOD_METRICS", "false"),
+                     ("HOROVOD_METRICS_PORT", "0"),
+                     ("HOROVOD_FLIGHT_RECORDER", "  ")):
+        monkeypatch.setenv(var, off)
+        metrics.reset_for_tests()
+        assert metrics.on() is False, (var, off)
+        monkeypatch.delenv(var)
+
+
+def test_wire_metrics_when_enabled():
+    metrics.enable()
+    from horovod_tpu.common.wire import CommTimeoutError, Wire
+
+    a, b = socket.socketpair()
+    try:
+        wa, wb = Wire(a), Wire(b)
+        wa.send_obj({"ping": 1})
+        wa.send_heartbeat()
+        assert wb.recv_obj() == {"ping": 1}
+        wb.set_deadline(0.2)
+        with pytest.raises(CommTimeoutError):
+            wb.recv_bytes()
+    finally:
+        a.close()
+        b.close()
+    snap = metrics.snapshot()
+
+    def series(name):
+        return dict((tuple(k), v)
+                    for k, v in snap[name]["values"])
+
+    assert series("hvd_wire_frames_sent_total")[("data",)] == 1
+    assert series("hvd_wire_frames_sent_total")[("heartbeat",)] == 1
+    assert series("hvd_wire_frames_recv_total")[("data",)] == 1
+    assert series("hvd_wire_frames_recv_total")[("heartbeat",)] == 1
+    assert series("hvd_wire_deadline_trips_total")[("recv",)] == 1
+    [[_, wait]] = [v for v in
+                   snap["hvd_wire_recv_wait_seconds"]["values"]]
+    assert wait["count"] == 1  # one completed data recv was timed
+
+
+# ---------------------------------------------------------------------------
+# Exporter
+
+
+def test_exporter_serves_metrics_and_404():
+    metrics.enable()
+    metrics.counter("hvd_exp_total", "exported").inc(9)
+    exp = metrics.MetricsExporter(0, metrics.render_all)
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics", timeout=5
+        ).read().decode()
+        assert "hvd_exp_total 9" in body
+        assert "# TYPE hvd_exp_total counter" in body
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{exp.port}/other", timeout=5)
+        assert err.value.code == 404
+    finally:
+        exp.close()
+
+
+def test_maybe_start_exporter_port_offset_and_unset(monkeypatch):
+    assert metrics.maybe_start_exporter(0) is None  # knob unset
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    base = free_port()
+    monkeypatch.setenv("HOROVOD_METRICS_PORT", str(base))
+    metrics.reset_for_tests()
+    metrics.counter("hvd_off_total").inc()
+    exp = metrics.maybe_start_exporter(0)
+    try:
+        assert exp is not None and exp.port == base
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{base}/metrics", timeout=5).read().decode()
+        assert "hvd_off_total 1" in body
+    finally:
+        if exp:
+            exp.close()
+
+
+def test_cluster_view_renders_remote_snapshots(monkeypatch):
+    monkeypatch.setenv("HOROVOD_RANK", "0")
+    metrics.enable()
+    metrics.counter("hvd_cv_total").inc(5)
+    worker = MetricsRegistry()
+    worker.counter("hvd_cv_total", "").inc(11)
+    metrics.ingest_remote(1, worker.snapshot())
+    text = metrics.render_all()
+    assert 'hvd_cv_total{rank="0"} 5' in text
+    assert 'hvd_cv_total{rank="1"} 11' in text
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+
+
+def test_flight_recorder_ring_bound_and_dump(tmp_path):
+    rec = metrics.FlightRecorder(capacity=16, sample=4, rank="3")
+    for i in range(40):
+        rec.record("tick", i=i)
+    events = rec.events()
+    assert len(events) == 16
+    assert events[-1]["i"] == 39 and events[0]["i"] == 24  # oldest dropped
+    out = rec.dump(str(tmp_path / "fr.jsonl"), "unit-test")
+    assert out.endswith(".rank3")
+    lines = [json.loads(ln) for ln in open(out)]
+    assert lines[0]["kind"] == "flight_recorder_dump"
+    assert lines[0]["reason"] == "unit-test" and lines[0]["events"] == 16
+    assert lines[-1]["kind"] == "tick" and lines[-1]["i"] == 39
+    assert all(ln["rank"] == 3 for ln in lines)
+
+
+def test_flight_recorder_sampling():
+    rec = metrics.FlightRecorder(capacity=64, sample=10, rank=None)
+    for _ in range(25):
+        rec.record_sampled("enqueue", op="allreduce")
+    occurrences = [e["occurrence"] for e in rec.events()]
+    assert occurrences == [1, 10, 20]  # 1st, then every 10th
+
+
+def test_expand_rank_path():
+    assert metrics.expand_rank_path("/x/fr-{rank}.jsonl", "2") \
+        == "/x/fr-2.jsonl"
+    assert metrics.expand_rank_path("/x/fr.jsonl", "2") == "/x/fr.jsonl.rank2"
+    assert metrics.expand_rank_path("/x/fr.jsonl", None) == "/x/fr.jsonl"
+    # A rank-less process (the horovodrun supervisor) must not expand the
+    # placeholder to "0" — that would clobber rank 0's crash postmortem.
+    assert metrics.expand_rank_path("/x/fr-{rank}.jsonl", None) \
+        == "/x/fr-launcher.jsonl"
+
+
+def test_record_event_and_dump_facade(tmp_path, monkeypatch):
+    path = tmp_path / "fr.jsonl"
+    monkeypatch.setenv("HOROVOD_FLIGHT_RECORDER", str(path))
+    metrics.reset_for_tests()  # re-read env: recorder now configured + on
+    metrics.record_event("abort", dead_rank=1, op="grad.w")
+    out = metrics.dump_flight_recorder("test")
+    lines = [json.loads(ln) for ln in open(out)]
+    assert lines[-1]["kind"] == "abort" and lines[-1]["dead_rank"] == 1
+    # With telemetry off, both are silent no-ops.
+    monkeypatch.delenv("HOROVOD_FLIGHT_RECORDER")
+    metrics.reset_for_tests()
+    metrics.record_event("abort", dead_rank=2)
+    assert metrics.dump_flight_recorder("test") is None
+
+
+# ---------------------------------------------------------------------------
+# Timeline drop accounting (satellite: silent data loss fix)
+
+
+def test_timeline_drops_counted_warned_and_stamped(tmp_path):
+    import logging as pylogging
+    import queue as queue_mod
+
+    from horovod_tpu.common import hvd_logging
+    from horovod_tpu.common.timeline import Timeline
+
+    metrics.enable()
+    t = Timeline(str(tmp_path / "tl.json"))
+    # Stop the real writer first, then swap in a 1-slot queue: overflow is
+    # deterministic because nothing drains it while we emit.
+    t._queue.put(Timeline._SHUTDOWN)
+    t._writer.join(timeout=5.0)
+    t._queue = queue_mod.Queue(maxsize=1)
+    for _ in range(6):
+        t._emit({"name": "ev", "ph": "B", "pid": 1, "ts": 0})
+    assert t._dropped == 5  # slot 1 admitted, 5 overflowed
+    t._queue.get_nowait()  # room for close()'s shutdown sentinel
+
+    msgs = []
+    cap = pylogging.Handler()
+    cap.emit = lambda record: msgs.append(record.getMessage())
+    hvd_logging.configure("warning")
+    hvd_logging._logger.addHandler(cap)
+    try:
+        t.close()
+    finally:
+        hvd_logging._logger.removeHandler(cap)
+    assert any("dropped 5 event(s)" in m for m in msgs), msgs
+    trace = json.loads((tmp_path / "tl.json").read_text())
+    assert trace[-1]["name"] == "trace_end"
+    assert trace[-1]["args"]["dropped_events"] == 5
+    snap = metrics.snapshot()
+    [[_, dropped]] = snap["hvd_timeline_events_dropped_total"]["values"]
+    assert dropped == 5
+
+
+# ---------------------------------------------------------------------------
+# Multi-process chaos acceptance: FaultPlan drop rules -> deadline-trip
+# counter increments + flight-recorder JSONL names the dead rank.
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_ranks(scenario, size=2, timeout=90.0, extra_env=None,
+               per_rank_env=None):
+    addr = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_CONTROLLER_ADDR": addr,
+            "HOROVOD_ENGINE": "python",
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(rank, {}))
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, scenario], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    deadline = time.monotonic() + timeout
+    outputs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise AssertionError(
+                f"{scenario}: rank {rank} hung past the timeout")
+        outputs.append(out)
+    for rank, proc in enumerate(procs):
+        assert proc.returncode == 0, (
+            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
+            f"{outputs[rank]}")
+    return outputs
+
+
+def _parse_snapshot(output):
+    for line in output.splitlines():
+        if line.startswith("METRICS_SNAPSHOT "):
+            return json.loads(line[len("METRICS_SNAPSHOT "):])
+    raise AssertionError(f"no METRICS_SNAPSHOT line in:\n{output}")
+
+
+def test_chaos_deadline_counter_and_flight_recorder_jsonl(tmp_path):
+    """Acceptance: a FaultPlan silent rank (dropped frames, heartbeats
+    off) must (a) increment the deadline-trip counter on the coordinator,
+    and (b) leave a parseable flight-recorder JSONL on every survivor
+    whose tail names the dead rank — matching the ABORT diagnosis."""
+    fr_path = tmp_path / "fr.jsonl"
+    outs = _run_ranks(
+        "fault_metrics", size=3,
+        extra_env={
+            "HOROVOD_FAULT_PLAN": json.dumps({"seed": 5, "faults": [
+                # rank 1 goes silent (drops every frame) without dying
+                {"site": "wire_send", "action": "drop", "at": 60,
+                 "times": 1000000, "rank": 1}]}),
+            "HOROVOD_COMM_TIMEOUT_SECONDS": "2",
+            "HOROVOD_HEARTBEAT_INTERVAL_SECONDS": "0",
+            "HOROVOD_STALL_CHECK_TIME_SECONDS": "30",
+            "HOROVOD_FLIGHT_RECORDER": str(fr_path),
+        },
+        # Workers get a longer deadline so their own timeouts can't race
+        # the coordinator's 2s diagnosis: rank 2 must still be listening
+        # when the ABORT broadcast arrives, and rank 1 (silent) fails
+        # promptly via EOF once the coordinator tears the star down.
+        per_rank_env={1: {"HOROVOD_COMM_TIMEOUT_SECONDS": "8"},
+                      2: {"HOROVOD_COMM_TIMEOUT_SECONDS": "8"}},
+        timeout=120.0)
+    # (a) rank 0's registry saw the deadline trip that started the abort.
+    snap0 = _parse_snapshot(outs[0])
+    trips = dict((tuple(k), v) for k, v in
+                 snap0["hvd_wire_deadline_trips_total"]["values"])
+    assert trips[("recv",)] >= 1, snap0
+    assert "rank 1 died or became unreachable" in outs[0], outs[0]
+    # The abort made it into the abort counter too.
+    [[_, aborts]] = snap0["hvd_controller_aborts_total"]["values"]
+    assert aborts >= 1
+
+    # (b) every rank dumped a parseable postmortem; the true survivors
+    # (0 = diagnoser, 2 = ABORT-broadcast recipient) name the dead rank.
+    for rank in range(3):
+        dump = tmp_path / f"fr.jsonl.rank{rank}"
+        assert dump.exists(), f"no flight recorder dump for rank {rank}"
+        lines = [json.loads(ln) for ln in dump.read_text().splitlines()]
+        assert lines[0]["kind"] == "flight_recorder_dump"
+        kinds = [ln["kind"] for ln in lines]
+        assert "fail_all" in kinds
+        if rank == 1:
+            # The silent rank never hears the ABORT (the coordinator
+            # skips the rank it diagnosed dead); its postmortem records
+            # losing the coordinator instead.
+            assert "coordinator_lost" in kinds, kinds
+            continue
+        named = [ln for ln in lines
+                 if ln["kind"] in ("abort", "remote_abort")
+                 and ln.get("dead_rank") == 1]
+        assert named, f"rank {rank} dump never names dead rank 1: {kinds}"
+        # The tail carries the diagnosis: fail_all (with in-flight ops)
+        # comes after the abort event that named the rank.
+        assert kinds.index("fail_all") > kinds.index(named[0]["kind"])
+
+
+def test_rank0_endpoint_serves_cluster_view():
+    """Acceptance: with HOROVOD_METRICS_PORT set, GET /metrics on rank 0
+    returns Prometheus text with per-rank-labeled wire + controller
+    series (workers piggyback snapshots on ticks)."""
+    base = _free_port()
+    _run_ranks(
+        "metrics_cluster",
+        extra_env={
+            "HOROVOD_METRICS_PORT": str(base),
+            "HOROVOD_METRICS_PUSH_CYCLES": "5",
+        },
+        timeout=120.0)

@@ -1,0 +1,144 @@
+"""Metric-catalog lint: keeps the telemetry namespace coherent as future
+PRs add series.
+
+Walks the ``horovod_tpu`` package source and asserts:
+
+1. every registered metric name is unique (one owning call site),
+   snake_case, and ``hvd_``-prefixed;
+2. no module registers metrics at **import time** — registration must be
+   lazy (the zero-overhead-off contract depends on it), verified in a
+   clean subprocess interpreter so this test is immune to whatever other
+   tests already registered in this process.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+from collections import Counter as TallyCounter
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "horovod_tpu")
+
+# registry.counter("name"...) / metrics.gauge("name"...) / r.histogram(...)
+_REG_CALL = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*\n?\s*[\"']([^\"']+)[\"']")
+_NAME_RULE = re.compile(r"^hvd_[a-z][a-z0-9_]*$")
+
+
+def _package_sources():
+    for root, _, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for fname in files:
+            if fname.endswith(".py"):
+                yield os.path.join(root, fname)
+
+
+def _registered_names():
+    names = []
+    for path in _package_sources():
+        with open(path) as f:
+            src = f.read()
+        for name in _REG_CALL.findall(src):
+            names.append((name, os.path.relpath(path, REPO)))
+    return names
+
+
+def test_metric_names_unique_snake_case_hvd_prefixed():
+    names = _registered_names()
+    assert names, "no metric registrations found — did the regex rot?"
+    bad = [(n, p) for n, p in names if not _NAME_RULE.match(n)]
+    assert not bad, f"non-conforming metric names (want hvd_snake_case): {bad}"
+    tally = TallyCounter(n for n, _ in names)
+    dupes = {n: [p for m, p in names if m == n]
+             for n, c in tally.items() if c > 1}
+    assert not dupes, (
+        "metric registered at more than one call site (each name must have "
+        f"exactly one owner): {dupes}")
+
+
+def test_known_series_present():
+    """The catalog documented in docs/metrics.md actually exists in code —
+    a rename must update the docs and this pin together."""
+    names = {n for n, _ in _registered_names()}
+    for expected in (
+        "hvd_wire_frames_sent_total",
+        "hvd_wire_bytes_recv_total",
+        "hvd_wire_recv_wait_seconds",
+        "hvd_wire_deadline_trips_total",
+        "hvd_controller_cycle_seconds",
+        "hvd_controller_fused_bytes_total",
+        "hvd_controller_cache_hits_total",
+        "hvd_controller_cache_misses_total",
+        "hvd_controller_stall_warnings_total",
+        "hvd_controller_aborts_total",
+        "hvd_collective_ops_total",
+        "hvd_collective_bytes_total",
+        "hvd_timeline_events_dropped_total",
+        "hvd_retry_giveups_total",
+        "hvd_init_cpu_fallback_total",
+        "hvd_launcher_restarts_total",
+    ):
+        assert expected in names, f"missing from the codebase: {expected}"
+
+
+def test_no_import_time_registration():
+    """Import, in a fresh interpreter, every module that CONTAINS a
+    registration call (telemetry env forced ON so a lazy guard can't hide
+    an eager registration bug at the on() check) and assert the default
+    registry is still empty. Modules with zero registration call sites —
+    proven by the static scan above — cannot register and are skipped:
+    importing the tensorflow/torch adapter trees would cost ~15s of
+    tier-1 budget to verify nothing."""
+    with_sites = {p for _, p in _registered_names()}
+    modules = []
+    for path in _package_sources():
+        rel = os.path.relpath(path, REPO)
+        in_metrics_pkg = os.sep + "metrics" + os.sep in path
+        if rel not in with_sites and not in_metrics_pkg:
+            continue
+        mod = rel[:-3].replace(os.sep, ".")
+        if mod.endswith(".__init__"):
+            mod = mod[: -len(".__init__")]
+        if mod.endswith(".__main__"):
+            continue  # importing a __main__ runs the CLI
+        modules.append(mod)
+    modules.append("horovod_tpu")  # the package root itself
+    code = (
+        "import importlib, json, sys\n"
+        "skipped = []\n"
+        f"for mod in {modules!r}:\n"
+        "    try:\n"
+        "        importlib.import_module(mod)\n"
+        "    except Exception as exc:\n"
+        "        skipped.append((mod, str(exc)[:100]))\n"
+        "from horovod_tpu import metrics\n"
+        "print(json.dumps({'names': metrics.default_registry().names(),\n"
+        "                  'skipped': skipped}))\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["HOROVOD_METRICS"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    report = json.loads(res.stdout.strip().splitlines()[-1])
+    assert report["names"] == [], (
+        "metrics registered at import time (must be lazy): "
+        f"{report['names']}")
+    # Optional-dep modules (mxnet/pyspark fakes, etc.) may fail to import
+    # in a bare interpreter; every instrumented module must NOT be skipped.
+    skipped = {m for m, _ in report["skipped"]}
+    for instrumented in ("horovod_tpu.common.wire",
+                        "horovod_tpu.common.timeline",
+                        "horovod_tpu.common.retry",
+                        "horovod_tpu.common.basics",
+                        "horovod_tpu.controller.controller",
+                        "horovod_tpu.run.launch",
+                        "horovod_tpu.metrics"):
+        assert instrumented not in skipped, (
+            f"{instrumented} failed to import: {report['skipped']}")
