@@ -202,7 +202,21 @@ def child_bench(status_path):
         "best_window": round(max(window_rates) / n, 2),
         "window_spread_pct": round(spread_pct, 2),
         "metrics": _controller_metrics(),
+        "straggler": _straggler_summary(),
     }), flush=True)
+
+
+def _straggler_summary():
+    """Straggler snapshot for the bench record (negotiation-slack p99 +
+    worst rank), alongside the controller-health `metrics` field. Fields
+    are None unless the run was traced (HOROVOD_TRACE_DIR) and the
+    attribution fed the registry — honest Nones beat invented zeros."""
+    try:
+        from horovod_tpu.trace import straggler as hvd_straggler
+
+        return hvd_straggler.summary()
+    except Exception as exc:  # telemetry must never fail the bench row
+        return {"error": str(exc)[:200]}
 
 
 def _controller_metrics():
@@ -324,6 +338,7 @@ def child_row(name, status_path):
                "unit": spec["unit"], "cmd": " ".join(
                    ["python", spec["script"]] + spec["args"])}
     row.setdefault("metrics", _controller_metrics())
+    row.setdefault("straggler", _straggler_summary())
     print(json.dumps(row), flush=True)
 
 

@@ -142,6 +142,11 @@ class Config:
     # Chrome-trace timeline output path (reference operations.cc:986-996).
     timeline_filename: Optional[str] = None
     timeline_mark_cycles: bool = False
+    # Cluster-wide distributed tracing (docs/tracing.md): every rank
+    # writes clock-anchored phase spans under this directory; rank 0
+    # merges them (+ straggler report) at clean shutdown. TPU-era
+    # extension — the reference timeline is per-rank only.
+    trace_dir: Optional[str] = None
     # Stall detection (reference operations.cc:688-769).
     stall_check_disable: bool = False
     stall_check_seconds: float = DEFAULT_STALL_CHECK_SECONDS
@@ -175,6 +180,8 @@ class Config:
             hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER"),
             timeline_filename=timeline,
             timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES"),
+            trace_dir=(os.environ.get("HOROVOD_TRACE_DIR") or "").strip()
+            or None,
             stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE"),
             stall_check_seconds=_env_float(
                 "HOROVOD_STALL_CHECK_TIME_SECONDS", DEFAULT_STALL_CHECK_SECONDS

@@ -76,6 +76,18 @@ class Timeline:
         self._file = open(filename, "w")
         self._file.write("[\n")
         self._closed = False
+        # Absolute anchor for the otherwise process-private timebase
+        # (docs/tracing.md): wall clock at the monotonic origin + rank, so
+        # even a standalone per-rank trace can be laid against another
+        # rank's (or the merged cluster trace) instead of floating.
+        from .config import env_rank
+
+        self._file.write(json.dumps({
+            "name": "clock_sync", "ph": "M", "pid": 0,
+            "args": {"wall_anchor": time.time(),
+                     "monotonic_origin": self._start,
+                     "rank": env_rank()},
+        }) + ",\n")
         self._dropped = 0  # overflow count; surfaced at close()
         # Own lock, NOT self._lock: _tensor_pid emits while holding
         # self._lock, so an overflow inside that call must not re-acquire

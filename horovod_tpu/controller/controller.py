@@ -110,7 +110,7 @@ class _Pending:
     ``common/common.h:167-184``)."""
 
     __slots__ = ("name", "array", "request", "handle", "average",
-                 "postprocess", "enqueued_at")
+                 "postprocess", "enqueued_at", "sent_at")
 
     def __init__(self, name: str, array: np.ndarray, request: Request,
                  handle: Handle, average: bool,
@@ -122,6 +122,12 @@ class _Pending:
         self.average = average
         self.postprocess = postprocess
         self.enqueued_at = time.monotonic()
+        # When this rank's request DEPARTED for the coordinator (stamped
+        # after the tick send completed, so send-path stalls are charged
+        # to this rank): the start of its "negotiate" trace span and the
+        # arrival signal the straggler attribution keys on. None until
+        # the request rides a tick (cache-bypass ops never negotiate).
+        self.sent_at: Optional[float] = None
 
 
 class ShutdownError(RuntimeError):
@@ -237,6 +243,42 @@ class Controller:
                 addr, topology.rank,
                 comm_timeout=config.comm_timeout_seconds)
             self._client.start_heartbeats(config.heartbeat_interval_seconds)
+
+        # Cluster tracing (docs/tracing.md): per-rank clock-anchored span
+        # writer, a coordinator-assigned sequence id per fused op carried
+        # on the cycle reply, and (rank 0) a clock-offset estimator fed by
+        # ping-pongs on the heartbeat frames. All inert without
+        # HOROVOD_TRACE_DIR.
+        self._trace_enabled = bool(config.trace_dir)
+        self._tracer = None
+        self._clock = None
+        self._cycle_index = 0
+        self._trace_seq = 0          # coordinator: next collective seq id
+        self._trace_last_seq: Optional[int] = None  # last executed here
+        if self._trace_enabled:
+            from ..common.config import _env_int
+            from ..trace import ClockSync, TraceWriter, rank_trace_path
+
+            self._clock_sync_cycles = max(
+                1, _env_int("HOROVOD_CLOCK_SYNC_CYCLES", 100))
+            try:
+                os.makedirs(config.trace_dir, exist_ok=True)
+                self._tracer = TraceWriter(
+                    rank_trace_path(config.trace_dir, topology.rank),
+                    topology.rank)
+            except OSError as exc:
+                # The shutdown trace exchange still runs (the predicate is
+                # the env-derived _trace_enabled, identical on every rank);
+                # this rank just contributes an empty blob.
+                logging.error(
+                    "trace: cannot write under %s (%s); rank %d will "
+                    "record no spans", config.trace_dir, exc, topology.rank)
+            if topology.rank == 0:
+                self._clock = ClockSync(topology.size)
+                for worker_rank, wire in self._service.wires.items():
+                    wire.set_clock_callback(
+                        lambda t0, wall, t1, _r=worker_rank:
+                        self._clock.observe(_r, t0, wall, t1))
 
         self._thread = threading.Thread(
             target=self._run_loop, name="hvd-controller", daemon=True)
@@ -430,6 +472,22 @@ class Controller:
             self._fail_all(self._diagnose_failure(exc))
         finally:
             self._closed.set()
+            if self._trace_enabled:
+                # Failure-path salvage: a clean shutdown already closed
+                # everything via _finalize_trace (both calls are
+                # idempotent); after a crash this leaves a valid local
+                # trace + offset table for the offline merge
+                # (python -m horovod_tpu.tools.straggler).
+                try:
+                    if self._tracer is not None:
+                        self._tracer.close()
+                    if self._clock is not None:
+                        from ..trace import OFFSETS_FILE
+
+                        self._clock.write(os.path.join(
+                            self.cfg.trace_dir, OFFSETS_FILE))
+                except Exception:
+                    pass  # tracing must never mask the real teardown
             for ring in (self._ring, self._local_ring, self._cross_ring):
                 if ring is not None:
                     ring.shutdown()
@@ -464,7 +522,8 @@ class Controller:
                    f"{inflight}")
             metrics.record_event("abort", dead_rank=exc.rank,
                                  cause=str(exc.cause)[:300],
-                                 inflight=inflight)
+                                 inflight=inflight,
+                                 last_seq=self._trace_last_seq)
             if self._service is not None:
                 self._service.send_abort_all(
                     msg, dead_rank=exc.rank,
@@ -473,13 +532,15 @@ class Controller:
         if isinstance(exc, RemoteAbortError):
             # The coordinator told us who died and what was pending there.
             metrics.record_event("remote_abort", dead_rank=exc.dead_rank,
-                                 op=exc.op, message=str(exc)[:300])
+                                 op=exc.op, message=str(exc)[:300],
+                                 last_seq=self._trace_last_seq)
             return RuntimeError(f"Horovod controller failed: job aborted by "
                                 f"coordinator: {exc}")
         if self._client is not None and isinstance(exc, (ConnectionError,
                                                          OSError)):
             metrics.record_event("coordinator_lost", error=str(exc)[:300],
-                                 inflight=inflight)
+                                 inflight=inflight,
+                                 last_seq=self._trace_last_seq)
             return RuntimeError(
                 f"Horovod controller failed: lost contact with the "
                 f"coordinator (rank 0): {exc}; in-flight ops: {inflight}")
@@ -531,12 +592,35 @@ class Controller:
             "requests": RequestList(requests=uncached, shutdown=shutdown),
         }
 
+    def _stamp_sent(self, tick: dict) -> None:
+        """Mark the tick's requests as departed (negotiate-span start /
+        straggler arrival signal). Called AFTER the send completed, so a
+        stalled or fault-delayed send is charged to this rank."""
+        if self._tracer is None:
+            return
+        now = time.monotonic()
+        with self._lock:
+            for req in tick["requests"].requests:
+                entry = self._table.get(req.tensor_name)
+                if entry is not None:
+                    entry.sent_at = now
+
     def _cycle(self) -> None:
         fault.hook("cycle")  # chaos seam: kill/delay/raise at cycle N
         mon = metrics.on()
         t_start = time.monotonic() if mon else 0.0
         tick = self._build_tick()
         if self.topo.rank == 0:
+            self._cycle_index += 1
+            if self._clock is not None and (
+                    self._cycle_index <= 8
+                    or self._cycle_index % self._clock_sync_cycles == 0):
+                # Offset refresh: a dense burst while the job warms up
+                # (short jobs still get synced), then periodic. Pongs are
+                # consumed whenever the coordinator next drains frames.
+                for wire in self._service.wires.values():
+                    wire.send_clock_ping()
+            self._stamp_sent(tick)  # rank 0's "send" is the local build
             t0 = time.monotonic()
             reply = self._coordinate(tick)
             nbytes = self._process_reply(reply)
@@ -560,6 +644,7 @@ class Controller:
                     self._cycles_since_push = 0
                     tick["metrics"] = metrics.snapshot()
             self._client.send(tick)
+            self._stamp_sent(tick)
             reply = self._client.recv()
             self._process_reply(reply)
         if mon:
@@ -624,6 +709,13 @@ class Controller:
             "invalid_mask": invalid_mask,
             "responses": ResponseList(responses=responses, shutdown=shutdown),
         }
+        if self._trace_enabled:
+            # Span propagation (docs/tracing.md): ONE base id per cycle;
+            # every rank derives per-op ids by walking the identical
+            # bypass-bits + responses order, so the ids agree everywhere
+            # without shipping one per op.
+            reply["trace_seq"] = self._trace_seq
+            self._trace_seq += len(bypass_bits) + len(responses)
         if self._pending_tune is not None:
             # Parameter sync (reference SyncParams, parameter_manager.cc:223).
             reply["tune"] = self._pending_tune
@@ -707,6 +799,10 @@ class Controller:
     # ----------------------------------------------------------- both sides
 
     def _process_reply(self, reply: dict) -> int:
+        # One stamp for the whole reply: negotiate spans end when the
+        # reply ARRIVED, not when each response's turn to execute came
+        # (executing response A must not inflate response B's span).
+        reply_at = time.monotonic()
         tune = reply.get("tune")
         cache_turned_off = False
         if tune is not None:
@@ -733,6 +829,17 @@ class Controller:
                     # Cache entry died under a pending hit: renegotiate.
                     self._queue.append(name)
 
+        # Collective sequence ids: the reply's base id plus the identical
+        # bypass+responses walk on every rank (see _coordinate).
+        seq_cursor = reply.get("trace_seq")
+
+        def _next_seq():
+            nonlocal seq_cursor
+            if seq_cursor is None:
+                return None
+            seq, seq_cursor = seq_cursor, seq_cursor + 1
+            return seq
+
         for bit in reply["bypass_bits"]:
             # Cached fast path (reference RunBypass, operations.cc:1166-1215).
             _, response = self._cache.get(bit)
@@ -742,7 +849,8 @@ class Controller:
             executed_bytes += self._execute(Response(
                 response_type=response.response_type,
                 tensor_names=[name],
-                tensor_sizes=list(response.tensor_sizes)), cache_put=False)
+                tensor_sizes=list(response.tensor_sizes)), cache_put=False,
+                seq=_next_seq(), reply_at=reply_at)
 
         if cache_turned_off:
             # Cache-hit tensors still parked on a bit (peer ranks hadn't
@@ -756,15 +864,81 @@ class Controller:
         rlist: ResponseList = reply["responses"]
         for response in rlist.responses:
             executed_bytes += self._execute(
-                response, cache_put=self._cache_enabled)
+                response, cache_put=self._cache_enabled, seq=_next_seq(),
+                reply_at=reply_at)
 
-        if rlist.shutdown or self._shutdown_requested:
+        # Teardown: a locally-requested shutdown normally exits right here
+        # (prompt), but a TRACED job must keep cycling until the flag has
+        # ridden a tick and come back echoed in rlist.shutdown — the
+        # reference's fully cooperative teardown — because the trace
+        # exchange below needs every rank to reach it in lockstep on the
+        # SAME cycle, wires still up. One extra ~cycle_time of latency,
+        # only when HOROVOD_TRACE_DIR is set.
+        if rlist.shutdown or (self._shutdown_requested
+                              and not self._trace_enabled):
+            if rlist.shutdown and self._trace_enabled:
+                self._finalize_trace()
             # Close BEFORE failing: once _fail_all empties the table, a
             # concurrently-enqueued op must take the closed branch, not
             # land in a table nobody will ever serve.
             self._closed.set()
             self._fail_all(ShutdownError("Horovod has been shut down"))
         return executed_bytes
+
+    def _finalize_trace(self) -> None:
+        """Shutdown trace collection, in lockstep off the shutdown reply:
+        workers close their span file and push its bytes to rank 0; rank 0
+        writes them out, dumps the clock-offset table, merges everything
+        into ``merged_trace.json`` and writes ``straggler_report.json``
+        (feeding the straggler metrics). Best-effort throughout — tracing
+        never turns a clean shutdown into a failure."""
+        try:
+            from .. import trace as trace_mod
+
+            trace_dir = self.cfg.trace_dir
+            if self.topo.rank != 0:
+                blob = b""
+                try:
+                    if self._tracer is not None:
+                        self._tracer.close()
+                        blob = self._tracer.read_bytes()
+                except Exception as exc:
+                    logging.error("trace: closing rank trace failed: %s", exc)
+                # The push must always happen — rank 0 is waiting for one
+                # blob per worker; empty means "nothing from this rank"
+                # (rank 0 then merges whatever shared-dir files exist).
+                self._client.send_bytes(blob)
+                return
+            blobs: Dict[int, bytes] = {}
+            for worker_rank in range(1, self.topo.size):
+                try:
+                    blobs[worker_rank] = self._service.recv_bytes_from(
+                        worker_rank)
+                except Exception as exc:
+                    logging.warning(
+                        "trace: rank %d pushed no trace (%s); merging the "
+                        "trace.rank*.json files that do exist",
+                        worker_rank, exc)
+                    break  # lockstep broken: stop collecting
+            if self._tracer is not None:
+                self._tracer.close()
+            for worker_rank, blob in blobs.items():
+                if blob:
+                    with open(trace_mod.rank_trace_path(
+                            trace_dir, worker_rank), "wb") as f:
+                        f.write(blob)
+            if self._clock is not None:
+                self._clock.write(
+                    os.path.join(trace_dir, trace_mod.OFFSETS_FILE))
+            merged = trace_mod.merge_trace_dir(trace_dir)
+            report = trace_mod.write_report(trace_dir)
+            logging.info("trace: merged trace at %s; straggler report at %s",
+                         merged, report)
+        except Exception as exc:
+            logging.error(
+                "trace: finalize failed: %s (per-rank trace files, if any, "
+                "can be merged offline with "
+                "`python -m horovod_tpu.tools.straggler <dir>`)", exc)
 
     def _fail_all(self, exc: BaseException) -> None:
         with self._lock:
@@ -781,14 +955,20 @@ class Controller:
             # Postmortem artifact: the recorder's tail now holds the abort
             # diagnosis (dead rank, in-flight ops) this exc carries.
             _ctl_metrics().aborts.inc()
+            # last_seq: the most recent collective sequence id this rank
+            # executed — the line in the merged trace (args.seq) where
+            # this postmortem picks up.
             metrics.record_event("fail_all", error=str(exc)[:500],
                                  pending=len(entries),
-                                 inflight=[e.name for e in entries[:16]])
+                                 inflight=[e.name for e in entries[:16]],
+                                 last_seq=self._trace_last_seq)
             metrics.dump_flight_recorder("fail_all")
 
     # ------------------------------------------------------------ data plane
 
-    def _execute(self, response: Response, cache_put: bool) -> int:
+    def _execute(self, response: Response, cache_put: bool,
+                 seq: Optional[int] = None,
+                 reply_at: Optional[float] = None) -> int:
         names = response.tensor_names
         if response.response_type == ResponseType.ERROR:
             with self._lock:
@@ -800,15 +980,32 @@ class Controller:
         with self._lock:
             entries = [self._table[n] for n in names]
         tname = names[0] if len(names) == 1 else f"fused[{len(names)}]"
+        if seq is not None:
+            self._trace_last_seq = seq
+        if self._tracer is not None:
+            # Retroactive per-tensor spans, now that the fused op's seq is
+            # known: enqueue = user call -> request departure; negotiate =
+            # departure -> this reply (cache-bypass ops never departed —
+            # no negotiate span, by design).
+            if reply_at is None:
+                reply_at = time.monotonic()
+            for entry in entries:
+                self._tracer.span(
+                    "enqueue", entry.enqueued_at,
+                    entry.sent_at if entry.sent_at is not None else reply_at,
+                    seq=seq, op=entry.name)
+                if entry.sent_at is not None:
+                    self._tracer.span("negotiate", entry.sent_at, reply_at,
+                                      seq=seq, op=entry.name)
         if self.timeline:
             self.timeline.start(tname, response.response_type.name)
 
         if response.response_type == ResponseType.ALLREDUCE:
-            self._execute_allreduce(entries, tname)
+            self._execute_allreduce(entries, tname, seq=seq)
         elif response.response_type == ResponseType.ALLGATHER:
-            self._execute_allgather(entries[0], response)
+            self._execute_allgather(entries[0], response, seq=seq)
         else:
-            self._execute_broadcast(entries[0])
+            self._execute_broadcast(entries[0], seq=seq)
 
         with self._lock:
             for entry in entries:
@@ -826,6 +1023,11 @@ class Controller:
             m = _ctl_metrics()
             m.tensors.inc(len(entries))
             m.fused_bytes.inc(nbytes)
+            # seq-stamped so a postmortem JSONL line is directly
+            # addressable in the merged trace (args.seq).
+            metrics.record_sampled_event(
+                "execute", seq=seq, op=response.response_type.name.lower(),
+                tensors=len(entries), nbytes=nbytes)
         return nbytes
 
     def _finish(self, entry: _Pending, out: np.ndarray) -> None:
@@ -833,9 +1035,11 @@ class Controller:
             out = entry.postprocess(out)
         entry.handle.set_result(out)
 
-    def _execute_allreduce(self, entries: List[_Pending], tname: str) -> None:
+    def _execute_allreduce(self, entries: List[_Pending], tname: str,
+                           seq: Optional[int] = None) -> None:
         # Pack the fusion buffer (reference MemcpyInFusionBuffer,
         # collective_operations.cc:35-50).
+        t_fuse = time.monotonic()
         if self.timeline:
             self.timeline.activity_start(tname, tl.MEMCPY_IN_FUSION_BUFFER)
         dtype = entries[0].array.dtype
@@ -843,6 +1047,7 @@ class Controller:
                np.concatenate([e.array.ravel() for e in entries]))
         # Integer sums are exact; float sums happen in the wire dtype, as in
         # the reference's MPI_SUM on the raw buffer.
+        t_exec = time.monotonic()
         if self.timeline:
             self.timeline.activity_end(tname)
             self.timeline.activity_start(tname, tl.TCP_COLLECTIVE)
@@ -872,6 +1077,7 @@ class Controller:
         else:
             self._client.send_bytes(buf.tobytes())
             result = np.frombuffer(self._client.recv_bytes(), dtype=dtype)
+        t_done = time.monotonic()
         if self.timeline:
             self.timeline.activity_end(tname)
             self.timeline.activity_start(tname, tl.MEMCPY_OUT_FUSION_BUFFER)
@@ -883,6 +1089,12 @@ class Controller:
             self._finish(entry, np.array(out, copy=True))
         if self.timeline:
             self.timeline.activity_end(tname)
+        if self._tracer is not None:
+            t_end = time.monotonic()
+            self._tracer.span("fuse", t_fuse, t_exec, seq=seq, op=tname,
+                              tensors=len(entries))
+            self._tracer.span("execute", t_exec, t_done, seq=seq, op=tname)
+            self._tracer.span("done", t_done, t_end, seq=seq, op=tname)
 
     def _use_ring(self, dtype) -> bool:
         """Path selection must be deterministic across ranks: depends only on
@@ -901,7 +1113,17 @@ class Controller:
         return (enabled and self._local_ring is not None
                 and RingBackend.dtype_code(dtype) is not None)
 
-    def _execute_allgather(self, entry: _Pending, response: Response) -> None:
+    def _trace_exec_done(self, seq: Optional[int], op: str,
+                         t0: float, t1: float) -> None:
+        """execute + done spans for the single-phase (unfused) ops."""
+        if self._tracer is not None:
+            t2 = time.monotonic()
+            self._tracer.span("execute", t0, t1, seq=seq, op=op)
+            self._tracer.span("done", t1, t2, seq=seq, op=op)
+
+    def _execute_allgather(self, entry: _Pending, response: Response,
+                           seq: Optional[int] = None) -> None:
+        t0 = time.monotonic()
         dtype = entry.array.dtype
         rest = entry.array.shape[1:]
         # Expose the negotiated per-rank first dims on the handle: callers
@@ -930,7 +1152,9 @@ class Controller:
                 flat = np.empty(total, dtype=dtype)
             self._local_ring.broadcast_(flat, 0)
             full = flat.reshape((sum(sizes),) + rest)
+            t1 = time.monotonic()
             self._finish(entry, np.array(full, copy=True))
+            self._trace_exec_done(seq, entry.name, t0, t1)
             return
         if self._use_ring(dtype):
             rest_elems = int(np.prod(rest, dtype=np.int64)) if rest else 1
@@ -951,14 +1175,20 @@ class Controller:
             self._client.send_bytes(entry.array.tobytes())
             raw = np.frombuffer(self._client.recv_bytes(), dtype=dtype)
             full = raw.reshape((sum(response.tensor_sizes),) + rest)
+        t1 = time.monotonic()
         self._finish(entry, np.array(full, copy=True))
+        self._trace_exec_done(seq, entry.name, t0, t1)
 
-    def _execute_broadcast(self, entry: _Pending) -> None:
+    def _execute_broadcast(self, entry: _Pending,
+                           seq: Optional[int] = None) -> None:
+        t0 = time.monotonic()
         root = entry.request.root_rank
         if self._use_ring(entry.array.dtype):
             result = np.array(entry.array, copy=True)
             self._ring.broadcast_(result, root)
+            t1 = time.monotonic()
             self._finish(entry, result)
+            self._trace_exec_done(seq, entry.name, t0, t1)
             return
         if self.topo.rank == 0:
             if root == 0:
@@ -980,7 +1210,9 @@ class Controller:
                 raw = self._client.recv_bytes()
                 result = np.frombuffer(raw, dtype=entry.array.dtype).reshape(
                     entry.array.shape)
+        t1 = time.monotonic()
         self._finish(entry, np.array(result, copy=True))
+        self._trace_exec_done(seq, entry.name, t0, t1)
 
 
 # ---------------------------------------------------------------------------

@@ -324,13 +324,13 @@ def run(args: argparse.Namespace) -> int:
         if interrupted.is_set():
             # Operator-initiated teardown (SIGINT/SIGTERM) is not a fault;
             # never auto-restart over the operator's intent.
-            return _exit(code)
+            return _exit(_finish_trace(args, code))
         if code == 0 or epoch >= max_restarts:
             if code != 0 and max_restarts > 0:
                 sys.stderr.write(
                     f"horovodrun: giving up after {epoch} restart(s); "
                     f"final exit code {code}\n")
-            return _exit(code)
+            return _exit(_finish_trace(args, code))
         epoch += 1
         delay = min(30.0, backoff * (2.0 ** (epoch - 1)))
         sys.stderr.write(
@@ -342,7 +342,7 @@ def run(args: argparse.Namespace) -> int:
         # relaunch, not schedule one more multi-hour attempt.
         if interrupted.wait(delay):
             epoch -= 1  # cancelled during backoff: this restart never ran
-            return _exit(code)
+            return _exit(_finish_trace(args, code))
         # Counted only once the backoff survives: a restart that was
         # cancelled mid-backoff must not appear in the restart history.
         if metrics.on():
@@ -351,9 +351,64 @@ def run(args: argparse.Namespace) -> int:
                                  exit_code=code)
 
 
+def _finish_trace(args: argparse.Namespace, code: int) -> int:
+    """Post-run trace hook for ``--trace``: rank 0 already merged on a
+    clean shutdown; after a crash (or a kill) the per-rank files are
+    still on disk, so merge whatever exists and point the operator at
+    the artifacts either way. Never changes the exit code."""
+    trace_dir = getattr(args, "trace", None)
+    if not trace_dir:
+        return code
+    try:
+        from .. import trace as trace_mod
+
+        merged = os.path.join(trace_dir, trace_mod.MERGED_TRACE_FILE)
+        report = os.path.join(trace_dir, trace_mod.REPORT_FILE)
+        if not os.path.exists(merged):
+            if not trace_mod.rank_trace_files(trace_dir):
+                sys.stderr.write(
+                    f"horovodrun: no per-rank traces under {trace_dir} to "
+                    "merge\n")
+                return code
+            trace_mod.merge_trace_dir(trace_dir)
+        if not os.path.exists(report):
+            trace_mod.write_report(trace_dir, feed=False)
+        sys.stderr.write(
+            f"horovodrun: merged trace at {merged}; straggler report at "
+            f"{report}\n")
+    except Exception as exc:  # tracing must never fail the launch result
+        sys.stderr.write(f"horovodrun: trace merge failed: {exc} "
+                         "(retry with python -m horovod_tpu.tools."
+                         f"straggler {trace_dir})\n")
+    return code
+
+
 def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
                  interrupted: Optional[threading.Event] = None) -> int:
     hosts = parse_hosts(args.hosts, args.np)
+    if getattr(args, "trace", None):
+        # Cluster tracing (docs/tracing.md): every rank writes spans under
+        # the shared dir; rank 0 merges at shutdown. The span source is
+        # the Python controller, so --trace pins HOROVOD_ENGINE=python
+        # unless the operator chose an engine explicitly.
+        os.makedirs(args.trace, exist_ok=True)
+        os.environ["HOROVOD_TRACE_DIR"] = args.trace
+        if not args.spmd and "HOROVOD_ENGINE" not in os.environ:
+            os.environ["HOROVOD_ENGINE"] = "python"
+            sys.stderr.write(
+                "horovodrun: --trace selects the python controller engine "
+                "(HOROVOD_ENGINE=python) — spans are emitted there; set "
+                "HOROVOD_ENGINE explicitly to override\n")
+        elif args.spmd or os.environ.get("HOROVOD_ENGINE") != "python":
+            # Say so NOW, not via an empty directory at exit: only the
+            # python controller emits spans.
+            sys.stderr.write(
+                "horovodrun: WARNING --trace has no span source under "
+                + ("--spmd" if args.spmd
+                   else f"HOROVOD_ENGINE={os.environ['HOROVOD_ENGINE']}")
+                + " — collective spans come from the python controller "
+                "engine; expect no trace.rank*.json files "
+                "(docs/tracing.md)\n")
     size = args.np
     secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
     coord_host = hosts[0][0]
@@ -620,6 +675,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--restart-backoff", type=float, default=1.0,
                         help="base seconds for the exponential restart "
                              "backoff (doubles per restart, capped at 30s)")
+    parser.add_argument("--trace", metavar="DIR", default=None,
+                        help="cluster-wide distributed tracing: every rank "
+                             "writes clock-anchored phase spans under DIR "
+                             "(HOROVOD_TRACE_DIR); rank 0 merges them into "
+                             "DIR/merged_trace.json with a straggler report "
+                             "at shutdown (docs/tracing.md)")
     parser.add_argument("--disable-cache", action="store_true",
                         help="skip the ssh-preflight result cache "
                              "(reference horovodrun --disable-cache)")

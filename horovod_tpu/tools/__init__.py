@@ -1,0 +1,5 @@
+"""Operator CLI tools (run as ``python -m horovod_tpu.tools.<name>``).
+
+* ``straggler`` — merge a trace directory's per-rank files (if needed)
+  and print/write the straggler-attribution report (docs/tracing.md).
+"""

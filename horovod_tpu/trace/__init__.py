@@ -1,0 +1,55 @@
+"""Cluster-wide distributed tracing (see ``docs/tracing.md``).
+
+Four pieces, Dapper-shaped (Sigelman et al., 2010) over the eager
+control plane:
+
+1. **Clock sync** (``trace/clock.py``) — the coordinator estimates each
+   worker's wall-clock offset ± uncertainty from RTT ping-pong
+   piggybacked on the wire's HEARTBEAT frames, refreshed for the life of
+   the job, and serialized as ``clock_offsets.json``.
+2. **Span propagation** (``trace/tracer.py`` + the controller) — the
+   coordinator assigns a monotonically increasing **collective sequence
+   id** per fused op, carried on the cycle reply; every rank emits
+   ``enqueue → negotiate → fuse → execute → done`` phase spans tagged
+   with it into its own ``trace.rank<N>.json``.
+3. **Merge** (``trace/merge.py``) — per-rank files are rebased through
+   the offset table into one ``merged_trace.json`` with one process-row
+   per rank (Chrome/Perfetto).
+4. **Attribution** (``trace/straggler.py``) — per collective, which rank
+   arrived last at negotiation and the slack distribution per
+   rank/phase; written as ``straggler_report.json`` and fed into the
+   metrics registry (``hvd_negotiation_slack_seconds``,
+   ``hvd_straggler_cycles_total{rank}``).
+
+Enable with ``HOROVOD_TRACE_DIR=<dir>`` (or ``horovodrun --trace DIR``);
+everything here is inert without it. Offline re-merge/attribution:
+``python -m horovod_tpu.tools.straggler <trace_dir>``.
+"""
+
+from __future__ import annotations
+
+from .clock import ClockSync, load_offsets  # noqa: F401
+from .merge import (  # noqa: F401
+    merge_events,
+    merge_trace_dir,
+    rank_trace_files,
+    write_trace,
+)
+from .straggler import attribute, summary, write_report  # noqa: F401
+from .tracer import (  # noqa: F401
+    MERGED_TRACE_FILE,
+    OFFSETS_FILE,
+    PHASES,
+    REPORT_FILE,
+    TraceWriter,
+    rank_trace_path,
+)
+
+__all__ = [
+    "ClockSync", "TraceWriter", "PHASES",
+    "rank_trace_path", "rank_trace_files", "merge_trace_dir",
+    "merge_events", "write_trace", "attribute", "write_report", "summary",
+    "load_offsets", "MERGED_TRACE_FILE", "OFFSETS_FILE", "REPORT_FILE",
+]
+# The HOROVOD_TRACE_DIR knob itself is parsed in exactly one place:
+# common/config.py (Config.from_env().trace_dir).

@@ -437,6 +437,33 @@ def scenario_fault_metrics(rank, size):
         raise AssertionError("injected fault did not surface")
 
 
+def scenario_trace(rank, size):
+    # Cluster-tracing acceptance (tests/test_trace.py): steady eager
+    # traffic with HOROVOD_TRACE_DIR set. At the lockstep shutdown rank 0
+    # collects every rank's span file, merges them through the clock
+    # offset table, and writes merged_trace.json + straggler_report.json;
+    # the parent asserts on the artifacts. Run with a FaultPlan delay on
+    # one rank's wire_send, the report must name that rank.
+    import json as _json
+
+    for i in range(25):
+        out = np.asarray(hvd.allreduce(np.ones(16, np.float32) * i,
+                                       average=False, name=f"tr.{i}"))
+        np.testing.assert_allclose(out, float(size) * i)
+    # Repeated name: cache-bypass collectives must carry seq ids too.
+    for i in range(5):
+        out = np.asarray(hvd.allreduce(np.ones(4, np.float32) * (i + rank),
+                                       average=False, name="tr.cached"))
+        np.testing.assert_allclose(out,
+                                   float(size) * i + sum(range(size)))
+    hvd.shutdown()  # triggers the lockstep trace finalize on every rank
+    if rank == 0:
+        # Attribution fed the registry during finalize: straggler series
+        # are now visible in the snapshot the parent parses.
+        print("METRICS_SNAPSHOT " + _json.dumps(hvd.metrics.snapshot()),
+              flush=True)
+
+
 def scenario_metrics_cluster(rank, size):
     # Rank-0 cluster view: workers piggyback registry snapshots on ticks
     # (HOROVOD_METRICS_PUSH_CYCLES); rank 0's exporter must serve every
@@ -1162,6 +1189,7 @@ SCENARIOS = {
     "fault_survivor": scenario_fault_survivor,
     "fault_metrics": scenario_fault_metrics,
     "metrics_cluster": scenario_metrics_cluster,
+    "trace": scenario_trace,
     "allreduce": scenario_allreduce,
     "fusion": scenario_fusion,
     "allgather": scenario_allgather,

@@ -59,6 +59,29 @@ def test_metrics_urls_logged_at_startup(monkeypatch):
     assert "ignoring unparseable HOROVOD_METRICS_PORT" in res.stderr
 
 
+def test_trace_flag_produces_merged_trace_and_report(tmp_path):
+    """horovodrun --trace DIR: ranks trace under DIR (python engine
+    pinned for the span source), rank 0 merges at shutdown, and the
+    launcher points the operator at the artifacts."""
+    import json
+
+    trace_dir = tmp_path / "trace"
+    res = _run_launcher(["-np", "2", "--trace", str(trace_dir),
+                         sys.executable, "-c", SCRIPT])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "HOROVOD_ENGINE=python" in res.stderr
+    assert "merged trace at" in res.stderr
+    merged = trace_dir / "merged_trace.json"
+    assert merged.exists(), res.stdout + res.stderr
+    events = json.loads(merged.read_text())
+    rows = {e["args"]["name"] for e in events
+            if e.get("name") == "process_name"}
+    assert rows >= {"rank 0", "rank 1"}
+    report = json.loads((trace_dir / "straggler_report.json").read_text())
+    assert report["collectives"] >= 1
+    assert report["ranks"] == [0, 1]
+
+
 def test_launch_failure_propagates():
     res = _run_launcher(
         ["-np", "2", sys.executable, "-c", "import sys; sys.exit(3)"])

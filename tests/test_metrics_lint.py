@@ -81,8 +81,35 @@ def test_known_series_present():
         "hvd_retry_giveups_total",
         "hvd_init_cpu_fallback_total",
         "hvd_launcher_restarts_total",
+        "hvd_negotiation_slack_seconds",
+        "hvd_straggler_cycles_total",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
+
+
+def test_trace_phase_names_fixed_vocabulary():
+    """Same discipline for trace spans as for metric names: phase strings
+    at every ``.span(...)`` emission site must come from the fixed
+    enqueue/negotiate/fuse/execute/done vocabulary (ad-hoc strings would
+    silently fall out of the merge's straggler attribution), and every
+    phase must actually be emitted somewhere."""
+    from horovod_tpu.trace import PHASES
+
+    span_call = re.compile(r"\.span\(\s*\n?\s*[\"']([a-z_]+)[\"']")
+    found = []
+    for path in _package_sources():
+        with open(path) as f:
+            src = f.read()
+        for name in span_call.findall(src):
+            found.append((name, os.path.relpath(path, REPO)))
+    assert found, "no trace span emission sites found — did the regex rot?"
+    bad = [(n, p) for n, p in found if n not in PHASES]
+    assert not bad, (
+        f"ad-hoc trace phase names (the vocabulary is fixed: {PHASES}): "
+        f"{bad}")
+    assert {n for n, _ in found} == set(PHASES), (
+        "a phase in the fixed vocabulary is never emitted: "
+        f"{set(PHASES) - {n for n, _ in found}}")
 
 
 def test_no_import_time_registration():
@@ -139,6 +166,7 @@ def test_no_import_time_registration():
                         "horovod_tpu.common.basics",
                         "horovod_tpu.controller.controller",
                         "horovod_tpu.run.launch",
+                        "horovod_tpu.trace.straggler",
                         "horovod_tpu.metrics"):
         assert instrumented not in skipped, (
             f"{instrumented} failed to import: {report['skipped']}")

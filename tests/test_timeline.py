@@ -35,6 +35,31 @@ def test_timeline_events(tmp_path):
     assert any(e.get("ph") == "E" for e in events)
 
 
+def test_timeline_clock_sync_anchor(tmp_path, monkeypatch):
+    """The timebase is no longer un-mergeable: the first record anchors
+    the monotonic origin to wall clock + rank, so even a standalone
+    per-rank trace can be laid against another rank's (docs/tracing.md)."""
+    monkeypatch.setenv("HOROVOD_RANK", "3")
+    path = tmp_path / "tl.json"
+    t = tl.Timeline(str(path))
+    t.start("x", tl.ALLREDUCE)
+    t.end("x")
+    t.close()
+    events = json.loads(path.read_text())
+    clock = events[0]
+    assert clock["name"] == "clock_sync" and clock["ph"] == "M"
+    assert clock["args"]["rank"] == 3
+    assert clock["args"]["wall_anchor"] > 0
+    assert clock["args"]["monotonic_origin"] >= 0
+    # A rank-less process (tests, single-host runs) records rank null
+    # rather than inventing 0.
+    monkeypatch.delenv("HOROVOD_RANK")
+    t2 = tl.Timeline(str(tmp_path / "tl2.json"))
+    t2.close()
+    events2 = json.loads((tmp_path / "tl2.json").read_text())
+    assert events2[0]["args"]["rank"] is None
+
+
 def test_timeline_via_init(tmp_path, monkeypatch):
     path = tmp_path / "tl.json"
     monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
