@@ -1,0 +1,54 @@
+"""Project-specific static analysis (``hvdlint``) + runtime lock checking.
+
+Horovod's core invariant — every rank issues the same collectives in the
+same order — is enforced at runtime by the coordinator; the round-9
+tracing additionally assumes every rank walks the identical
+bypass+responses order, and the fault-tolerance plane assumes ~25 locks
+across wire/controller/metrics/heartbeats never invert. Nothing checked
+any of this before a 256-chip job hung. This package does, in two
+complementary ways:
+
+* :mod:`~horovod_tpu.analysis.framework` + :mod:`~horovod_tpu.analysis.rules`
+  — an AST-based lint over the package source with distributed-correctness
+  rules (HVD001..HVD007), ``# hvdlint: disable=RULE`` suppressions, a
+  checked-in baseline for grandfathered findings, and JSON/text reporters.
+  CLI: ``python -m horovod_tpu.tools.lint``; gate: ``tests/test_lint.py``.
+* :mod:`~horovod_tpu.analysis.lockorder` — a runtime lock-order detector
+  (``HOROVOD_LOCKCHECK=1``): tracked locks record the global acquisition-
+  order graph and report cycles (potential deadlocks) with both stacks.
+
+Everything here is stdlib-only and import-light: ``common/wire.py`` (and
+every other hot module) imports :func:`~horovod_tpu.analysis.lockorder.make_lock`
+at module load, so this package must never pull in numpy/jax.
+
+See ``docs/static-analysis.md`` for the rule catalog and workflows.
+"""
+
+from .framework import (  # noqa: F401
+    Finding,
+    LintResult,
+    Rule,
+    SourceFile,
+    baseline_key,
+    iter_python_files,
+    lint_source,
+    load_baseline,
+    render_json,
+    render_text,
+    run_lint,
+    write_baseline,
+)
+from .lockorder import (  # noqa: F401
+    LockGraph,
+    TrackedLock,
+    lockcheck_enabled,
+    make_lock,
+)
+from .rules import ALL_RULES, get_rule  # noqa: F401
+
+__all__ = [
+    "Finding", "LintResult", "Rule", "SourceFile", "baseline_key",
+    "iter_python_files", "lint_source", "load_baseline", "render_json",
+    "render_text", "run_lint", "write_baseline", "ALL_RULES", "get_rule",
+    "LockGraph", "TrackedLock", "lockcheck_enabled", "make_lock",
+]

@@ -1,0 +1,323 @@
+"""Runtime lock-order (deadlock-potential) detector.
+
+The control plane holds ~25 locks across wire/controller/metrics/
+heartbeat/timeline threads with no ordering discipline; a deadlock only
+manifests when two threads interleave just wrong — typically on a
+256-chip job, never on a laptop. This module makes the ordering
+observable: with ``HOROVOD_LOCKCHECK=1`` every lock created through
+:func:`make_lock` is a :class:`TrackedLock` that records, per thread,
+the set of locks already held at each acquisition and folds the
+observations into one process-global **acquisition-order graph** (edge
+``A -> B``: some thread acquired B while holding A, with both stacks
+captured). A cycle in that graph is a potential deadlock even if the
+run never hung.
+
+Zero overhead when off: ``make_lock`` returns a plain
+``threading.Lock`` unless the knob is set (cached once, invalidated on
+fork like ``horovod_tpu.metrics``).
+
+Artifacts: at interpreter exit (or via :func:`write_graph`) the graph is
+written as ``lockgraph.json`` — ``HOROVOD_LOCKCHECK_OUTPUT`` overrides
+the path, a ``{rank}`` placeholder expands like the flight recorder's —
+and any cycles are logged loudly with the acquisition stacks of every
+edge. ``tests/test_lint.py`` seeds an inversion and asserts the cycle
+report; the 3-rank acceptance run asserts the real controller's graph
+is acyclic.
+
+Stdlib-only on purpose: ``common/wire.py`` imports this at module load.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import sys
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+ENV_KNOB = "HOROVOD_LOCKCHECK"
+ENV_OUTPUT = "HOROVOD_LOCKCHECK_OUTPUT"
+DEFAULT_OUTPUT = "lockgraph.json"
+GRAPH_FILE = DEFAULT_OUTPUT
+_STACK_LIMIT = 12
+
+_enabled: Optional[bool] = None
+
+
+def _invalidate_in_child() -> None:
+    global _enabled
+    _enabled = None
+
+
+os.register_at_fork(after_in_child=_invalidate_in_child)
+
+
+def lockcheck_enabled() -> bool:
+    """Whether ``HOROVOD_LOCKCHECK`` asks for tracked locks (cached; the
+    repo-wide knob semantics: "0"/"false"/"off" mean OFF)."""
+    global _enabled
+    if _enabled is None:
+        # Cannot route through common/config.py: this module loads BEFORE
+        # the rest of the package (wire/metrics import make_lock at module
+        # level) and must stay import-cycle-free. Same _env_bool
+        # semantics, read locally. hvdlint: disable=HVD003
+        val = (os.environ.get(ENV_KNOB) or "").strip().lower()
+        _enabled = val not in ("", "0", "false", "no", "off")
+    return _enabled
+
+
+def _capture_stack() -> List[str]:
+    """Compact acquisition stack: 'file:line in func' frames, innermost
+    last, with this module's own frames trimmed."""
+    frames = traceback.extract_stack()
+    out = []
+    here = os.path.abspath(__file__)
+    for fr in frames:
+        if os.path.abspath(fr.filename) == here:
+            continue
+        out.append(f"{fr.filename}:{fr.lineno} in {fr.name}")
+    return out[-_STACK_LIMIT:]
+
+
+class LockGraph:
+    """Process-global acquisition-order graph. Nodes are lock *names*
+    (many lock instances may share a name — e.g. every metric's child
+    lock — which is exactly the granularity ordering rules are stated
+    at). All internal state is guarded by an UNtracked plain lock."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # (held_name, acquired_name) -> {"count", "stack_held",
+        # "stack_acquired", "thread"} — stacks from the FIRST observation.
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        self._local = threading.local()
+
+    # -- per-thread held stack ---------------------------------------------
+
+    def _held(self) -> List[Tuple[str, List[str]]]:
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = []
+            self._local.held = held
+        return held
+
+    def note_acquired(self, name: str) -> None:
+        stack = _capture_stack()
+        held = self._held()
+        with self._mu:
+            for held_name, held_stack in held:
+                if held_name == name:
+                    continue  # re-acquiring a sibling of the same name
+                key = (held_name, name)
+                entry = self._edges.get(key)
+                if entry is None:
+                    self._edges[key] = {
+                        "count": 1,
+                        "thread": threading.current_thread().name,
+                        "stack_held": held_stack,
+                        "stack_acquired": stack,
+                    }
+                else:
+                    entry["count"] += 1
+        held.append((name, stack))
+
+    def note_released(self, name: str) -> None:
+        held = self._held()
+        # Release order need not be LIFO; drop the most recent entry of
+        # this name.
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == name:
+                del held[i]
+                return
+
+    # -- graph queries ------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], dict]:
+        with self._mu:
+            return {k: dict(v) for k, v in self._edges.items()}
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the order graph (each a name list with
+        the start repeated at the end). Any cycle means two threads can
+        deadlock by acquiring along different edges of it."""
+        edges = self.edges()
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        for targets in adj.values():
+            targets.sort()
+        cycles: List[List[str]] = []
+        seen_cycles = set()
+
+        def dfs(start: str, node: str, path: List[str],
+                on_path: set) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    # Normalize rotation so each cycle reports once.
+                    cyc = path[:]
+                    pivot = cyc.index(min(cyc))
+                    norm = tuple(cyc[pivot:] + cyc[:pivot])
+                    if norm not in seen_cycles:
+                        seen_cycles.add(norm)
+                        cycles.append(list(norm) + [norm[0]])
+                elif nxt not in on_path and nxt > start:
+                    # Only explore nodes > start: every elementary cycle
+                    # is found from its smallest node exactly once.
+                    on_path.add(nxt)
+                    path.append(nxt)
+                    dfs(start, nxt, path, on_path)
+                    path.pop()
+                    on_path.discard(nxt)
+
+        for start in sorted(adj):
+            dfs(start, start, [start], {start})
+        return cycles
+
+    def report(self) -> dict:
+        """JSON-clean graph + cycle report (the ``lockgraph.json``
+        payload). Each cycle carries the stacks of every edge on it —
+        both where the first lock was held and where the second was
+        acquired — so the inversion is actionable from the artifact
+        alone."""
+        edges = self.edges()
+        cycles = self.cycles()
+        cycle_details = []
+        for cyc in cycles:
+            steps = []
+            for a, b in zip(cyc, cyc[1:]):
+                entry = edges.get((a, b), {})
+                steps.append({
+                    "from": a, "to": b,
+                    "count": entry.get("count", 0),
+                    "thread": entry.get("thread"),
+                    "stack_held": entry.get("stack_held", []),
+                    "stack_acquired": entry.get("stack_acquired", []),
+                })
+            cycle_details.append({"locks": cyc, "edges": steps})
+        return {
+            "enabled": lockcheck_enabled(),
+            "locks": sorted({n for e in edges for n in e}),
+            "edges": [
+                {"from": a, "to": b, "count": v["count"],
+                 "thread": v["thread"],
+                 "stack_held": v["stack_held"],
+                 "stack_acquired": v["stack_acquired"]}
+                for (a, b), v in sorted(edges.items())
+            ],
+            "cycles": cycle_details,
+            "acyclic": not cycles,
+        }
+
+    def write(self, path: str) -> str:
+        report = self.report()
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    def clear(self) -> None:
+        with self._mu:
+            self._edges.clear()
+
+
+_graph = LockGraph()
+
+
+def graph() -> LockGraph:
+    return _graph
+
+
+class TrackedLock:
+    """Drop-in ``threading.Lock`` wrapper feeding the order graph.
+
+    Supports the full Lock protocol (context manager,
+    ``acquire(blocking=, timeout=)``, ``locked()``); only *successful*
+    acquisitions are recorded — a failed try-acquire establishes no
+    ordering."""
+
+    __slots__ = ("name", "_inner", "_graph")
+
+    def __init__(self, name: str, graph_: Optional[LockGraph] = None,
+                 inner=None):
+        self.name = name
+        self._inner = inner if inner is not None else threading.Lock()
+        self._graph = graph_ if graph_ is not None else _graph
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._graph.note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._graph.note_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name!r} {self._inner!r}>"
+
+
+def make_lock(name: str):
+    """THE lock factory for instrumented subsystems: a plain
+    ``threading.Lock`` normally, a :class:`TrackedLock` under
+    ``HOROVOD_LOCKCHECK=1``. Call sites pay one cached-boolean check at
+    *creation* time only — the returned plain lock has zero added
+    acquire/release cost."""
+    if lockcheck_enabled():
+        return TrackedLock(name)
+    return threading.Lock()
+
+
+def output_path() -> str:
+    """Where the atexit dump lands: ``HOROVOD_LOCKCHECK_OUTPUT`` (with
+    the flight recorder's ``{rank}``/``.rankN`` expansion) or
+    ``lockgraph.json`` in the CWD."""
+    # Import-cycle-free like lockcheck_enabled. hvdlint: disable=HVD003
+    path = (os.environ.get(ENV_OUTPUT) or "").strip() or DEFAULT_OUTPUT
+    rank = (os.environ.get("HOROVOD_RANK") or "").strip() or None  # hvdlint: disable=HVD003
+    if "{rank}" in path:
+        return path.replace("{rank}", rank if rank is not None else "0")
+    if rank is not None:
+        return f"{path}.rank{rank}"
+    return path
+
+
+def write_graph(path: Optional[str] = None) -> Optional[str]:
+    """Dump the current graph (report + cycles). Returns the path, or
+    None when lockcheck is off or the dump fails (never raises — the
+    detector must not fail the job it observes)."""
+    if not lockcheck_enabled():
+        return None
+    try:
+        out = _graph.write(path or output_path())
+    except OSError as exc:
+        sys.stderr.write(f"lockcheck: cannot write lock graph: {exc}\n")
+        return None
+    cycles = _graph.cycles()
+    if cycles:
+        sys.stderr.write(
+            "lockcheck: LOCK-ORDER CYCLE(S) detected (potential deadlock): "
+            + "; ".join(" -> ".join(c) for c in cycles)
+            + f" — full stacks in {out}\n")
+    return out
+
+
+def _atexit_dump() -> None:
+    if lockcheck_enabled():
+        write_graph()
+
+
+atexit.register(_atexit_dump)

@@ -1,0 +1,397 @@
+"""hvdlint distributed-correctness rules (HVD001..HVD007).
+
+Each rule encodes one invariant the runtime depends on but cannot check
+until a job is already hung:
+
+* HVD001 — a collective call lexically inside a rank-conditional branch.
+  Every rank must issue the same collectives in the same order (the
+  coordinator's negotiation assumes it; SURVEY §L1); a collective only
+  some ranks reach deadlocks the rest. Intentional subgroup collectives
+  (e.g. the hierarchical cross-ring on local roots) carry a suppression.
+* HVD002 — unordered dict/set iteration in controller/negotiation paths.
+  Wire payload construction and response walks must be identical on
+  every rank; dict insertion order is process-local history. Wrap the
+  walk in ``sorted(...)``.
+* HVD003 — ``os.environ`` value reads outside ``common/config.py``.
+  Config has exactly one choke point so every rank parses a knob the
+  same way; a stray read invents a second, subtly different parser.
+  Mutations (``os.environ[...] = v``, ``.pop``, ``.update``) and
+  membership tests stay allowed — exporting env to children is the
+  launcher's job.
+* HVD004 — ``time.time()`` where a duration/deadline is being measured.
+  Wall clocks step (NTP) and a stepped deadline fires early or never;
+  ``time.monotonic()`` is the duration clock. Wall-clock *anchors*
+  (trace clock-sync, event timestamps) are legitimate and carry
+  suppressions.
+* HVD005 — ``threading.Thread`` without explicit ``name=`` and
+  ``daemon=``. An anonymous non-daemon thread is invisible in stack
+  dumps and blocks interpreter exit; every spawn site must decide both.
+* HVD006 — import-time side effects: metric registration, env value
+  reads, or thread spawns at module top level. Importing must be free
+  (the zero-overhead-off telemetry contract and fork semantics depend
+  on it).
+* HVD007 — metric catalog discipline: every literal metric name
+  registered via ``counter()``/``gauge()``/``histogram()`` must be
+  ``hvd_``-prefixed snake_case and have exactly one owning call site
+  (the AST successor of the regex checks in tests/test_metrics_lint.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Tuple, Type
+
+from .framework import Finding, Rule, SourceFile
+
+CONFIG_MODULE_SUFFIX = "common/config.py"
+
+# Names that enqueue a collective on the eager tier (package API surface
+# plus the in-place/async variants and ring-backend methods).
+COLLECTIVE_NAMES = frozenset({
+    "allreduce", "allreduce_", "allreduce_async",
+    "allgather", "allgather_", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async",
+    "alltoall", "reducescatter", "barrier",
+    "grouped_allreduce", "grouped_allreduce_",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "broadcast_object", "allgather_object", "broadcast_variables",
+})
+
+# Identifiers whose appearance in an ``if`` test marks it rank-conditional.
+RANK_NAMES = frozenset({"rank", "local_rank", "cross_rank", "process_index"})
+
+METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
+_METRIC_NAME_RE = re.compile(r"^hvd_[a-z][a-z0-9_]*$")
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """Trailing identifier of the called object: ``hvd.allreduce`` ->
+    ``allreduce``, ``barrier`` -> ``barrier``."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _mentions_rank(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in RANK_NAMES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in RANK_NAMES:
+            return True
+    return False
+
+
+def _is_os_environ(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "environ"
+            and isinstance(node.value, ast.Name) and node.value.id == "os")
+
+
+class DivergentCollectiveRule(Rule):
+    code = "HVD001"
+    name = "divergent-collective"
+    description = ("collective call lexically inside a rank-conditional "
+                   "branch: ranks taking the other branch never enqueue it "
+                   "and the job deadlocks at negotiation")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        findings: List[Finding] = []
+
+        def visit(node: ast.AST, inside: bool) -> None:
+            if isinstance(node, ast.If) and _mentions_rank(node.test):
+                # The test expression itself runs on every rank.
+                visit_children([node.test], inside)
+                visit_children(node.body + node.orelse, True)
+                return
+            if isinstance(node, ast.Call) and inside:
+                cname = _call_name(node)
+                if cname in COLLECTIVE_NAMES:
+                    findings.append(self.finding(
+                        src, node,
+                        f"collective '{cname}' inside a rank-conditional "
+                        "branch (divergent-collective deadlock): hoist it "
+                        "out, or suppress if the subgroup genuinely "
+                        "matches the conditional"))
+            visit_children(ast.iter_child_nodes(node), inside)
+
+        def visit_children(children, inside: bool) -> None:
+            for child in children:
+                visit(child, inside)
+
+        visit(src.tree, False)
+        yield from findings
+
+
+class UnorderedIterationRule(Rule):
+    code = "HVD002"
+    name = "unordered-controller-iteration"
+    description = ("unordered dict/set iteration in controller/negotiation "
+                   "paths: wire payloads and response walks must be "
+                   "identical on every rank — wrap in sorted(...)")
+
+    PATH_MARKERS = ("controller/",)
+    METHODS = frozenset({"items", "keys", "values"})
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not any(m in src.relpath for m in self.PATH_MARKERS):
+            return
+        sorted_args = set()
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                    and node.func.id == "sorted" and node.args):
+                sorted_args.add(id(node.args[0]))
+        for node in ast.walk(src.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self.METHODS
+                    and not node.args and not node.keywords
+                    and id(node) not in sorted_args):
+                yield self.finding(
+                    src, node,
+                    f"unordered '.{node.func.attr}()' walk in a controller "
+                    "path; dict order is process-local history — wrap in "
+                    "sorted(...) so every rank walks the same order")
+
+
+class StrayEnvReadRule(Rule):
+    code = "HVD003"
+    name = "stray-env-read"
+    description = ("os.environ value read outside common/config.py: all "
+                   "knob parsing goes through the config accessors so "
+                   "every rank agrees on malformed values")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if src.relpath.endswith(CONFIG_MODULE_SUFFIX):
+            return
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute) and func.attr == "get"
+                        and _is_os_environ(func.value)):
+                    yield self._found(src, node)
+                elif (isinstance(func, ast.Attribute)
+                      and func.attr == "getenv"
+                      and isinstance(func.value, ast.Name)
+                      and func.value.id == "os"):
+                    yield self._found(src, node)
+            elif (isinstance(node, ast.Subscript)
+                  and _is_os_environ(node.value)
+                  and isinstance(getattr(node, "ctx", None), ast.Load)):
+                # ctx distinguishes reads from writes/deletes on its own:
+                # `os.environ["K"] = v` is a Store, `del ...` a Del. Only
+                # Load-context subscripts are value reads.
+                yield self._found(src, node)
+
+    def _found(self, src: SourceFile, node: ast.AST) -> Finding:
+        var = None
+        key = None
+        if isinstance(node, ast.Call) and node.args:
+            key = node.args[0]
+        elif isinstance(node, ast.Subscript):
+            key = node.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            var = key.value
+        what = f" of {var!r}" if var else ""
+        return self.finding(
+            src, node,
+            f"direct os.environ read{what} bypasses common/config.py; "
+            "add/use a config accessor so every consumer parses the knob "
+            "identically")
+
+
+class WallClockDeadlineRule(Rule):
+    code = "HVD004"
+    name = "wall-clock-duration"
+    description = ("time.time() used where durations/deadlines are "
+                   "measured; wall clocks step under NTP — use "
+                   "time.monotonic() (wall-clock anchors carry a "
+                   "suppression)")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        bare_time_imported = any(
+            isinstance(node, ast.ImportFrom) and node.module == "time"
+            and any(a.name == "time" for a in node.names)
+            for node in ast.walk(src.tree))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = (isinstance(func, ast.Attribute) and func.attr == "time"
+                   and isinstance(func.value, ast.Name)
+                   and func.value.id == "time")
+            hit = hit or (bare_time_imported and isinstance(func, ast.Name)
+                          and func.id == "time")
+            if hit:
+                yield self.finding(
+                    src, node,
+                    "time.time() in runtime code: use time.monotonic() for "
+                    "durations/deadlines; a genuine wall-clock anchor "
+                    "(trace clock-sync, event timestamps) should carry "
+                    "'# hvdlint: disable=HVD004'")
+
+
+class AnonymousThreadRule(Rule):
+    code = "HVD005"
+    name = "anonymous-thread"
+    description = ("threading.Thread without explicit name= and daemon=: "
+                   "anonymous threads are invisible in stack dumps and an "
+                   "implicit daemon=False blocks interpreter exit")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        thread_imported = any(
+            isinstance(node, ast.ImportFrom) and node.module == "threading"
+            and any(a.name == "Thread" for a in node.names)
+            for node in ast.walk(src.tree))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = (isinstance(func, ast.Attribute) and func.attr == "Thread"
+                   and isinstance(func.value, ast.Name)
+                   and func.value.id == "threading")
+            hit = hit or (thread_imported and isinstance(func, ast.Name)
+                          and func.id == "Thread")
+            if not hit:
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            missing = [k for k in ("name", "daemon") if k not in kwargs]
+            if missing:
+                missing_txt = " and ".join(m + "=" for m in missing)
+                yield self.finding(
+                    src, node,
+                    f"threading.Thread without explicit {missing_txt}; "
+                    "name every thread (hvd-*) and state daemon-ness "
+                    "explicitly")
+
+
+class ImportTimeSideEffectRule(Rule):
+    code = "HVD006"
+    name = "import-time-side-effect"
+    description = ("module-top-level side effect (metric registration, env "
+                   "value read, thread spawn): importing must be free — "
+                   "the zero-overhead telemetry and fork contracts depend "
+                   "on it")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for stmt in self._top_level_statements(src.tree):
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = _call_name(node)
+                func = node.func
+                if cname in METRIC_FACTORIES and self._is_registration(node):
+                    yield self.finding(
+                        src, node,
+                        f"metric '{cname}(...)' registered at import time; "
+                        "registration must be lazy (first use), see the "
+                        "_m/SimpleNamespace convention")
+                elif (isinstance(func, ast.Attribute) and func.attr == "get"
+                      and _is_os_environ(func.value)) or (
+                          isinstance(func, ast.Attribute)
+                          and func.attr == "getenv"
+                          and isinstance(func.value, ast.Name)
+                          and func.value.id == "os"):
+                    yield self.finding(
+                        src, node,
+                        "env value read at import time: module constants "
+                        "must not freeze the environment before the "
+                        "launcher/runtime finished exporting it — read "
+                        "lazily through common/config.py")
+                elif (isinstance(func, ast.Attribute)
+                      and func.attr == "Thread"
+                      and isinstance(func.value, ast.Name)
+                      and func.value.id == "threading"):
+                    yield self.finding(
+                        src, node,
+                        "thread spawned at import time: threads don't "
+                        "survive fork and import order becomes a runtime "
+                        "dependency — spawn from init paths")
+
+    @staticmethod
+    def _top_level_statements(tree: ast.Module):
+        """Module-level statements, descending into top-level if/try
+        bodies (the common guard patterns) but never into defs/classes."""
+        pending = list(tree.body)
+        while pending:
+            stmt = pending.pop(0)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.If, ast.Try)):
+                for field in ("body", "orelse", "finalbody"):
+                    pending.extend(getattr(stmt, field, []) or [])
+                for h in getattr(stmt, "handlers", []) or []:
+                    pending.extend(h.body)
+                continue
+            yield stmt
+
+    @staticmethod
+    def _is_registration(node: ast.Call) -> bool:
+        """A registration passes a literal metric name first — matching
+        HVD007's notion of a catalog entry."""
+        return bool(node.args) and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str)
+
+
+class MetricCatalogRule(Rule):
+    code = "HVD007"
+    name = "metric-catalog"
+    description = ("registered metric names must be unique (one owning "
+                   "call site), snake_case, and hvd_-prefixed — the "
+                   "telemetry namespace stays coherent as PRs add series")
+
+    def __init__(self):
+        # Cross-file state for the duration of one run_lint() pass (a
+        # fresh instance per run): first-seen call site per metric name.
+        self._seen: Dict[str, str] = {}
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        for name, node in self.registrations(src.tree):
+            if not _METRIC_NAME_RE.match(name):
+                yield self.finding(
+                    src, node,
+                    f"metric name {name!r} violates the catalog convention "
+                    "(want hvd_ + lowercase snake_case)")
+            first = self._seen.get(name)
+            if first is None:
+                self._seen[name] = f"{src.relpath}:{node.lineno}"
+            else:
+                yield self.finding(
+                    src, node,
+                    f"metric {name!r} registered at more than one call "
+                    f"site (first owner: {first}); each name must have "
+                    "exactly one owner")
+
+    @staticmethod
+    def registrations(tree: ast.AST) -> Iterator[Tuple[str, ast.Call]]:
+        """Every ``counter/gauge/histogram("literal", ...)`` call —
+        the shared definition of "a catalog entry" (test_metrics_lint
+        builds its name inventory on this)."""
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and _call_name(node) in METRIC_FACTORIES
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                yield node.args[0].value, node
+
+
+ALL_RULES: List[Type[Rule]] = [
+    DivergentCollectiveRule,
+    UnorderedIterationRule,
+    StrayEnvReadRule,
+    WallClockDeadlineRule,
+    AnonymousThreadRule,
+    ImportTimeSideEffectRule,
+    MetricCatalogRule,
+]
+
+
+def get_rule(code: str) -> Type[Rule]:
+    for cls in ALL_RULES:
+        if cls.code == code.upper():
+            return cls
+    raise KeyError(f"unknown hvdlint rule {code!r}")

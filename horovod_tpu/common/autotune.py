@@ -280,6 +280,8 @@ class ParameterManager:
                                 + ",score_bytes_per_sec\n")
                     self._log_header_due = False
                 cats = ",".join(str(int(v)) for _, v in cat_items)
+                # Log-row wall stamp, read next to other logs — not
+                # duration math. hvdlint: disable=HVD004
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
                         f"{self.cycle_time_ms:.3f},{cats},{score:.1f}\n")
 

@@ -135,11 +135,11 @@ def _maybe_init_jax_distributed() -> None:
     Hardened (round-6 outage, artifacts/tpu_outage_r6.md): preflight-probed
     and retried with exponential backoff under ``HOROVOD_TPU_INIT_RETRIES``/
     ``_BACKOFF`` instead of wedging on the first dead coordinator."""
-    coord = os.environ.get("HOROVOD_SPMD_COORDINATOR")
+    coord = config_mod.spmd_coordinator()
     if not coord:
         return
-    rank = os.environ.get("HOROVOD_RANK")
-    size = os.environ.get("HOROVOD_SIZE")
+    rank = config_mod.env_rank()
+    size = config_mod.env_size()
     if rank is None or size is None:
         raise RuntimeError(
             "HOROVOD_SPMD_COORDINATOR is set but HOROVOD_RANK/HOROVOD_SIZE "
@@ -155,7 +155,7 @@ def _maybe_init_jax_distributed() -> None:
     if already:
         return
     kwargs = {}
-    raw_timeout = (os.environ.get("HOROVOD_START_TIMEOUT") or "").strip()
+    raw_timeout = (config_mod.env_str("HOROVOD_START_TIMEOUT") or "").strip()
     if raw_timeout:
         # One parser for every HOROVOD_START_TIMEOUT consumer
         # (config.start_timeout_seconds): garbage falls back to the same
@@ -170,7 +170,7 @@ def _maybe_init_jax_distributed() -> None:
         if not explicit_off:
             kwargs["initialization_timeout"] = int(
                 config_mod.start_timeout_seconds())
-    if int(rank) != 0:
+    if rank != 0:
         # Rank 0 HOSTS the coordinator service inside initialize();
         # probing it from rank 0 before the call would always fail.
         _preflight_coordinator(coord)
@@ -201,8 +201,8 @@ def _maybe_init_jax_distributed() -> None:
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,
-                num_processes=int(size),
-                process_id=int(rank),
+                num_processes=size,
+                process_id=rank,
                 **kwargs)
         except Exception:
             _reset_distributed_state()
@@ -210,7 +210,7 @@ def _maybe_init_jax_distributed() -> None:
 
     attempts, backoff = retry.init_retry_env()
     retry.retry_call(_attempt, attempts=attempts, backoff=backoff,
-                     seed=int(rank), describe="jax.distributed.initialize")
+                     seed=rank, describe="jax.distributed.initialize")
 
 
 def _acquire_backend() -> bool:
@@ -248,7 +248,7 @@ def _acquire_backend() -> bool:
     attempts, backoff = retry.init_retry_env()
     try:
         retry.retry_call(_attempt, attempts=attempts, backoff=backoff,
-                         seed=int(os.environ.get("HOROVOD_RANK", "0") or 0),
+                         seed=config_mod.env_rank() or 0,
                          describe="jax backend acquisition")
         return True
     except retry.RetryError as exc:
@@ -341,7 +341,7 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         # both derive from launcher-exported env, so it is.
         from .config import ring_data_plane_enabled
 
-        engine = os.environ.get("HOROVOD_ENGINE")
+        engine = config_mod.engine()
         if engine is None:
             engine = "native" if ring_data_plane_enabled() else "python"
         use_native = topology.size > 1 and engine == "native"
@@ -355,7 +355,7 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
             from ..controller.native import NativeController
 
             _state.controller = NativeController(config, topology)
-        elif topology.size > 1 and os.environ.get("HOROVOD_CONTROLLER_ADDR"):
+        elif topology.size > 1 and config_mod.controller_addr():
             # Python controller over the TCP star.
             from ..controller.controller import Controller
 

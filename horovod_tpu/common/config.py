@@ -93,6 +93,132 @@ def env_rank() -> Optional[int]:
         return None
 
 
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw environment string, unset -> ``default``. THE generic reader:
+    every value read of the environment outside this module goes through
+    an accessor here (enforced by hvdlint HVD003), so there is exactly
+    one place that decides what unset/empty/garbage means per knob."""
+    val = os.environ.get(name)
+    return default if val is None else val
+
+
+def env_size() -> Optional[int]:
+    """``HOROVOD_SIZE`` as Optional[int]; unset/empty/garbage -> None
+    (the :func:`env_rank` convention — the two must agree on what a
+    malformed launch environment means)."""
+    val = os.environ.get("HOROVOD_SIZE")
+    if val is None or not val.strip():
+        return None
+    try:
+        return int(val)
+    except ValueError:
+        return None
+
+
+def engine() -> Optional[str]:
+    """``HOROVOD_ENGINE`` (native/python), None when the launcher left
+    the choice to :func:`ring_data_plane_enabled`. Every rank derives the
+    same answer from the same launcher-exported env."""
+    return os.environ.get("HOROVOD_ENGINE") or None
+
+
+def controller_addr() -> Optional[str]:
+    """``HOROVOD_CONTROLLER_ADDR``: the coordinator's TCP star endpoint,
+    exported by horovodrun; None outside a launched eager job."""
+    return os.environ.get("HOROVOD_CONTROLLER_ADDR") or None
+
+
+def spmd_coordinator() -> Optional[str]:
+    """``HOROVOD_SPMD_COORDINATOR``: jax.distributed coordinator address
+    (horovodrun --spmd); None outside SPMD multi-host mode."""
+    return os.environ.get("HOROVOD_SPMD_COORDINATOR") or None
+
+
+def secret_key_hex() -> Optional[str]:
+    """``HOROVOD_SECRET_KEY`` (hex), the per-job HMAC key minted by the
+    launcher. None means the hermetic single-job default applies
+    (``common/wire.job_secret``) — both wire implementations and the
+    launcher must agree on that fallback."""
+    return os.environ.get("HOROVOD_SECRET_KEY") or None
+
+
+def ring_addrs() -> Optional[str]:
+    """``HOROVOD_RING_ADDRS``: per-rank addresses for the native ring
+    data plane (launcher-exported, identical on every rank)."""
+    return os.environ.get("HOROVOD_RING_ADDRS") or None
+
+
+def local_ring_addrs() -> Optional[str]:
+    return os.environ.get("HOROVOD_LOCAL_RING_ADDRS") or None
+
+
+def cross_ring_addrs() -> Optional[str]:
+    return os.environ.get("HOROVOD_CROSS_RING_ADDRS") or None
+
+
+def cpu_ops() -> str:
+    """``HOROVOD_CPU_OPS``: "star" forces the pure-Python star data
+    plane; anything else (default "ring") allows the native rings. Part
+    of the per-rank-identical path-selection predicate
+    (:func:`ring_data_plane_enabled`)."""
+    return os.environ.get("HOROVOD_CPU_OPS", "ring")
+
+
+def flash_xla_bwd() -> bool:
+    """``HOROVOD_FLASH_XLA_BWD``: trace-time escape hatch selecting the
+    rematerialized XLA backward for flash attention (O(S^2) memory).
+    Raw truthiness on purpose — the historical contract is "set to
+    anything non-empty", and both consumers (ops/attention.py,
+    parallel/sequence.py) must keep flipping together."""
+    return bool(os.environ.get("HOROVOD_FLASH_XLA_BWD"))
+
+
+def flight_recorder_path() -> Optional[str]:
+    """``HOROVOD_FLIGHT_RECORDER``: crash-postmortem JSONL path (with
+    ``{rank}``/``.rankN`` expansion applied by the recorder). None/blank
+    disables — and, via ``metrics.on()``, setting it implicitly enables
+    telemetry."""
+    val = (os.environ.get("HOROVOD_FLIGHT_RECORDER") or "").strip()
+    return val or None
+
+
+def restart_epoch() -> int:
+    """``HOROVOD_RESTART_EPOCH``: supervision attempt number, bumped by
+    ``horovodrun --max-restarts`` per relaunch. 0 on the first launch,
+    outside the launcher, and for garbage values (a malformed relaunch
+    env must look like a fresh start, not crash resume logic)."""
+    try:
+        return max(0, int(os.environ.get("HOROVOD_RESTART_EPOCH", "0")))
+    except ValueError:
+        return 0
+
+
+def tensorflow_custom_op_enabled() -> bool:
+    """``HOROVOD_TENSORFLOW_CUSTOM_OP``: opt-out knob for the native TF
+    custom-op data path. Historical semantics kept exactly: only the
+    explicit negatives disable; unset and even empty mean enabled (NOT
+    the ``_env_bool`` convention — existing launch scripts rely on
+    it)."""
+    return os.environ.get("HOROVOD_TENSORFLOW_CUSTOM_OP", "1") \
+        .strip().lower() not in ("0", "false", "no", "off")
+
+
+def log_level_name() -> str:
+    """``HOROVOD_LOG_LEVEL`` lowercased, defaulting to "warning" — the
+    one parser for both the early logging bootstrap
+    (``hvd_logging.configure``) and ``Config.from_env``."""
+    return os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
+
+
+def fault_plan_raw() -> Optional[str]:
+    """``HOROVOD_FAULT_PLAN``: inline JSON or ``@file`` reference for the
+    deterministic fault-injection plan; None/blank disables."""
+    val = os.environ.get("HOROVOD_FAULT_PLAN")
+    if not val or not val.strip():
+        return None
+    return val
+
+
 def _env_bool(name: str, default: bool = False) -> bool:
     val = os.environ.get(name)
     if val is None:

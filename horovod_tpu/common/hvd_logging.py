@@ -10,7 +10,6 @@ knows its rank.
 from __future__ import annotations
 
 import logging
-import os
 import sys
 
 TRACE = 5  # below DEBUG, matches reference LogLevel::TRACE (logging.h:8)
@@ -33,7 +32,9 @@ _rank_prefix = ""
 def configure(level_name: str | None = None, hide_time: bool | None = None) -> None:
     global _configured
     if level_name is None:
-        level_name = os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
+        from .config import log_level_name
+
+        level_name = log_level_name()
     if hide_time is None:
         from .config import _env_bool
 

@@ -30,6 +30,8 @@ import contextlib
 import os
 from typing import Iterator, Optional
 
+from .config import env_str
+
 import jax
 
 __all__ = ["trace", "start_trace", "stop_trace", "annotate", "step",
@@ -57,7 +59,7 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
     way); with neither set, the block runs unprofiled — safe to leave in
     production code. Remember to block on the last output: dispatch is
     async and an un-synced trace records only enqueues."""
-    log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV)
+    log_dir = log_dir or env_str(PROFILE_DIR_ENV)
     if not log_dir:
         yield
         return
@@ -68,7 +70,7 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
 
 def start_trace(log_dir: Optional[str] = None) -> None:
     """Non-context form of :func:`trace` (pair with :func:`stop_trace`)."""
-    log_dir = log_dir or os.environ.get(PROFILE_DIR_ENV)
+    log_dir = log_dir or env_str(PROFILE_DIR_ENV)
     if not log_dir:
         raise ValueError(
             f"start_trace: pass log_dir or set ${PROFILE_DIR_ENV}")

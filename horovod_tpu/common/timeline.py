@@ -23,6 +23,7 @@ from typing import Dict, Optional
 
 from . import hvd_logging as logging
 from .. import metrics
+from ..analysis.lockorder import make_lock
 
 # Activity vocabulary (reference common/common.h:30-51, with the CUDA/MPI
 # entries replaced by their TPU analogues).
@@ -71,7 +72,7 @@ class Timeline:
         self.mark_cycles = mark_cycles
         self._queue: "queue.Queue" = queue.Queue(maxsize=1 << 20)
         self._pids: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("timeline.pids")
         self._start = time.monotonic()
         self._file = open(filename, "w")
         self._file.write("[\n")
@@ -84,6 +85,7 @@ class Timeline:
 
         self._file.write(json.dumps({
             "name": "clock_sync", "ph": "M", "pid": 0,
+            # hvdlint: disable=HVD004 (the wall anchor IS the point)
             "args": {"wall_anchor": time.time(),
                      "monotonic_origin": self._start,
                      "rank": env_rank()},
@@ -92,7 +94,7 @@ class Timeline:
         # Own lock, NOT self._lock: _tensor_pid emits while holding
         # self._lock, so an overflow inside that call must not re-acquire
         # it (non-reentrant -> self-deadlock).
-        self._drop_lock = threading.Lock()
+        self._drop_lock = make_lock("timeline.drops")
         self._writer = threading.Thread(
             target=self._writer_loop, name="hvd-timeline-writer", daemon=True
         )

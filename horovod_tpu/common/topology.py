@@ -26,13 +26,14 @@ topology alongside the process-level rank/size.
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Optional, Sequence, Tuple
+
+from .config import env_str
 
 
 def _first_env_int(names: Sequence[str]) -> Optional[int]:
     for name in names:
-        val = os.environ.get(name)
+        val = env_str(name)
         if val is not None and val.strip():
             try:
                 return int(val)

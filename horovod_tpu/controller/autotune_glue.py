@@ -26,7 +26,8 @@ _FIXING_ENV = {
 def make_parameter_manager(config: Config,
                            tune_hierarchical: bool = False,
                            tune_cache: bool = False) -> ParameterManager:
-    fixed = {knob for knob, env in _FIXING_ENV.items() if env in os.environ}
+    fixed = {knob for knob, env in sorted(_FIXING_ENV.items())
+             if env in os.environ}
     if not tune_hierarchical:
         # No two-level rings in this job: the hierarchical knobs have no
         # data plane to switch to — pin them at their config values (the

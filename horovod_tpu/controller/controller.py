@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..analysis.lockorder import make_lock
+from ..common import config as config_mod
 from ..common import hvd_logging as logging
 from ..common import timeline as tl
 from ..common.config import Config, ring_data_plane_enabled
@@ -142,7 +144,11 @@ class Controller:
         self.topo = topology
         self.timeline = timeline
         self.handles = HandleManager()
-        self._lock = threading.Lock()
+        # Guards the queue/table/cache state; reached from user threads
+        # (enqueue), the controller thread, and teardown. Tracked under
+        # HOROVOD_LOCKCHECK so its ordering against the wire send lock
+        # and the metrics locks is recorded.
+        self._lock = make_lock("controller.state")
         self._queue: List[str] = []           # names awaiting negotiation
         self._table: Dict[str, _Pending] = {}  # name -> entry
         self._bit_pending: Dict[int, str] = {}  # cache bit -> name (hits)
@@ -172,7 +178,7 @@ class Controller:
         # Init failure is fatal, not a fallback: path selection must be
         # identical on every rank or the lockstep data phases deadlock.
         self._ring = None
-        ring_addrs = os.environ.get("HOROVOD_RING_ADDRS")
+        ring_addrs = config_mod.ring_addrs()
         if topology.size > 1 and ring_data_plane_enabled():
             from ..common.wire import job_secret
             from ..core.bindings import RingBackend
@@ -199,13 +205,13 @@ class Controller:
         if ((config.hierarchical_allreduce or config.hierarchical_allgather
              or config.autotune)
                 and topology.local_size > 1 and topology.cross_size > 1
-                and os.environ.get("HOROVOD_CPU_OPS", "ring") != "star"):
+                and config_mod.cpu_ops() != "star"):
             # HOROVOD_CPU_OPS=star is the operator's native-ring escape
             # hatch; it must disable the hierarchical rings too. Autotune
             # builds the rings even when the flag starts off so the
             # categorical search can explore the two-level path.
-            local_addrs = os.environ.get("HOROVOD_LOCAL_RING_ADDRS")
-            cross_addrs = os.environ.get("HOROVOD_CROSS_RING_ADDRS")
+            local_addrs = config_mod.local_ring_addrs()
+            cross_addrs = config_mod.cross_ring_addrs()
             if local_addrs and cross_addrs:  # both or neither: the path
                 # choice must be identical on every rank or the data phases
                 # deadlock.
@@ -226,7 +232,13 @@ class Controller:
                 config, tune_hierarchical=self._local_ring is not None,
                 tune_cache=True)
 
-        addr = os.environ["HOROVOD_CONTROLLER_ADDR"]
+        addr = config_mod.controller_addr()
+        if addr is None:
+            # Was a bare KeyError; the curated message survives the move
+            # to the config accessor (HVD003).
+            raise RuntimeError(
+                "HOROVOD_CONTROLLER_ADDR is not set; the Python controller "
+                "requires the horovodrun-exported TCP star endpoint")
         if topology.rank == 0:
             self._service = CoordinatorService(
                 addr, topology.size,
@@ -275,7 +287,7 @@ class Controller:
                     "record no spans", config.trace_dir, exc, topology.rank)
             if topology.rank == 0:
                 self._clock = ClockSync(topology.size)
-                for worker_rank, wire in self._service.wires.items():
+                for worker_rank, wire in sorted(self._service.wires.items()):
                     wire.set_clock_callback(
                         lambda t0, wall, t1, _r=worker_rank:
                         self._clock.observe(_r, t0, wall, t1))
@@ -618,7 +630,7 @@ class Controller:
                 # Offset refresh: a dense burst while the job warms up
                 # (short jobs still get synced), then periodic. Pongs are
                 # consumed whenever the coordinator next drains frames.
-                for wire in self._service.wires.values():
+                for _, wire in sorted(self._service.wires.items()):
                     wire.send_clock_ping()
             self._stamp_sent(tick)  # rank 0's "send" is the local build
             t0 = time.monotonic()
@@ -664,12 +676,17 @@ class Controller:
                 if snap:
                     metrics.ingest_remote(rank, snap)
 
-        shutdown = any(t["requests"].shutdown for t in ticks.values())
+        # One sorted() walk shared by the reductions: the controller
+        # package bans raw dict iteration wholesale (HVD002) — cheaper
+        # to comply once than to argue each site is commutative, and
+        # this runs every cycle (HOROVOD_CYCLE_TIME can be 1 ms).
+        rank_order_ticks = [t for _, t in sorted(ticks.items())]
+        shutdown = any(t["requests"].shutdown for t in rank_order_ticks)
         invalid_mask = 0
-        for t in ticks.values():
+        for t in rank_order_ticks:
             invalid_mask |= t["invalid_mask"]
         and_mask = ticks[0]["cache_mask"]
-        for t in ticks.values():
+        for t in rank_order_ticks:
             and_mask &= t["cache_mask"]
         and_mask &= ~invalid_mask
         bypass_bits = ResponseCache.mask_to_bits(and_mask)
@@ -764,7 +781,7 @@ class Controller:
         """Reference ``CheckForStalledTensors`` (operations.cc:688-769)."""
         if self.cfg.stall_check_disable:
             return
-        for name, first in list(self._first_seen.items()):
+        for name, first in sorted(self._first_seen.items()):
             age = now - first
             if age > self.cfg.stall_check_seconds:
                 last = self._stall_warned.get(name, 0.0)
@@ -858,7 +875,11 @@ class Controller:
             # strand forever now that ticks stop advertising bits:
             # renegotiate them as ordinary requests.
             with self._lock:
-                self._queue.extend(self._bit_pending.values())
+                # Sorted by cache bit: the renegotiation order these
+                # stranded tensors re-enter the queue in must not depend
+                # on per-rank insertion history.
+                self._queue.extend(
+                    name for _, name in sorted(self._bit_pending.items()))
                 self._bit_pending.clear()
 
         rlist: ResponseList = reply["responses"]
@@ -922,7 +943,7 @@ class Controller:
                     break  # lockstep broken: stop collecting
             if self._tracer is not None:
                 self._tracer.close()
-            for worker_rank, blob in blobs.items():
+            for worker_rank, blob in sorted(blobs.items()):
                 if blob:
                     with open(trace_mod.rank_trace_path(
                             trace_dir, worker_rank), "wb") as f:
@@ -944,7 +965,9 @@ class Controller:
         with self._lock:
             if self._failure is None and not isinstance(exc, ShutdownError):
                 self._failure = exc
-            entries = list(self._table.values())
+            # Sorted by tensor name so failure callbacks fire in the same
+            # order on every rank (callbacks may issue follow-up work).
+            entries = [self._table[n] for n in sorted(self._table)]
             self._table.clear()
             self._queue.clear()
             self._bit_pending.clear()
@@ -1058,6 +1081,9 @@ class Controller:
             result = np.array(buf, copy=True)
             self._local_ring.allreduce_(result, average=False)
             if self.topo.local_rank == 0:
+                # The cross ring's membership IS the local roots — the
+                # rank-conditional matches the subgroup exactly, so this
+                # cannot diverge. hvdlint: disable=HVD001
                 self._cross_ring.allreduce_(result, average=False)
             self._local_ring.broadcast_(result, 0)
         elif self._use_ring(dtype):
@@ -1147,6 +1173,8 @@ class Controller:
                 group_counts = [
                     sum(s * rest_elems for s in sizes[g * ls:(g + 1) * ls])
                     for g in range(self.topo.cross_size)]
+                # Cross-ring members are exactly the local roots (see
+                # allreduce above). hvdlint: disable=HVD001
                 flat = self._cross_ring.allgather(local_flat, group_counts)
             else:
                 flat = np.empty(total, dtype=dtype)

@@ -173,7 +173,9 @@ class NativeController:
         self._pinned: Dict[int, np.ndarray] = {}
         self._shut = False
 
-        ring_addrs = os.environ.get("HOROVOD_RING_ADDRS", "")
+        from ..common.config import ring_addrs as _ring_addrs
+
+        ring_addrs = _ring_addrs() or ""
         if topology.size > 1 and not ring_addrs:
             raise RuntimeError(
                 "native engine requires HOROVOD_RING_ADDRS (exported by "

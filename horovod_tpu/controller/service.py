@@ -159,7 +159,7 @@ class CoordinatorService(_HeartbeatMixin):
             conn.settimeout(None)
             self.wires[rank] = wire
             logging.debug("coordinator: rank %d connected", rank)
-        for wire in self.wires.values():
+        for _, wire in sorted(self.wires.items()):
             wire.set_deadline(comm_timeout)
 
     def recv_from(self, rank: int) -> Any:
@@ -205,11 +205,11 @@ class CoordinatorService(_HeartbeatMixin):
                 pass  # that worker is dying too; nothing more to do
 
     def _hb_wires(self):
-        return list(self.wires.values())
+        return [self.wires[r] for r in sorted(self.wires)]
 
     def close(self) -> None:
         self.stop_heartbeats()
-        for wire in self.wires.values():
+        for _, wire in sorted(self.wires.items()):
             wire.close()
         self._listener.close()
 

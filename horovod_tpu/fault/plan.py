@@ -128,14 +128,14 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
-        raw = os.environ.get("HOROVOD_FAULT_PLAN")
-        if not raw or not raw.strip():
+        from ..common.config import env_rank, fault_plan_raw
+
+        raw = fault_plan_raw()
+        if raw is None:
             return None
         if raw.startswith("@"):
             with open(raw[1:]) as f:
                 raw = f.read()
-        from ..common.config import env_rank
-
         return cls.from_json(raw, rank=env_rank())
 
     def count(self, site: str) -> int:

@@ -29,6 +29,7 @@ import threading
 from typing import Dict, Optional
 
 from ..common.config import _env_bool, _env_int, env_rank
+from ..common.config import flight_recorder_path as _flight_recorder_path
 from .exporter import MetricsExporter, start_exporter  # noqa: F401
 from .recorder import FlightRecorder, expand_rank_path
 from .registry import (  # noqa: F401
@@ -57,7 +58,12 @@ __all__ = [
 # <1% controller-cycle overhead budget. Spawned ranks get a fresh module;
 # forked ranks re-resolve on their first hook after the fork callback.
 _on: Optional[bool] = None
-_lock = threading.Lock()
+# Tracked under HOROVOD_LOCKCHECK: this guards the enabled cache, the
+# remote-snapshot table, and recorder creation — all reached from the
+# controller, heartbeat, and exporter threads.
+from ..analysis.lockorder import make_lock  # noqa: E402
+
+_lock = make_lock("metrics.state")
 
 _registry = MetricsRegistry()
 _remote: Dict[int, Dict[str, dict]] = {}
@@ -90,8 +96,7 @@ def _resolve_on() -> bool:
             # port means no endpoint, hence no implicit enable either.
             _on = (_env_bool("HOROVOD_METRICS")
                    or _env_int("HOROVOD_METRICS_PORT", 0) > 0
-                   or bool((os.environ.get("HOROVOD_FLIGHT_RECORDER")
-                            or "").strip()))
+                   or _flight_recorder_path() is not None)
     return _on
 
 
@@ -216,7 +221,7 @@ def record_sampled_event(kind: str, **fields) -> None:
 
 
 def flight_recorder_path() -> Optional[str]:
-    return os.environ.get("HOROVOD_FLIGHT_RECORDER") or None
+    return _flight_recorder_path()
 
 
 def dump_flight_recorder(reason: str,

@@ -25,11 +25,11 @@ small dict per event, dropped from the left when the ring is full.
 from __future__ import annotations
 
 import json
-import threading
 import time
 from collections import deque
 from typing import Dict, Optional
 
+from ..analysis.lockorder import make_lock
 from ..common import hvd_logging as logging
 from ..common.config import _env_int, env_rank
 
@@ -73,10 +73,12 @@ class FlightRecorder:
         self.sample = sample
         self._events: deque = deque(maxlen=capacity)
         self._sample_counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.recorder")
         self._seq = 0
 
     def record(self, kind: str, **fields) -> None:
+        # Postmortem timestamps are wall-clock on purpose (they
+        # are read next to system logs). hvdlint: disable=HVD004
         event = {"ts": round(time.time(), 6), "kind": kind}
         if self.rank is not None:
             event["rank"] = self.rank
@@ -108,6 +110,7 @@ class FlightRecorder:
         try:
             events = self.events()
             header = {"kind": "flight_recorder_dump", "reason": reason,
+                      # hvdlint: disable=HVD004 (wall-clock stamp)
                       "ts": round(time.time(), 6), "events": len(events)}
             if self.rank is not None:
                 header["rank"] = self.rank

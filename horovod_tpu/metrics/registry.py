@@ -24,9 +24,10 @@ Design constraints, in order:
 
 from __future__ import annotations
 
-import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..analysis.lockorder import make_lock
 
 
 def log_buckets(start: float, factor: float, count: int) -> Tuple[float, ...]:
@@ -90,7 +91,9 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        # One lock-order node for every metric instance: ordering rules
+        # are stated per subsystem, not per series.
+        self._lock = make_lock("metrics.metric")
         self._children: Dict[Tuple[str, ...], object] = {}
         if not self.labelnames:
             # Unlabeled metric: one implicit child so inc()/observe() on
@@ -206,7 +209,7 @@ class MetricsRegistry:
     package asserting this statically too)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
         self._metrics: Dict[str, _Metric] = {}
 
     def _get_or_create(self, cls, name: str, help: str,

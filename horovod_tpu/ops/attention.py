@@ -23,7 +23,6 @@ saved per-row log-sum-exp instead of storing the S x S matrix. Set
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -522,7 +521,9 @@ def _flash_fwd_rule(q, k, v, maskf, causal, sm_scale, block_q, block_k,
 def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, has_mask,
                     res, g):
     q, k, v, maskf, out, lse = res
-    if os.environ.get("HOROVOD_FLASH_XLA_BWD"):
+    from ..common.config import flash_xla_bwd
+
+    if flash_xla_bwd():
         # Escape hatch: rematerialized backward through the XLA reference
         # path (materializes the S x S probs; O(S^2) memory). Read at trace
         # time — set it before the train step is first compiled; already-

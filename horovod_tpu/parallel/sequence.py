@@ -21,7 +21,6 @@ Both are shard_map-tier functions: call them inside
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -207,7 +206,9 @@ def _flash_block_pair_bwd(diag_causal, scale, res, cts):
     )
 
     q, maskf, k_blk, v_blk, out, lse = res
-    if os.environ.get("HOROVOD_FLASH_XLA_BWD"):
+    from ..common.config import flash_xla_bwd
+
+    if flash_xla_bwd():
         # Same escape hatch as flash_attention's backward: rematerialize
         # the (out, lse) pair densely and differentiate through XLA
         # (O(S_local^2) memory; trace-time switch).

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..common import config as config_mod
 from ..common.wire import make_secret
 from .. import metrics
 
@@ -130,6 +131,17 @@ _SSH_CACHE = os.path.expanduser("~/.horovod_tpu/ssh_preflight.json")
 _SSH_CACHE_TTL_S = 300.0
 
 
+def _boot_id() -> str:
+    """Scope for on-disk monotonic stamps: CLOCK_MONOTONIC is only
+    comparable within one boot, so the cache records which boot wrote it
+    and entries from any other boot are discarded wholesale."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        return "unknown-boot"
+
+
 def ssh_preflight(hosts: List[str], ssh_port: int = 22,
                   use_cache: bool = True, timeout: float = 10.0) -> None:
     """Verify passwordless ssh to every remote host before fanning out
@@ -139,13 +151,25 @@ def ssh_preflight(hosts: List[str], ssh_port: int = 22,
     import json
 
     cache: Dict[str, float] = {}
-    now = time.time()
+    # Monotonic, not wall clock: an NTP step mid-TTL would expire (or
+    # revive) entries spuriously. CLOCK_MONOTONIC is boot-relative and
+    # only comparable within one boot, so the file carries the writing
+    # boot's id and a mismatch discards it entirely (a pre-reboot stamp
+    # can otherwise look in-TTL once uptime catches up). The 0 <= age
+    # guard additionally drops stamps from the future within a boot.
+    now = time.monotonic()
+    boot = _boot_id()
     if use_cache:
         try:
             with open(_SSH_CACHE) as f:
-                cache = {h: t for h, t in json.load(f).items()
-                         if now - t < _SSH_CACHE_TTL_S}
-        except (OSError, ValueError):
+                data = json.load(f)
+            entries = (data.get("entries", {})
+                       if data.get("boot_id") == boot else {})
+            # Pre-boot_id cache files (a bare dict) hold wall-clock or
+            # foreign-boot stamps: treat as empty, it's a 5-minute cache.
+            cache = {h: t for h, t in entries.items()
+                     if 0 <= now - t < _SSH_CACHE_TTL_S}
+        except (OSError, ValueError, AttributeError):
             cache = {}
 
     # Cache key includes the port: success on 22 says nothing about 2222.
@@ -172,7 +196,10 @@ def ssh_preflight(hosts: List[str], ssh_port: int = 22,
             else:
                 failures[host] = msg
 
-    threads = [threading.Thread(target=check, args=(h,)) for h in to_check]
+    # daemon=False on purpose: the preflight's join IS the launch gate.
+    threads = [threading.Thread(target=check, args=(h,),
+                                name=f"hvd-ssh-preflight-{h}", daemon=False)
+               for h in to_check]
     for t in threads:
         t.start()
     for t in threads:
@@ -182,7 +209,7 @@ def ssh_preflight(hosts: List[str], ssh_port: int = 22,
         try:
             os.makedirs(os.path.dirname(_SSH_CACHE), exist_ok=True)
             with open(_SSH_CACHE, "w") as f:
-                json.dump(cache, f)
+                json.dump({"boot_id": boot, "entries": cache}, f)
         except OSError:
             pass
     if failures:
@@ -226,7 +253,8 @@ def discover_routable_addrs(hosts: List[str], ssh_port: int, secret: str,
                     except Exception as exc:  # checked by the poll loop
                         thread_errors.append(f"local probe {idx}: {exc}")
 
-                t = threading.Thread(target=_local_probe, daemon=True)
+                t = threading.Thread(target=_local_probe,
+                                     name=f"hvd-nic-probe-{i}", daemon=True)
                 t.start()
                 threads.append(t)
             else:
@@ -248,7 +276,8 @@ def discover_routable_addrs(hosts: List[str], ssh_port: int, secret: str,
                 # must not wedge on a full pipe mid-protocol.
                 buf: List[str] = []
                 threading.Thread(target=lambda p=p, b=buf: b.extend(
-                    iter(p.stderr.readline, "")), daemon=True).start()
+                    iter(p.stderr.readline, "")),
+                    name=f"hvd-nic-stderr-{host}", daemon=True).start()
                 procs.append((host, p, buf))
         # Poll instead of blocking: a probe that dies instantly (no remote
         # python3, auth failure) should fail the discovery now, with its
@@ -399,18 +428,18 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
                 "horovodrun: --trace selects the python controller engine "
                 "(HOROVOD_ENGINE=python) — spans are emitted there; set "
                 "HOROVOD_ENGINE explicitly to override\n")
-        elif args.spmd or os.environ.get("HOROVOD_ENGINE") != "python":
+        elif args.spmd or config_mod.engine() != "python":
             # Say so NOW, not via an empty directory at exit: only the
             # python controller emits spans.
             sys.stderr.write(
                 "horovodrun: WARNING --trace has no span source under "
                 + ("--spmd" if args.spmd
-                   else f"HOROVOD_ENGINE={os.environ['HOROVOD_ENGINE']}")
+                   else f"HOROVOD_ENGINE={config_mod.engine()}")
                 + " — collective spans come from the python controller "
                 "engine; expect no trace.rank*.json files "
                 "(docs/tracing.md)\n")
     size = args.np
-    secret = os.environ.get("HOROVOD_SECRET_KEY") or make_secret()
+    secret = config_mod.secret_key_hex() or make_secret()
     coord_host = hosts[0][0]
     any_remote_host = any(not _is_local(h) for h, _ in hosts)
     host_ip: Dict[str, str] = {}
@@ -460,7 +489,7 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
     # (common/basics.py). Print the resolved URLs so operators never
     # compute the port offset by hand; rank 0's endpoint additionally
     # aggregates every worker's piggybacked snapshot (rank-labeled).
-    metrics_base = os.environ.get("HOROVOD_METRICS_PORT")
+    metrics_base = config_mod.env_str("HOROVOD_METRICS_PORT")
     if metrics_base:
         try:
             base_port = int(metrics_base)
@@ -497,8 +526,7 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
             else:
                 ring_addrs.append(
                     f"{_public_host(host)}:{_derived_port(ring_base, r)}")
-        ring_addrs_env = os.environ.get("HOROVOD_RING_ADDRS",
-                                        ",".join(ring_addrs))
+        ring_addrs_env = config_mod.ring_addrs() or ",".join(ring_addrs)
 
     # Per-group ring addresses for the two-level (hierarchical) data plane
     # (HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER): one ring inside each host
@@ -588,7 +616,8 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
         procs.append(proc)
         t = threading.Thread(
             target=_stream, args=(f"[{rank}]: " if size > 1 else "",
-                                  proc.stdout, sys.stdout), daemon=True)
+                                  proc.stdout, sys.stdout),
+            name=f"hvd-rank-stream-{rank}", daemon=True)
         t.start()
         threads.append(t)
 

@@ -49,6 +49,7 @@ class NICDriverService:
         self._srv = socket.create_server(("0.0.0.0", 0))
         self.port = self._srv.getsockname()[1]
         self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               name="hvd-nic-accept",
                                                daemon=True)
         self._accept_thread.start()
 
@@ -59,7 +60,7 @@ class NICDriverService:
             except OSError:
                 return
             threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+                             name="hvd-nic-serve", daemon=True).start()
 
     def _serve(self, conn: socket.socket) -> None:
         wire = Wire(conn)

@@ -36,7 +36,9 @@ _FRAME_HEARTBEAT = 1
 
 
 def _secret() -> bytes:
-    key = os.environ.get("HOROVOD_SECRET_KEY")
+    # Standalone by contract (ssh-piped, no package on the remote host):
+    # the one env read that CANNOT route through common/config.py.
+    key = os.environ.get("HOROVOD_SECRET_KEY")  # hvdlint: disable=HVD003
     if key:
         return bytes.fromhex(key)
     return b"horovod-tpu-default-insecure-key"  # wire.job_secret default
@@ -136,7 +138,8 @@ def _dial_driver(driver_addr: str) -> socket.socket:
             done.set()
 
     for cand in candidates:
-        threading.Thread(target=_try, args=(cand,), daemon=True).start()
+        threading.Thread(target=_try, args=(cand,),
+                         name=f"hvd-probe-dial-{cand}", daemon=True).start()
     done.wait(PROBE_TIMEOUT + 2.0)
     with lock:
         if not winner:
@@ -166,7 +169,8 @@ def run_probe_task(index: int, driver_addr: str,
             except OSError:
                 return
 
-    threading.Thread(target=_absorb, daemon=True).start()
+    threading.Thread(target=_absorb, name="hvd-probe-absorb",
+                     daemon=True).start()
 
     sock = _dial_driver(driver_addr)
     # Protocol waits are driver-paced (replies arrive only after every host
@@ -195,7 +199,9 @@ def run_probe_task(index: int, driver_addr: str,
             except OSError:
                 pass
 
-        probes = [threading.Thread(target=_try, args=tuple(a))
+        # daemon=False on purpose: the join below IS the probe barrier.
+        probes = [threading.Thread(target=_try, args=tuple(a),
+                                   name=f"hvd-probe-{a[1]}", daemon=False)
                   for a in ans["next_addrs"]]
         for t in probes:
             t.start()

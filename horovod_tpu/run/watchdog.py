@@ -73,9 +73,12 @@ def install(poll_interval: float = _POLL_INTERVAL_S,
         _set_pdeathsig(signal.SIGTERM)
 
         def _watch():
+            from ..common.config import env_rank
+
             while True:
                 time.sleep(poll_interval)
                 if os.getppid() != parent:
+                    rank = env_rank()
                     try:
                         # Best-effort: stderr may BE a pipe to the dead
                         # parent — a BrokenPipeError here must not stop
@@ -83,7 +86,7 @@ def install(poll_interval: float = _POLL_INTERVAL_S,
                         sys.stderr.write(
                             f"horovod_tpu: parent {parent} died; "
                             "terminating orphaned rank "
-                            f"{os.environ.get('HOROVOD_RANK', '?')}\n")
+                            f"{'?' if rank is None else rank}\n")
                         sys.stderr.flush()
                     except Exception:
                         pass

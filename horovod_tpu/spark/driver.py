@@ -79,7 +79,8 @@ class SparkDriverService:
         self._listener.listen(num_proc)
         self._listener.settimeout(timeout)
         self.port = self._listener.getsockname()[1]
-        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread = threading.Thread(target=self._serve,
+                                        name="hvd-spark-driver", daemon=True)
         self._error = None
         self._thread.start()
 

@@ -87,11 +87,10 @@ def _custom_ops():
 
 
 def _custom_ops_vote(ctrl):
-    import os
+    from ..common.config import tensorflow_custom_op_enabled
 
     local_ok = True
-    if os.environ.get("HOROVOD_TENSORFLOW_CUSTOM_OP", "1").strip().lower() in (
-            "0", "false", "no", "off"):
+    if not tensorflow_custom_op_enabled():
         local_ok = False
     else:
         from ..controller.native import NativeController

@@ -2,4 +2,7 @@
 
 * ``straggler`` — merge a trace directory's per-rank files (if needed)
   and print/write the straggler-attribution report (docs/tracing.md).
+* ``lint`` — hvdlint: the AST-based distributed-correctness analyzer
+  over the package source (rules HVD001..HVD007, suppressions,
+  baseline; docs/static-analysis.md).
 """

@@ -1,0 +1,103 @@
+"""``python -m horovod_tpu.tools.lint`` — hvdlint CLI.
+
+Runs the AST-based distributed-correctness analyzer
+(``horovod_tpu/analysis``) over the package (or any paths given) and
+reports findings as text or JSON. Exit code 1 on any non-baselined
+finding or parse error, 0 when clean — the same contract the tier-1
+gate (``tests/test_lint.py``) enforces.
+
+Workflows (docs/static-analysis.md):
+
+* ``python -m horovod_tpu.tools.lint`` — lint the installed package
+  against the checked-in baseline.
+* ``... --format json`` — machine-readable report (CI annotations).
+* ``... --select HVD003,HVD004`` — run a subset of rules.
+* ``... --write-baseline`` — grandfather today's findings; the gate
+  then fails only on NEW ones. Shrink the baseline, never grow it.
+* ``... --list-rules`` — the rule catalog with one-line rationales.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from ..analysis import (
+    ALL_RULES,
+    load_baseline,
+    render_json,
+    render_text,
+    run_lint,
+    write_baseline,
+)
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_DIR = os.path.dirname(_PKG_DIR)
+DEFAULT_BASELINE = os.path.join(_REPO_DIR, ".hvdlint-baseline.json")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.tools.lint",
+        description="hvdlint: AST-based distributed-correctness analyzer "
+                    "for horovod_tpu (docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/directories to lint (default: the "
+                             "horovod_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline file of grandfathered findings "
+                             f"(default: {DEFAULT_BASELINE}); 'none' "
+                             "disables")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="record the current findings as the new "
+                             "baseline and exit 0")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule codes to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    parser.add_argument("--verbose", action="store_true",
+                        help="also list baselined findings (text format)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in ALL_RULES:
+            print(f"{cls.code} [{cls.name}]: {cls.description}")
+        return 0
+
+    paths = args.paths or [_PKG_DIR]
+    select = ([c.strip() for c in args.select.split(",") if c.strip()]
+              if args.select else None)
+    if args.write_baseline and (select or args.paths) \
+            and os.path.abspath(args.baseline) == DEFAULT_BASELINE:
+        # The default baseline is a whole-package artifact: rewriting it
+        # from a partial scan (rule subset or sub-paths) would silently
+        # delete every grandfathered entry outside the scan's scope.
+        # Scoped baselines are fine — into an explicitly named file.
+        parser.error("--write-baseline on the default baseline requires a "
+                     "full default scan (no --select, no explicit paths); "
+                     "pass --baseline <file> to write a scoped one")
+    baseline = None
+    if args.baseline and args.baseline.lower() != "none" \
+            and not args.write_baseline:
+        baseline = load_baseline(args.baseline)
+    # Paths are reported relative to the repo (parent of the package) so
+    # baselines are stable across checkouts.
+    result = run_lint(paths, baseline=baseline, root=_REPO_DIR,
+                      select=select)
+
+    if args.write_baseline:
+        out = write_baseline(args.baseline, result.findings)
+        print(f"hvdlint: wrote {len(result.findings)} finding(s) to {out}")
+        return 0
+
+    sys.stdout.write(render_json(result) if args.format == "json"
+                     else render_text(result, verbose=args.verbose))
+    return 1 if (result.findings or result.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,8 @@ import json
 import os
 import threading
 import time
+
+from ..analysis.lockorder import make_lock
 from collections import deque
 from typing import Dict, Optional
 
@@ -47,7 +49,7 @@ class ClockSync:
         self.size = size
         self._window = max(1, window)
         self._samples: Dict[int, deque] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace.clock")
 
     def observe(self, rank: int, t0: float, peer_wall: float,
                 t1: Optional[float] = None) -> None:
@@ -55,7 +57,7 @@ class ClockSync:
         answered with ``peer_wall`` (worker clock), received at ``t1``
         (our clock, default now)."""
         if t1 is None:
-            t1 = time.time()
+            t1 = time.time()  # hvdlint: disable=HVD004 (wall protocol)
         rtt = t1 - t0
         if rtt < 0:  # our own clock stepped mid-exchange: unusable
             return

@@ -31,6 +31,7 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis.lockorder import make_lock
 from ..common.config import _env_int
 
 # The fixed phase vocabulary: one chrome "thread" per phase per rank.
@@ -58,10 +59,10 @@ class TraceWriter:
         self._path = path
         self.rank = int(rank)
         self._mono0 = time.monotonic()
-        self._wall0 = time.time()
+        self._wall0 = time.time()  # hvdlint: disable=HVD004 (anchor)
         self._max = max_events if max_events is not None else max(
             1024, _env_int("HOROVOD_TRACE_MAX_EVENTS", DEFAULT_MAX_EVENTS))
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace.writer")
         self._events: list = []
         self._dropped = 0
         self._closed = False

@@ -58,11 +58,12 @@ def restart_epoch() -> int:
     """Supervision attempt number (``horovodrun --max-restarts`` bumps
     ``HOROVOD_RESTART_EPOCH`` on every relaunch; 0 on the first launch and
     outside the launcher). Training scripts branch on this to resume from
-    the latest checkpoint instead of reinitializing."""
-    try:
-        return max(0, int(os.environ.get("HOROVOD_RESTART_EPOCH", "0")))
-    except ValueError:
-        return 0
+    the latest checkpoint instead of reinitializing. The parsing lives in
+    ``common/config.restart_epoch`` (HVD003: one parser per knob); this
+    remains the public API."""
+    from ..common import config
+
+    return config.restart_epoch()
 
 
 def restore_latest(directory: str, like: Optional[Any] = None,

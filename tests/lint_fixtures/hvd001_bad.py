@@ -1,0 +1,12 @@
+"""HVD001 must fire: collective inside a rank-conditional branch."""
+import horovod_tpu as hvd
+
+
+def train(x):
+    if hvd.rank() == 0:
+        hvd.allreduce(x, name="oops")      # only rank 0 enqueues: deadlock
+    if hvd.local_rank() != 0:
+        out = hvd.broadcast(x, root_rank=0)
+    else:
+        out = x
+    return out

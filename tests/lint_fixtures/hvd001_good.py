@@ -1,0 +1,10 @@
+"""HVD001 must stay silent: collectives on every rank; rank branches do
+rank-local work only."""
+import horovod_tpu as hvd
+
+
+def train(x, log):
+    out = hvd.allreduce(x, name="grad")    # every rank reaches this
+    if hvd.rank() == 0:
+        log("step done", out.shape)        # rank-local side effect: fine
+    return out

@@ -1,0 +1,10 @@
+"""HVD002 must fire (linted under a controller/ relpath): raw dict walks
+feeding wire sends."""
+
+
+def coordinate(ticks, wire):
+    for rank, tick in ticks.items():       # insertion order != rank order
+        wire.send((rank, tick))
+    payload = [t for t in ticks.values()]
+    names = list(ticks.keys())
+    return payload, names

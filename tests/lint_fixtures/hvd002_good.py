@@ -1,0 +1,9 @@
+"""HVD002 must stay silent: every walk is sorted()."""
+
+
+def coordinate(ticks, wire):
+    for rank, tick in sorted(ticks.items()):
+        wire.send((rank, tick))
+    payload = [t for _, t in sorted(ticks.items())]
+    names = sorted(ticks)                  # iterating the dict itself: keys
+    return payload, names

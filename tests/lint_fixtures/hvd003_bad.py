@@ -1,0 +1,10 @@
+"""HVD003 must fire: direct env value reads outside common/config.py."""
+import os
+
+
+def knob():
+    return os.environ.get("HOROVOD_THING", "1")
+
+
+def other():
+    return os.environ["HOROVOD_OTHER"] + os.getenv("HOROVOD_THIRD", "")

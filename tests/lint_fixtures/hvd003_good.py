@@ -1,0 +1,12 @@
+"""HVD003 must stay silent: membership tests, writes, and full-copy
+exports are the launcher's legitimate business."""
+import os
+
+
+def export():
+    child_env = dict(os.environ)
+    child_env["X"] = "1"
+    os.environ["HOROVOD_EXPORTED"] = "1"
+    os.environ.setdefault("HOROVOD_DEFAULTED", "0")
+    os.environ.pop("HOROVOD_SCRUBBED", None)
+    return "HOROVOD_FLAG" in os.environ, child_env

@@ -1,0 +1,10 @@
+"""HVD004 must fire: wall clock in deadline/duration math."""
+import time
+
+
+def wait_until(check, timeout):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if check():
+            return True
+    return False

@@ -1,0 +1,9 @@
+"""HVD005 must fire: anonymous threads / implicit daemon-ness."""
+import threading
+
+
+def spawn(fn):
+    threading.Thread(target=fn).start()
+    t = threading.Thread(target=fn, daemon=True)   # still nameless
+    t.start()
+    return t

@@ -1,0 +1,10 @@
+"""HVD005 must stay silent: every thread named, daemon-ness explicit."""
+import threading
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn, name="hvd-worker", daemon=True)
+    t.start()
+    u = threading.Thread(target=fn, name="hvd-joiner", daemon=False)
+    u.start()
+    return t, u

@@ -1,0 +1,13 @@
+"""HVD006 must fire: registration/env-read/thread-spawn at import time."""
+import os
+import threading
+
+from horovod_tpu import metrics
+
+FLAG = os.environ.get("HOROVOD_FROZEN_AT_IMPORT")
+_C = metrics.counter("hvd_eager_total", "registered while importing")
+threading.Thread(target=print, name="hvd-import", daemon=True)
+
+
+def fine():
+    return FLAG

@@ -1,0 +1,11 @@
+"""HVD006 must stay silent: everything side-effecting is lazy."""
+from horovod_tpu import metrics
+
+_m = None
+
+
+def _lazy_metrics():
+    global _m
+    if _m is None:
+        _m = metrics.counter("hvd_lazy_total", "registered on first use")
+    return _m

@@ -1,0 +1,18 @@
+"""HVD007 must fire: bad name, bad case, and a duplicated owner."""
+from horovod_tpu import metrics
+
+
+def a():
+    return metrics.counter("requests_total", "missing the hvd_ prefix")
+
+
+def b():
+    return metrics.gauge("hvd_CamelCase", "not snake_case")
+
+
+def c():
+    return metrics.histogram("hvd_dup_seconds", "first owner")
+
+
+def d():
+    return metrics.histogram("hvd_dup_seconds", "second owner: duplicate")

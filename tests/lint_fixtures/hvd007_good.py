@@ -1,0 +1,10 @@
+"""HVD007 must stay silent: conforming, single-owner names."""
+from horovod_tpu import metrics
+
+
+def a():
+    return metrics.counter("hvd_requests_total", "fine")
+
+
+def b():
+    return metrics.histogram("hvd_latency_seconds", "fine too")

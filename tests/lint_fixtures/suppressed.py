@@ -1,0 +1,16 @@
+"""Every line here would fire a rule; every line carries a pragma.
+tests/test_lint.py asserts zero findings — the suppression contract."""
+import os
+import time
+
+
+def anchored():
+    t = time.time()  # hvdlint: disable=HVD004 trace wall anchor
+    # hvdlint: disable=HVD003 (standalone script, no package available)
+    raw = os.environ.get("HOROVOD_RAW")
+    return t, raw
+
+
+def everything():
+    # hvdlint: disable=all
+    return os.environ.get("HOROVOD_ALL"), time.time()

@@ -1,0 +1,450 @@
+"""hvdlint + lockorder: the static-analysis tier-1 gate.
+
+Four layers (docs/static-analysis.md):
+
+1. **The gate** — the whole ``horovod_tpu`` package lints clean against
+   the checked-in baseline (``.hvdlint-baseline.json``, ≤ 10 entries).
+   Any NEW finding fails tier-1, which is what keeps the rounds-7..9
+   fault-tolerance/tracing invariants true as the codebase grows.
+2. **Rule proofs** — per-rule bad/good fixtures under
+   ``tests/lint_fixtures/``: every rule demonstrably fires on its bad
+   snippet and stays silent on the good one.
+3. **Framework contracts** — suppression pragmas, baseline round-trip,
+   reporters, CLI exit codes.
+4. **Lock-order detector** — a seeded A->B/B->A inversion must be
+   reported as a cycle with both acquisition stacks; a real 3-rank run
+   under ``HOROVOD_LOCKCHECK=1`` must produce valid, acyclic
+   ``lockgraph.json`` artifacts with real edges on the coordinator.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from horovod_tpu.analysis import (
+    baseline_key,
+    get_rule,
+    lint_source,
+    load_baseline,
+    render_json,
+    render_text,
+    run_lint,
+    write_baseline,
+)
+from horovod_tpu.analysis.lockorder import LockGraph, TrackedLock, make_lock
+from horovod_tpu.analysis.rules import ALL_RULES
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "horovod_tpu")
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+BASELINE = os.path.join(REPO, ".hvdlint-baseline.json")
+MAX_BASELINE_ENTRIES = 10
+
+
+def _fixture(name: str) -> str:
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+# ---------------------------------------------------------------------------
+# 1. The gate
+
+
+def test_package_lints_clean_against_baseline():
+    """THE tier-1 gate: zero non-baselined findings over the package."""
+    baseline = load_baseline(BASELINE)
+    assert len(baseline) <= MAX_BASELINE_ENTRIES, (
+        f"baseline grew to {len(baseline)} entries (max "
+        f"{MAX_BASELINE_ENTRIES}); fix findings instead of grandfathering "
+        "them")
+    result = run_lint([PKG], root=REPO, baseline=baseline)
+    assert not result.parse_errors, result.parse_errors
+    assert result.files_scanned > 50, "package scan looks truncated"
+    assert not result.findings, (
+        "NEW hvdlint findings (fix them, add a justified inline "
+        "suppression, or — last resort — baseline them):\n"
+        + "\n".join(f.render() for f in result.findings))
+
+
+def test_baseline_entries_still_exist():
+    """A baseline entry whose finding no longer fires is stale — shrink
+    the file (the workflow's ratchet direction)."""
+    baseline = load_baseline(BASELINE)
+    result = run_lint([PKG], root=REPO, baseline=baseline)
+    live = {baseline_key(f.as_dict()) for f in result.baselined}
+    stale = [e for e in baseline if baseline_key(e) not in live]
+    assert not stale, f"stale baseline entries (remove them): {stale}"
+
+
+# ---------------------------------------------------------------------------
+# 2. Per-rule fixture proofs
+
+
+_RELPATHS = {"HVD002": "horovod_tpu/controller/_fixture.py"}
+
+
+@pytest.mark.parametrize("code", [cls.code for cls in ALL_RULES])
+def test_rule_fires_on_bad_fixture(code):
+    src = _fixture(f"{code.lower()}_bad.py")
+    relpath = _RELPATHS.get(code, f"horovod_tpu/{code.lower()}_fixture.py")
+    findings = lint_source(src, relpath, rules=[get_rule(code)()])
+    assert findings, f"{code} failed to fire on its bad fixture"
+    assert all(f.rule == code for f in findings)
+
+
+@pytest.mark.parametrize("code", [cls.code for cls in ALL_RULES])
+def test_rule_silent_on_good_fixture(code):
+    src = _fixture(f"{code.lower()}_good.py")
+    relpath = _RELPATHS.get(code, f"horovod_tpu/{code.lower()}_fixture.py")
+    findings = lint_source(src, relpath, rules=[get_rule(code)()])
+    assert not findings, (
+        f"{code} false positive on its good fixture:\n"
+        + "\n".join(f.render() for f in findings))
+
+
+def test_hvd002_is_scoped_to_controller_paths():
+    """The same unordered walk outside controller/ is not a finding."""
+    src = _fixture("hvd002_bad.py")
+    findings = lint_source(src, "horovod_tpu/utils/elsewhere.py",
+                           rules=[get_rule("HVD002")()])
+    assert not findings
+
+
+def test_hvd007_counts_duplicates_and_bad_names():
+    findings = lint_source(_fixture("hvd007_bad.py"),
+                           "horovod_tpu/x.py", rules=[get_rule("HVD007")()])
+    messages = "\n".join(f.message for f in findings)
+    assert "requests_total" in messages        # missing prefix
+    assert "hvd_CamelCase" in messages         # not snake_case
+    assert "more than one call site" in messages  # duplicate owner
+    assert len(findings) == 3
+
+
+# ---------------------------------------------------------------------------
+# 3. Framework contracts
+
+
+def test_suppression_comment_silences_findings():
+    findings = lint_source(_fixture("suppressed.py"), "horovod_tpu/s.py")
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_suppression_is_rule_specific():
+    src = ("import os, time\n"
+           "t = os.environ.get('X')  # hvdlint: disable=HVD004\n")
+    findings = lint_source(src, "horovod_tpu/s.py")
+    # HVD004 pragma does NOT cover the HVD003 (env read at import time
+    # also trips HVD006) findings on that line.
+    assert {f.rule for f in findings} == {"HVD003", "HVD006"}
+
+
+def test_baseline_roundtrip(tmp_path):
+    bad = os.path.join(FIXTURES, "hvd004_bad.py")
+    first = run_lint([bad], root=FIXTURES)
+    assert first.findings
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, first.findings)
+    entries = load_baseline(path)
+    assert len(entries) == len(first.findings)
+    # With the baseline applied the same findings are grandfathered...
+    second = run_lint([bad], root=FIXTURES, baseline=entries)
+    assert not second.findings
+    assert len(second.baselined) == len(first.findings)
+    # ...and a NEW finding (different file) still fails.
+    third = run_lint([bad, os.path.join(FIXTURES, "hvd005_bad.py")],
+                     root=FIXTURES, baseline=entries)
+    assert third.findings and all(f.rule == "HVD005"
+                                  for f in third.findings)
+
+
+def test_baseline_is_a_multiset_not_a_blanket(tmp_path):
+    """One grandfathered entry absorbs exactly ONE finding: adding a
+    second violation of the same rule to the same file (identical
+    file-invariant message) must still be reported as new."""
+    one = "import time\n\ndef f():\n    return time.time()\n"
+    entries = [f.as_dict() for f in lint_source(one, "x.py")]
+    assert len(entries) == 1
+    two = one + "\n\ndef g():\n    return time.time()\n"
+    result_findings = []
+    # Reuse run_lint's budget semantics through lint files on disk.
+    p = tmp_path / "x.py"
+    p.write_text(two)
+    result = run_lint([str(p)], root=str(tmp_path), baseline=entries)
+    assert len(result.baselined) == 1
+    assert len(result.findings) == 1, (
+        "the second time.time() hid behind the first one's baseline "
+        f"entry: {result_findings}")
+
+
+def test_hvd003_flags_env_read_inside_store_target():
+    """A value read used as a subscript KEY of an assignment target is
+    still a read: ``x[os.environ['K']] = 1`` must fire."""
+    src = ("import os\n"
+           "def f(x):\n"
+           "    x[os.environ['K']] = 1\n")
+    findings = lint_source(src, "horovod_tpu/x.py",
+                           rules=[get_rule("HVD003")()])
+    assert len(findings) == 1 and findings[0].rule == "HVD003"
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    """Baseline matching keys on (rule, path, message), not line numbers:
+    prepending code to the file must not resurrect grandfathered
+    findings."""
+    src = _fixture("hvd004_bad.py")
+    findings = lint_source(src, "x.py")
+    entries = [f.as_dict() for f in findings]
+    drifted = "# a new comment line\nVERSION = 3\n" + src
+    shifted = lint_source(drifted, "x.py")
+    assert [f.line for f in shifted] != [f.line for f in findings]
+    keys = {baseline_key(e) for e in entries}
+    assert all(baseline_key(f.as_dict()) in keys for f in shifted)
+
+
+def test_reporters_render(tmp_path):
+    result = run_lint([os.path.join(FIXTURES, "hvd004_bad.py")],
+                      root=FIXTURES)
+    text = render_text(result)
+    assert "HVD004" in text and "finding(s)" in text
+    payload = json.loads(render_json(result))
+    assert payload["findings"] and payload["findings"][0]["rule"] == "HVD004"
+    assert payload["files_scanned"] == 1
+
+
+def test_cli_json_and_exit_codes(tmp_path):
+    """The CLI contract the acceptance criteria name: ``python -m
+    horovod_tpu.tools.lint --format json --baseline ...`` — exit 1 on a
+    dirty tree, 0 once the findings are baselined."""
+    bad = tmp_path / "pkgdir" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(_fixture("hvd005_bad.py"))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    base = [sys.executable, "-m", "horovod_tpu.tools.lint",
+            str(bad.parent), "--format", "json"]
+    res = subprocess.run(base + ["--baseline", "none"], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 1, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"HVD005"}
+    # Grandfather them; the same invocation now exits 0.
+    bl = str(tmp_path / "bl.json")
+    res = subprocess.run(base + ["--write-baseline", "--baseline", bl],
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    res = subprocess.run(base + ["--baseline", bl], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_refuses_partial_rewrite_of_default_baseline(tmp_path):
+    """--write-baseline on the DEFAULT baseline from a partial scan
+    (--select / explicit paths) would silently drop out-of-scope
+    entries; the CLI must refuse (exit 2, usage error) and leave the
+    checked-in file untouched."""
+    before = open(BASELINE).read()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.tools.lint",
+         "--select", "HVD004", "--write-baseline"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 2, res.stdout + res.stderr
+    assert "full default scan" in res.stderr
+    assert open(BASELINE).read() == before
+
+
+# ---------------------------------------------------------------------------
+# 4. Lock-order detector
+
+
+def test_tracked_lock_is_a_lock():
+    g = LockGraph()
+    lock = TrackedLock("t.a", graph_=g)
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+    assert lock.acquire(blocking=False)
+    lock.release()
+    # A failed try-acquire records nothing and needs no release.
+    holder = TrackedLock("t.b", graph_=g)
+    holder.acquire()
+    assert not (holder._inner.acquire(blocking=False))
+    holder.release()
+
+
+def test_seeded_lock_inversion_reports_cycle_with_both_stacks():
+    """The acceptance-criteria unit: acquire A->B on one code path and
+    B->A on another; the detector must report the cycle and attach the
+    acquisition stacks of BOTH edges."""
+    g = LockGraph()
+    a = TrackedLock("seed.a", graph_=g)
+    b = TrackedLock("seed.b", graph_=g)
+
+    def path_one():     # A then B
+        with a:
+            with b:
+                pass
+
+    def path_two():     # B then A — the inversion
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=path_one, name="inv-1", daemon=True)
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=path_two, name="inv-2", daemon=True)
+    t2.start()
+    t2.join()
+
+    cycles = g.cycles()
+    assert cycles, "inversion not detected"
+    assert sorted(cycles[0][:-1]) == ["seed.a", "seed.b"]
+    report = g.report()
+    assert not report["acyclic"]
+    (cyc,) = report["cycles"]
+    assert len(cyc["edges"]) == 2
+    for edge in cyc["edges"]:
+        # Both stacks per edge: where the held lock was taken and where
+        # the second acquisition happened — the actionable part.
+        assert edge["stack_held"], edge
+        assert edge["stack_acquired"], edge
+        assert any("path_one" in fr or "path_two" in fr
+                   for fr in edge["stack_acquired"])
+    assert {cyc["edges"][0]["thread"], cyc["edges"][1]["thread"]} == \
+        {"inv-1", "inv-2"}
+
+
+def test_no_false_cycle_on_consistent_order():
+    g = LockGraph()
+    a = TrackedLock("ok.a", graph_=g)
+    b = TrackedLock("ok.b", graph_=g)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert g.cycles() == []
+    assert g.report()["acyclic"]
+    assert g.edges()[("ok.a", "ok.b")]["count"] == 3
+
+
+def test_same_name_reacquisition_is_not_an_edge():
+    """Many lock instances share one graph node (e.g. every metric's
+    child lock); nesting two of them must not fabricate a self-cycle."""
+    g = LockGraph()
+    a1 = TrackedLock("m.metric", graph_=g)
+    a2 = TrackedLock("m.metric", graph_=g)
+    with a1:
+        with a2:
+            pass
+    assert g.edges() == {}
+
+
+def test_make_lock_gated_by_env(monkeypatch):
+    from horovod_tpu.analysis import lockorder
+
+    monkeypatch.delenv("HOROVOD_LOCKCHECK", raising=False)
+    monkeypatch.setattr(lockorder, "_enabled", None)
+    assert isinstance(make_lock("x"), type(threading.Lock()))
+    monkeypatch.setenv("HOROVOD_LOCKCHECK", "1")
+    monkeypatch.setattr(lockorder, "_enabled", None)
+    assert isinstance(make_lock("x"), TrackedLock)
+    monkeypatch.setenv("HOROVOD_LOCKCHECK", "0")  # repo knob semantics
+    monkeypatch.setattr(lockorder, "_enabled", None)
+    assert isinstance(make_lock("x"), type(threading.Lock()))
+    monkeypatch.setattr(lockorder, "_enabled", None)
+
+
+def test_write_graph_artifact(tmp_path, monkeypatch):
+    from horovod_tpu.analysis import lockorder
+
+    monkeypatch.setenv("HOROVOD_LOCKCHECK", "1")
+    monkeypatch.setattr(lockorder, "_enabled", None)
+    g = lockorder.graph()
+    a = TrackedLock("art.a", graph_=g)
+    b = TrackedLock("art.b", graph_=g)
+    with a:
+        with b:
+            pass
+    out = tmp_path / "lockgraph.json"
+    assert lockorder.write_graph(str(out)) == str(out)
+    payload = json.loads(out.read_text())
+    assert payload["acyclic"] in (True, False)
+    assert any(e["from"] == "art.a" and e["to"] == "art.b"
+               for e in payload["edges"])
+    monkeypatch.setattr(lockorder, "_enabled", None)
+
+
+# ---------------------------------------------------------------------------
+# 5. 3-rank acceptance: real controller under HOROVOD_LOCKCHECK=1
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_lockcheck_three_rank_run_produces_acyclic_graph(tmp_path):
+    """Acceptance criterion: a 3-rank eager job under
+    ``HOROVOD_LOCKCHECK=1`` completes and every rank writes a valid
+    ``lockgraph.json`` with no cycles. Telemetry + rank-0 timeline are
+    on so the run exercises the real nested acquisitions (the
+    timeline-emit-under-pids-lock path the detector exists to watch)."""
+    addr = f"127.0.0.1:{_free_port()}"
+    size = 3
+    out = str(tmp_path / "lockgraph.json")
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HOROVOD_CYCLE_TIME": "1",
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_CONTROLLER_ADDR": addr,
+            "HOROVOD_ENGINE": "python",
+            "HOROVOD_LOCKCHECK": "1",
+            "HOROVOD_LOCKCHECK_OUTPUT": out,
+            "HOROVOD_METRICS": "1",
+        })
+        if rank == 0:
+            env["HOROVOD_TIMELINE"] = str(tmp_path / "tl.json")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "mp_worker.py"), "allreduce"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    for rank, proc in enumerate(procs):
+        stdout, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0, (
+            f"rank {rank} failed under lockcheck:\n{stdout[-3000:]}")
+    edges_seen = 0
+    for rank in range(size):
+        path = f"{out}.rank{rank}"
+        assert os.path.exists(path), f"rank {rank} wrote no lock graph"
+        payload = json.loads(open(path).read())
+        assert payload["acyclic"] is True, (
+            f"rank {rank} lock-order CYCLE: {payload['cycles']}")
+        edges_seen += len(payload["edges"])
+    # The coordinator's timeline/metrics nesting guarantees real
+    # observations — an all-empty graph would mean the factory isn't
+    # actually wired into the runtime locks.
+    assert edges_seen > 0, "no lock-order edges recorded on any rank"

@@ -1,31 +1,33 @@
 """Metric-catalog lint: keeps the telemetry namespace coherent as future
 PRs add series.
 
-Walks the ``horovod_tpu`` package source and asserts:
+Round 8 enforced the catalog with regexes; the checks now ride the
+hvdlint AST framework (``horovod_tpu.analysis``, rule HVD007) — the
+registration inventory comes from real ``ast`` call nodes instead of a
+regex over raw source, so formatting changes can't dodge the lint. The
+assertions are unchanged:
 
 1. every registered metric name is unique (one owning call site),
-   snake_case, and ``hvd_``-prefixed;
-2. no module registers metrics at **import time** — registration must be
-   lazy (the zero-overhead-off contract depends on it), verified in a
-   clean subprocess interpreter so this test is immune to whatever other
-   tests already registered in this process.
+   snake_case, and ``hvd_``-prefixed — now simply "HVD007 reports no
+   findings over the package";
+2. no module registers metrics at **import time** — statically HVD006,
+   and dynamically in a clean subprocess interpreter (this test is
+   immune to whatever other tests already registered in this process).
 """
 
+import ast
 import json
 import os
 import re
 import subprocess
 import sys
-from collections import Counter as TallyCounter
+
+from horovod_tpu.analysis import run_lint
+from horovod_tpu.analysis.rules import MetricCatalogRule
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 PKG = os.path.join(REPO, "horovod_tpu")
-
-# registry.counter("name"...) / metrics.gauge("name"...) / r.histogram(...)
-_REG_CALL = re.compile(
-    r"\b(?:counter|gauge|histogram)\(\s*\n?\s*[\"']([^\"']+)[\"']")
-_NAME_RULE = re.compile(r"^hvd_[a-z][a-z0-9_]*$")
 
 
 def _package_sources():
@@ -38,26 +40,25 @@ def _package_sources():
 
 
 def _registered_names():
+    """(name, relpath) for every literal counter/gauge/histogram
+    registration — the AST inventory HVD007 itself is built on."""
     names = []
     for path in _package_sources():
         with open(path) as f:
-            src = f.read()
-        for name in _REG_CALL.findall(src):
+            tree = ast.parse(f.read(), filename=path)
+        for name, _node in MetricCatalogRule.registrations(tree):
             names.append((name, os.path.relpath(path, REPO)))
     return names
 
 
 def test_metric_names_unique_snake_case_hvd_prefixed():
     names = _registered_names()
-    assert names, "no metric registrations found — did the regex rot?"
-    bad = [(n, p) for n, p in names if not _NAME_RULE.match(n)]
-    assert not bad, f"non-conforming metric names (want hvd_snake_case): {bad}"
-    tally = TallyCounter(n for n, _ in names)
-    dupes = {n: [p for m, p in names if m == n]
-             for n, c in tally.items() if c > 1}
-    assert not dupes, (
-        "metric registered at more than one call site (each name must have "
-        f"exactly one owner): {dupes}")
+    assert names, "no metric registrations found — did the AST scan rot?"
+    result = run_lint([PKG], root=REPO, select=["HVD007"])
+    assert not result.parse_errors, result.parse_errors
+    assert not result.findings, (
+        "metric catalog violations (hvd_ snake_case, one owner per name):\n"
+        + "\n".join(f.render() for f in result.findings))
 
 
 def test_known_series_present():
@@ -85,6 +86,15 @@ def test_known_series_present():
         "hvd_straggler_cycles_total",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
+
+
+def test_no_import_time_registration_static():
+    """Static half of the import-time contract: HVD006 over the package
+    (registration calls, env value reads, and thread spawns at module
+    top level) is clean."""
+    result = run_lint([PKG], root=REPO, select=["HVD006"])
+    assert not result.findings, "\n".join(
+        f.render() for f in result.findings)
 
 
 def test_trace_phase_names_fixed_vocabulary():
