@@ -203,6 +203,7 @@ def child_bench(status_path):
         "window_spread_pct": round(spread_pct, 2),
         "metrics": _controller_metrics(),
         "straggler": _straggler_summary(),
+        "health": _doctor_summary(),
     }), flush=True)
 
 
@@ -219,11 +220,24 @@ def _straggler_summary():
         return {"error": str(exc)[:200]}
 
 
+def _doctor_summary():
+    """Cluster-doctor verdict for the bench record (rule hits + the
+    worst finding's rank and hint), beside the raw `metrics` and
+    `straggler` fields: BENCH_*.json then carries not just the numbers
+    but the diagnosis. Empty (findings=0, no rules) on a healthy run."""
+    try:
+        from horovod_tpu import doctor as hvd_doctor
+
+        return hvd_doctor.summary()
+    except Exception as exc:  # telemetry must never fail the bench row
+        return {"error": str(exc)[:200]}
+
+
 def _controller_metrics():
     """Controller-health snapshot for the bench record (cycle p50/p99,
     fused bytes, cache hit rate): BENCH_*.json then shows whether the
     control plane, not just the math, was healthy during the run. Fields
-    are None on SPMD-only runs (no eager controller ticking)."""
+    are all-zero on SPMD-only runs (no eager controller ticking)."""
     try:
         from horovod_tpu import metrics as hvd_metrics
 
@@ -339,6 +353,7 @@ def child_row(name, status_path):
                    ["python", spec["script"]] + spec["args"])}
     row.setdefault("metrics", _controller_metrics())
     row.setdefault("straggler", _straggler_summary())
+    row.setdefault("health", _doctor_summary())
     print(json.dumps(row), flush=True)
 
 

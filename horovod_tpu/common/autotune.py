@@ -21,6 +21,20 @@ Enabled by ``HOROVOD_AUTOTUNE``; per-step CSV via ``HOROVOD_AUTOTUNE_LOG``
 (reference ``operations.cc:1074-1078``). The coordinator tunes and the new
 values ride the cycle reply to all ranks (reference ``SyncParams``,
 ``parameter_manager.cc:223``).
+
+Straggler-aware scoring (no reference counterpart — closes ROADMAP item
+5 over the r8/r9 observability planes): when ``straggler_weight`` > 0,
+each cycle may carry the coordinator's observed negotiation slack (how
+late the slowest rank's tick arrived beyond the pacing bound) and its
+total excess recv-wait; a configuration's score becomes
+
+    score = median(bytes/sec) / (1 + w*slack_frac + w*wait_frac)
+
+with both penalty terms medians of the per-cycle fractions
+``slack/seconds`` — a scale-free "fraction of the cycle spent waiting
+on stragglers". Two configurations with identical throughput therefore
+rank strictly by their slack, and the per-step log records every
+component so the blend is auditable after the fact.
 """
 
 from __future__ import annotations
@@ -160,7 +174,8 @@ class ParameterManager:
                  categoricals: Optional[dict] = None,
                  fixed=frozenset(),
                  tune_hierarchical: bool = False,
-                 hierarchical: bool = False):
+                 hierarchical: bool = False,
+                 straggler_weight: float = 0.0):
         # Legacy spelling (round-3 callers/tests): hierarchical allreduce
         # only, tuned iff tune_hierarchical.
         if categoricals is None:
@@ -179,11 +194,22 @@ class ParameterManager:
         self.categoricals = {k: bool(v) for k, v in categoricals.items()}
         self._warmup_left = self.WARMUP_SAMPLES
         self._scores: List[float] = []
+        # Per-cycle straggler cost as a fraction of the cycle: slack
+        # (worst rank's lateness) and excess recv-wait, both reset with
+        # the score window on every parameter change.
+        self.straggler_weight = max(0.0, float(straggler_weight))
+        self._slack_fracs: List[float] = []
+        self._wait_fracs: List[float] = []
         self._bo_steps = 0
         self._completed = False
         self._log_path = log_path
         self._log_header_due = log_path is not None
         self._best_score = -np.inf
+        # Components of the most recently scored configuration (and of
+        # the best-seen one) — the hvd_autotune_* gauges and the doctor's
+        # wandering-search rule read these.
+        self.last_objective: Optional[dict] = None
+        self.best_objective: Optional[dict] = None
         self.best_fusion_threshold = self.fusion_threshold
         self.best_cycle_time_ms = self.cycle_time_ms
         self.best_categoricals = dict(self.categoricals)
@@ -241,25 +267,57 @@ class ParameterManager:
             if self._cat_sweep >= self.CATEGORY_SWEEPS:
                 self._cats_converged = True
 
-    def record(self, nbytes: int,
-               seconds: float) -> Optional[Tuple[int, float, dict]]:
+    @staticmethod
+    def blend(throughput: float, slack_frac: float, wait_frac: float,
+              weight: float) -> float:
+        """The straggler-aware objective: throughput discounted by the
+        fraction of each cycle spent waiting on stragglers. Strictly
+        decreasing in both penalty fractions at fixed throughput, so two
+        configurations with identical bytes/sec rank by their slack."""
+        return throughput / (1.0 + weight * max(0.0, slack_frac)
+                             + weight * max(0.0, wait_frac))
+
+    def record(self, nbytes: int, seconds: float,
+               slack_seconds: float = 0.0,
+               recv_wait_seconds: float = 0.0
+               ) -> Optional[Tuple[int, float, dict]]:
         """Feed one cycle's totals; returns new (fusion_threshold, cycle_ms,
         categoricals) when the manager moves to a new configuration, else
-        None."""
+        None. ``slack_seconds``/``recv_wait_seconds`` are the coordinator's
+        per-cycle straggler observations (worst rank's tick lateness /
+        total excess tick wait); both default to 0, which reduces the
+        objective to the reference's pure bytes/sec."""
         if nbytes <= 0 or seconds <= 0 or not self.tunable:
             return None
         if self._warmup_left > 0:
             self._warmup_left -= 1
             return None
         self._scores.append(nbytes / seconds)
+        if self.straggler_weight > 0:
+            self._slack_fracs.append(max(0.0, slack_seconds) / seconds)
+            self._wait_fracs.append(max(0.0, recv_wait_seconds) / seconds)
         if len(self._scores) < self.SAMPLES_PER_STEP:
             return None
 
         # MEDIAN of the per-cycle rates (reference sorts scores_ and takes
         # scores_[SAMPLES/2], parameter_manager.cc:176-180): a mean lets
         # one contended cycle on a timeshared host poison the whole
-        # configuration's score.
-        score = float(np.median(self._scores))  # bytes/sec, higher better
+        # configuration's score. The straggler penalties get the same
+        # median treatment — one contended cycle must not smear an
+        # otherwise clean configuration.
+        throughput = float(np.median(self._scores))  # bytes/sec
+        w = self.straggler_weight
+        slack_frac = (float(np.median(self._slack_fracs))
+                      if self._slack_fracs else 0.0)
+        wait_frac = (float(np.median(self._wait_fracs))
+                     if self._wait_fracs else 0.0)
+        score = self.blend(throughput, slack_frac, wait_frac, w)
+        self.last_objective = {
+            "throughput_bytes_per_sec": throughput,
+            "slack_penalty": w * slack_frac,
+            "recv_wait_penalty": w * wait_frac,
+            "score": score,
+        }
         params = (np.log2(self.fusion_threshold), self.cycle_time_ms)
         self._bo.add_sample(params, score)
         if score > self._best_score:
@@ -267,6 +325,7 @@ class ParameterManager:
             self.best_fusion_threshold = self.fusion_threshold
             self.best_cycle_time_ms = self.cycle_time_ms
             self.best_categoricals = dict(self.categoricals)
+            self.best_objective = dict(self.last_objective)
         if self._log_path:
             cat_items = sorted(self.categoricals.items())
             with open(self._log_path, "a") as f:
@@ -277,13 +336,17 @@ class ParameterManager:
                     if f.tell() == 0:
                         f.write("time,fusion_threshold,cycle_time_ms,"
                                 + ",".join(k for k, _ in cat_items)
-                                + ",score_bytes_per_sec\n")
+                                + ",throughput_bytes_per_sec,"
+                                "slack_penalty,recv_wait_penalty,"
+                                "score_bytes_per_sec\n")
                     self._log_header_due = False
                 cats = ",".join(str(int(v)) for _, v in cat_items)
                 # Log-row wall stamp, read next to other logs — not
                 # duration math. hvdlint: disable=HVD004
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
-                        f"{self.cycle_time_ms:.3f},{cats},{score:.1f}\n")
+                        f"{self.cycle_time_ms:.3f},{cats},"
+                        f"{throughput:.1f},{w * slack_frac:.6f},"
+                        f"{w * wait_frac:.6f},{score:.1f}\n")
 
         self._advance_categoricals(score)
 
@@ -316,6 +379,30 @@ class ParameterManager:
             self._initial_cycle_ms if "cycle_time" in self.fixed
             else float(nxt[1]))
         self._scores = []
+        self._slack_fracs = []
+        self._wait_fracs = []
         self._warmup_left = self.WARMUP_SAMPLES
         return (self.fusion_threshold, self.cycle_time_ms,
                 dict(self.categoricals))
+
+    @property
+    def steps_scored(self) -> int:
+        """Scored BO configurations so far (the gauge publisher keys its
+        "something changed" check on this)."""
+        return self._bo_steps
+
+    def state(self) -> dict:
+        """JSON-clean tuner state for the ``hvd_autotune_*`` gauges and
+        the doctor's wandering/stalled-search rules."""
+        return {
+            "active": bool(self.tunable),
+            "steps_completed": self._bo_steps,
+            "steps_remaining": max(0, self.BO_MAX_STEPS - self._bo_steps),
+            "fusion_threshold": int(self.fusion_threshold),
+            "cycle_time_ms": float(self.cycle_time_ms),
+            "best_fusion_threshold": int(self.best_fusion_threshold),
+            "best_cycle_time_ms": float(self.best_cycle_time_ms),
+            "straggler_weight": self.straggler_weight,
+            "last_objective": self.last_objective,
+            "best_objective": self.best_objective,
+        }

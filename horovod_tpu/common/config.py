@@ -210,6 +210,27 @@ def log_level_name() -> str:
     return os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
 
 
+def autotune_straggler_weight() -> float:
+    """``HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT``: how strongly the autotuner's
+    objective discounts throughput for observed negotiation slack and
+    coordinator recv-wait (docs/autotune.md). 0 restores the pure
+    bytes/sec objective; negative/garbage values clamp to the default.
+    Default 1.0 — with a healthy cluster both penalty terms are ~0, so
+    the blend only bites when stragglers actually cost wall time."""
+    val = _env_float("HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT", 1.0)
+    return val if val >= 0 else 1.0
+
+
+def doctor_cycles() -> int:
+    """``HOROVOD_DOCTOR_CYCLES``: coordinator cycles between periodic
+    cluster-doctor sweeps (the rank-0 log line + hvd_doctor_* gauges;
+    docs/doctor.md). 0/negative disables the periodic sweep (the /doctor
+    endpoint and offline CLI still work). Default 1000 — ~5s at the
+    default 5 ms cycle time."""
+    val = _env_int("HOROVOD_DOCTOR_CYCLES", 1000)
+    return max(0, val)
+
+
 def fault_plan_raw() -> Optional[str]:
     """``HOROVOD_FAULT_PLAN``: inline JSON or ``@file`` reference for the
     deterministic fault-injection plan; None/blank disables."""

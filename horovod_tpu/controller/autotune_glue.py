@@ -4,14 +4,21 @@ module stays importable without numpy-linalg-heavy paths on the hot import).
 Mirrors the reference's fixed-knob wiring (``operations.cc:1005-1049``):
 every knob the user's environment sets explicitly is pinned
 (``SetX(value, fixed=true)``); only the rest are tuned.
+
+Also home to the tuner's telemetry surface: :func:`publish_tuner_gauges`
+mirrors the live :meth:`ParameterManager.state` into the ``hvd_autotune_*``
+gauges so the rank-0 cluster view (and the cluster doctor's
+wandering/stalled-search rules, ``horovod_tpu/doctor``) can watch the
+search without parsing the autotune CSV.
 """
 
 from __future__ import annotations
 
 import os
 
+from .. import metrics
 from ..common.autotune import ParameterManager
-from ..common.config import Config
+from ..common.config import Config, autotune_straggler_weight
 
 # knob name -> env var whose presence fixes it (reference env surface).
 _FIXING_ENV = {
@@ -48,4 +55,78 @@ def make_parameter_manager(config: Config,
             "cache_enabled": config.cache_capacity > 0,
         },
         fixed=fixed,
+        straggler_weight=autotune_straggler_weight(),
     )
+
+
+_m = None
+
+
+def _autotune_metrics():
+    """Lazy registration (never at import time — tests/test_metrics_lint.py).
+    One gauge per scalar of tuner state plus a component-labeled objective
+    gauge; all live on the coordinator only (the tuner runs on rank 0)."""
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            active=metrics.gauge(
+                "hvd_autotune_active",
+                "1 while the parameter search is still exploring, 0 once "
+                "every knob is pinned or the search completed."),
+            steps=metrics.gauge(
+                "hvd_autotune_steps_completed",
+                "Scored Bayesian-optimization configurations so far."),
+            remaining=metrics.gauge(
+                "hvd_autotune_steps_remaining",
+                "BO configurations left before the search pins the best "
+                "and stops."),
+            threshold=metrics.gauge(
+                "hvd_autotune_fusion_threshold_bytes",
+                "Fusion threshold currently being explored."),
+            cycle_ms=metrics.gauge(
+                "hvd_autotune_cycle_time_ms",
+                "Cycle time (ms) currently being explored."),
+            best_threshold=metrics.gauge(
+                "hvd_autotune_best_fusion_threshold_bytes",
+                "Fusion threshold of the best-scoring configuration seen."),
+            best_cycle_ms=metrics.gauge(
+                "hvd_autotune_best_cycle_time_ms",
+                "Cycle time (ms) of the best-scoring configuration seen."),
+            objective=metrics.gauge(
+                "hvd_autotune_objective",
+                "Blended-objective components of the most recently scored "
+                "configuration (docs/autotune.md): throughput_bytes_per_sec,"
+                " slack_penalty, recv_wait_penalty, score.",
+                ("component",)),
+            best_objective=metrics.gauge(
+                "hvd_autotune_best_objective",
+                "Blended score of the best-seen configuration."),
+        )
+    return _m
+
+
+def publish_tuner_gauges(pm: ParameterManager) -> None:
+    """Mirror ``pm.state()`` into the ``hvd_autotune_*`` gauges. Cheap
+    (a dozen locked float sets) and called only when a configuration was
+    actually scored, so it never rides the per-cycle hot path."""
+    if not metrics.on():
+        return
+    state = pm.state()
+    m = _autotune_metrics()
+    m.active.set(1.0 if state["active"] else 0.0)
+    m.steps.set(state["steps_completed"])
+    m.remaining.set(state["steps_remaining"])
+    m.threshold.set(state["fusion_threshold"])
+    m.cycle_ms.set(state["cycle_time_ms"])
+    m.best_threshold.set(state["best_fusion_threshold"])
+    m.best_cycle_ms.set(state["best_cycle_time_ms"])
+    last = state["last_objective"]
+    if last is not None:
+        for component in ("throughput_bytes_per_sec", "slack_penalty",
+                          "recv_wait_penalty", "score"):
+            m.objective.labels(component).set(last[component])
+    best = state["best_objective"]
+    if best is not None:
+        m.best_objective.set(best["score"])

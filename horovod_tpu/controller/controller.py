@@ -103,6 +103,12 @@ def _ctl_metrics():
                 "hvd_collective_bytes_total",
                 "Eager collective payload bytes enqueued, by op and dtype.",
                 ("op", "dtype")),
+            tick_lateness=metrics.histogram(
+                "hvd_controller_tick_lateness_seconds",
+                "Per-rank tick lateness observed by the coordinator: time "
+                "it sat blocked on a rank's tick beyond the cycle-time "
+                "pacing allowance. The live straggler signal the doctor "
+                "and the autotune objective consume.", ("rank",)),
         )
     return _m
 
@@ -225,12 +231,29 @@ class Controller:
                     self._cross_ring = RingBackend(
                         topology.cross_rank, topology.cross_size, cross_addrs,
                         job_secret())
+        # Coordinator-side straggler observations for the cycle just
+        # coordinated: worst rank's tick lateness and the summed excess
+        # wait (seconds). Written by _coordinate, read by _cycle on the
+        # same (controller) thread.
+        self._cycle_slack = 0.0
+        self._cycle_excess_wait = 0.0
+        # Periodic rank-0 cluster-doctor sweep (docs/doctor.md): one log
+        # line + hvd_doctor_* gauges every N cycles; 0 disables.
+        self._doctor_cycles = (config_mod.doctor_cycles()
+                               if topology.rank == 0 else 0)
+        self._doctor_thread: Optional[threading.Thread] = None
+        self._autotune_steps_pub: Optional[int] = None
+        self._publish_tuner = None
         if config.autotune and topology.rank == 0:
-            from .autotune_glue import make_parameter_manager
+            from .autotune_glue import (
+                make_parameter_manager,
+                publish_tuner_gauges,
+            )
 
             self._param_manager = make_parameter_manager(
                 config, tune_hierarchical=self._local_ring is not None,
                 tune_cache=True)
+            self._publish_tuner = publish_tuner_gauges
 
         addr = config_mod.controller_addr()
         if addr is None:
@@ -638,7 +661,9 @@ class Controller:
             nbytes = self._process_reply(reply)
             if self._param_manager is not None:
                 tuned = self._param_manager.record(
-                    nbytes, time.monotonic() - t0)
+                    nbytes, time.monotonic() - t0,
+                    slack_seconds=self._cycle_slack,
+                    recv_wait_seconds=self._cycle_excess_wait)
                 if tuned is not None:
                     # Continuous knobs apply immediately (coordinator-only
                     # effects); the hierarchical flag is applied ONLY via
@@ -647,6 +672,18 @@ class Controller:
                     # cycle boundary.
                     self._fusion_threshold, self._cycle_time_ms = tuned[:2]
                     self._pending_tune = tuned
+                if (mon and self._param_manager.steps_scored
+                        != self._autotune_steps_pub):
+                    # First pass publishes the initial state (active flag,
+                    # starting knobs); afterwards only a newly scored
+                    # configuration re-publishes — gauge writes stay off
+                    # the steady-state cycle path.
+                    self._autotune_steps_pub = \
+                        self._param_manager.steps_scored
+                    self._publish_tuner(self._param_manager)
+            if (self._doctor_cycles and mon
+                    and self._cycle_index % self._doctor_cycles == 0):
+                self._doctor_sweep()
         else:
             if mon:
                 self._cycles_since_push += 1
@@ -667,8 +704,34 @@ class Controller:
     def _coordinate(self, my_tick: dict) -> dict:
         size = self.topo.size
         ticks = {0: my_tick}
+        # Per-rank tick waits: how long the coordinator sat blocked on
+        # each rank's tick this cycle. The walk is in rank order, so the
+        # common ~cycle_time pacing wait lands on whichever recv blocks
+        # first; a cumulative allowance of one cycle time is free and
+        # anything beyond it is LATENESS charged to the rank being waited
+        # on — the live analogue of the trace plane's negotiation slack.
+        measure = metrics.on() or self._param_manager is not None
+        waits: Dict[int, float] = {}
         for rank in range(1, size):
+            t_r = time.monotonic() if measure else 0.0
             ticks[rank] = self._service.recv_from(rank)
+            if measure:
+                waits[rank] = time.monotonic() - t_r
+        if measure:
+            allowance = self._cycle_time_ms / 1e3
+            slack = 0.0
+            excess = 0.0
+            mon = metrics.on()
+            for rank in sorted(waits):
+                lateness = max(0.0, waits[rank] - allowance)
+                allowance = max(0.0, allowance - waits[rank])
+                slack = max(slack, lateness)
+                excess += lateness
+                if mon:
+                    _ctl_metrics().tick_lateness.labels(
+                        str(rank)).observe(lateness)
+            self._cycle_slack = slack
+            self._cycle_excess_wait = excess
 
         if metrics.on():
             for rank in range(1, size):
@@ -812,6 +875,41 @@ class Controller:
                                          age_seconds=round(age, 3))
                     with self._lock:
                         self._shutdown_requested = True
+
+    def _doctor_sweep(self) -> None:
+        """Periodic rank-0 cluster-doctor pass (docs/doctor.md): diagnose
+        the live evidence (local + piggybacked remote snapshots), refresh
+        the hvd_doctor_* gauges, and emit ONE log line. Runs on a daemon
+        thread: every worker sits blocked at the cycle barrier while the
+        coordinator is in _cycle, and a sweep that ran inline there would
+        periodically distort the very cycle-time and recv-wait series it
+        diagnoses. A sweep still running when the next one is due is
+        skipped, not stacked. Telemetry must never fail the job it
+        observes — any doctor error is swallowed to a debug line."""
+        if self._doctor_thread is not None and self._doctor_thread.is_alive():
+            return
+
+        def sweep() -> None:
+            try:
+                from .. import doctor
+
+                rep = doctor.report()
+                # warning+ findings go to WARNING: the package's default
+                # log level filters info, and an operator-actionable
+                # diagnosis must not be silently dropped on a
+                # default-configured job. Info-only findings (e.g. a
+                # scoreless autotune search) stay at info — a doctor
+                # that cries wolf every sweep gets ignored.
+                actionable = (rep["counts"]["critical"]
+                              + rep["counts"]["warning"]) > 0
+                log = logging.warning if actionable else logging.info
+                log("doctor: %s", doctor.periodic_line(rep=rep))
+            except Exception as exc:
+                logging.debug("doctor sweep failed: %s", exc)
+
+        self._doctor_thread = threading.Thread(
+            target=sweep, name="hvd-doctor", daemon=True)
+        self._doctor_thread.start()
 
     # ----------------------------------------------------------- both sides
 

@@ -1,0 +1,471 @@
+"""The cluster doctor's rule catalog (docs/doctor.md).
+
+Each rule is a pure function ``Evidence -> Iterator[Diagnosis]`` — no
+clocks, no env, no I/O — so every rule is unit-testable from synthetic
+evidence and behaves identically live (the ``/doctor`` endpoint, the
+coordinator's periodic sweep) and offline (``tools.doctor`` over an
+artifact directory). A rule that cannot see its minimum evidence yields
+nothing: absence of data is not health, and the report records which
+sources were present.
+
+Thresholds are module constants, deliberately conservative: a doctor
+that cries wolf gets ignored, and every ``Diagnosis`` carries the raw
+evidence series so the operator can re-judge the verdict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..metrics import quantile
+from .evidence import Evidence
+
+SEVERITIES = ("critical", "warning", "info")
+
+# -- persistent straggler ----------------------------------------------------
+# A rank must be late this often / this much before it is named.
+STRAGGLER_MIN_COLLECTIVES = 10     # report: attributed collectives needed
+STRAGGLER_CYCLE_SHARE = 0.3        # report: fraction a rank arrived last
+STRAGGLER_MIN_LATENESS = 0.010     # seconds, p99 floor (live + report)
+STRAGGLER_CRITICAL_LATENESS = 0.100
+STRAGGLER_MIN_SAMPLES = 20         # live: tick-lateness observations needed
+STRAGGLER_SKEW_FACTOR = 3.0        # live: p99 vs other ranks' median p99
+# -- clock sync --------------------------------------------------------------
+CLOCK_MAX_UNCERTAINTY = 0.005      # seconds
+# -- recv-wait skew ----------------------------------------------------------
+RECV_WAIT_MIN_P99 = 0.020          # seconds
+RECV_WAIT_SKEW_FACTOR = 3.0
+# -- heartbeat flapping ------------------------------------------------------
+FLAPPING_MIN_TRIPS = 2
+FLAPPING_CRITICAL_TRIPS = 10
+# -- cache collapse ----------------------------------------------------------
+CACHE_MIN_TRAFFIC = 200            # hits + misses before judging
+CACHE_COLLAPSE_RATE = 0.2
+# -- restart churn -----------------------------------------------------------
+RESTART_CHURN_MIN = 2
+RESTART_CHURN_CRITICAL = 5
+# -- autotune search ---------------------------------------------------------
+AUTOTUNE_STALLED_MIN_CYCLES = 500  # controller cycles before "stalled"
+AUTOTUNE_WANDER_MIN_STEPS = 10     # steps before "wandering" is judged
+AUTOTUNE_WANDER_RATIO = 0.5        # last score vs best score
+
+
+@dataclasses.dataclass
+class Diagnosis:
+    """One structured verdict: what is wrong, where, how bad, what to do.
+    ``evidence`` holds the raw numbers the verdict was derived from so an
+    operator can re-judge it without re-running the rules."""
+
+    rule: str
+    severity: str          # "critical" | "warning" | "info"
+    summary: str
+    hint: str
+    rank: Optional[int] = None
+    evidence: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "rank": self.rank, "summary": self.summary,
+                "hint": self.hint, "evidence": self.evidence}
+
+    def one_line(self) -> str:
+        where = f"rank {self.rank} " if self.rank is not None else ""
+        return f"[{self.severity}] {where}{self.rule}: {self.summary}"
+
+
+def _series_totals(snapshots: Dict[int, dict], name: str
+                   ) -> Dict[int, float]:
+    """rank -> summed counter value for ``name`` across its label sets."""
+    out: Dict[int, float] = {}
+    for rank in sorted(snapshots):
+        entry = snapshots[rank].get(name)
+        if entry and entry.get("type") != "histogram":
+            out[rank] = sum(v for _, v in entry.get("values", []))
+    return out
+
+
+def _counter_by_first_label(snap: dict, name: str) -> Dict[str, float]:
+    entry = snap.get(name)
+    if not entry:
+        return {}
+    return {labels[0]: value for labels, value in entry.get("values", [])
+            if labels}
+
+
+def _gauge(snapshots: Dict[int, dict], name: str) -> Optional[float]:
+    """First rank's unlabeled gauge value, or None when absent anywhere."""
+    for rank in sorted(snapshots):
+        entry = snapshots[rank].get(name)
+        if entry and entry.get("values"):
+            return float(entry["values"][0][1])
+    return None
+
+
+def _per_label_quantiles(entry: Optional[dict], q: float
+                         ) -> Dict[str, Tuple[float, int]]:
+    """label-value -> (quantile, sample count) for a single-label
+    histogram entry (e.g. hvd_controller_tick_lateness_seconds{rank})."""
+    if not entry or entry.get("type") != "histogram":
+        return {}
+    out: Dict[str, Tuple[float, int]] = {}
+    for labels, value in entry.get("values", []):
+        if not labels:
+            continue
+        single = {"type": "histogram", "buckets": entry.get("buckets", []),
+                  "values": [[[], value]]}
+        est = quantile(single, q)
+        if est is not None:
+            out[labels[0]] = (est, int(value.get("count", 0)))
+    return out
+
+
+def _hist_quantile_and_count(snap: dict, name: str, q: float
+                             ) -> Tuple[Optional[float], int]:
+    entry = snap.get(name)
+    est = quantile(entry, q)
+    count = 0
+    if entry and entry.get("type") == "histogram":
+        count = sum(int(v.get("count", 0))
+                    for _, v in entry.get("values", []))
+    return est, count
+
+
+def _ms(seconds: float) -> str:
+    return f"{seconds * 1e3:.0f}ms"
+
+
+# ---------------------------------------------------------------------------
+# Rules
+
+
+def check_persistent_straggler(ev: Evidence) -> Iterator[Diagnosis]:
+    """One rank keeps arriving last at negotiation. Offline evidence is
+    the r9 straggler report; live evidence is the coordinator's per-rank
+    tick-lateness histogram — both express "late at negotiation"."""
+    report = ev.straggler_report
+    if report and report.get("collectives", 0) >= STRAGGLER_MIN_COLLECTIVES:
+        total = report["collectives"]
+        for rank_str in sorted(report.get("per_rank", {})):
+            stats = report["per_rank"][rank_str]
+            cycles = stats.get("straggler_cycles", 0)
+            p99 = stats.get("lateness_p99_seconds") or 0.0
+            if (cycles >= max(5, STRAGGLER_CYCLE_SHARE * total)
+                    and p99 >= STRAGGLER_MIN_LATENESS):
+                sev = ("critical" if p99 >= STRAGGLER_CRITICAL_LATENESS
+                       else "warning")
+                yield Diagnosis(
+                    rule="persistent_straggler", severity=sev,
+                    rank=int(rank_str),
+                    summary=(f"arrived last at negotiation in {cycles} of "
+                             f"{total} collectives (lateness p99 "
+                             f"{_ms(p99)})"),
+                    hint=(f"rank {rank_str} is persistently ≥"
+                          f"{_ms(p99)} late at negotiation across {total} "
+                          "collectives; suspect its NIC, a co-tenant "
+                          "process, or its input pipeline"),
+                    evidence={"straggler_cycles": cycles,
+                              "collectives": total,
+                              "lateness_p99_seconds": p99,
+                              "source": "straggler_report"})
+    # Live: the coordinator's tick-lateness histogram (rank label).
+    for rank in sorted(ev.snapshots):
+        per = _per_label_quantiles(
+            ev.snapshots[rank].get("hvd_controller_tick_lateness_seconds"),
+            0.99)
+        if len(per) < 2:
+            # One observed worker means no cluster to compare against:
+            # the documented contract is "≥3x the cluster median", and
+            # without peers the floor would degenerate to an absolute
+            # threshold that names a merely compute-bound lone worker.
+            continue
+        for label in sorted(per):
+            p99, count = per[label]
+            others = [p for lbl, (p, _) in per.items() if lbl != label]
+            floor = max(sorted(others)[len(others) // 2] if others else 0.0,
+                        1e-3)
+            if (count >= STRAGGLER_MIN_SAMPLES
+                    and p99 >= STRAGGLER_MIN_LATENESS
+                    and p99 >= STRAGGLER_SKEW_FACTOR * floor):
+                sev = ("critical" if p99 >= STRAGGLER_CRITICAL_LATENESS
+                       else "warning")
+                yield Diagnosis(
+                    rule="persistent_straggler", severity=sev,
+                    rank=int(label),
+                    summary=(f"coordinator waited ≥{_ms(p99)} (p99) for "
+                             f"this rank's tick over {count} cycles"),
+                    hint=(f"rank {label} is persistently ≥{_ms(p99)} late "
+                          f"at negotiation across {count} collectives; "
+                          "suspect its NIC, a co-tenant process, or its "
+                          "input pipeline"),
+                    evidence={"tick_lateness_p99_seconds": p99,
+                              "cycles": count,
+                              "cluster_median_p99_seconds": floor,
+                              "source": "tick_lateness"})
+
+
+def check_clock_sync(ev: Evidence) -> Iterator[Diagnosis]:
+    """Clock-offset table quality: an unsynced or high-uncertainty rank
+    silently degrades every downstream straggler attribution."""
+    clock: Dict[int, dict] = {}
+    if ev.clock:
+        clock = {int(r): e for r, e in sorted(ev.clock.items())}
+    elif ev.straggler_report and ev.straggler_report.get("clock"):
+        clock = {int(r): e for r, e in
+                 sorted(ev.straggler_report["clock"].items())}
+    if len(clock) < 2:
+        return
+    for rank in sorted(clock):
+        entry = clock[rank]
+        if rank == 0:
+            continue  # rank 0 IS the reference clock
+        if not entry.get("synced", False):
+            yield Diagnosis(
+                rule="clock_sync_degraded", severity="warning", rank=rank,
+                summary="never completed a clock ping-pong; merged traces "
+                        "rebase it with offset 0",
+                hint=(f"rank {rank}'s heartbeat path never returned a "
+                      "pong — straggler attribution involving it is "
+                      "unreliable; check that heartbeats flow "
+                      "(HOROVOD_HEARTBEAT_INTERVAL_SECONDS > 0) and that "
+                      "nothing drops frames between it and rank 0"),
+                evidence={"clock": entry})
+            continue
+        unc = entry.get("uncertainty_seconds")
+        if unc is not None and unc >= CLOCK_MAX_UNCERTAINTY:
+            yield Diagnosis(
+                rule="clock_sync_degraded", severity="warning", rank=rank,
+                summary=(f"clock offset uncertainty grew to {_ms(unc)} "
+                         "(min-RTT window polluted)"),
+                hint=(f"attribution finer than {_ms(unc)} against rank "
+                      f"{rank} is noise; the RTT floor rose — look for "
+                      "congestion or queueing between it and rank 0"),
+                evidence={"clock": entry})
+
+
+def check_recv_wait_skew(ev: Evidence) -> Iterator[Diagnosis]:
+    """One worker's control-plane recvs wait far longer than the cluster
+    median: its link (or the peer feeding it) is slow. Needs the rank-0
+    cluster view with ≥2 WORKER snapshots — the coordinator's own
+    recv-wait histogram is excluded on both sides of the comparison,
+    because in the star topology rank 0's recvs block waiting for the
+    slowest worker's tick: a sick worker inflates rank 0's profile, and
+    judging it would blame exactly the wrong rank (the tick-lateness
+    straggler rule owns that case)."""
+    per_rank: Dict[int, Tuple[float, int]] = {}
+    for rank in sorted(ev.snapshots):
+        if rank == 0:
+            continue
+        p99, count = _hist_quantile_and_count(
+            ev.snapshots[rank], "hvd_wire_recv_wait_seconds", 0.99)
+        if p99 is not None and count >= 20:
+            per_rank[rank] = (p99, count)
+    if len(per_rank) < 2:
+        return
+    for rank in sorted(per_rank):
+        p99, count = per_rank[rank]
+        # Median of the OTHER ranks' p99s (as in the live straggler
+        # rule): a whole-cluster median would include the outlier's own
+        # value and, at the documented 2-snapshot minimum, BE it —
+        # making the rule unable to ever fire on a 2-rank job.
+        others = sorted(p for r, (p, _) in per_rank.items() if r != rank)
+        median = others[len(others) // 2]
+        if (p99 >= RECV_WAIT_MIN_P99
+                and p99 >= RECV_WAIT_SKEW_FACTOR * max(median, 1e-3)):
+            yield Diagnosis(
+                rule="recv_wait_skew", severity="warning", rank=rank,
+                summary=(f"recv-wait p99 {_ms(p99)} vs cluster median "
+                         f"{_ms(median)} over {count} recvs"),
+                hint=(f"rank {rank} waits {p99 / max(median, 1e-9):.1f}x "
+                      "the cluster median for control frames; its NIC, "
+                      "its host, or the path to the coordinator is slow"),
+                evidence={"recv_wait_p99_seconds": p99,
+                          "cluster_median_p99_seconds": median,
+                          "recvs": count})
+
+
+def check_heartbeat_flapping(ev: Evidence) -> Iterator[Diagnosis]:
+    """Repeated liveness-deadline trips on a rank that is still alive:
+    heartbeats arrive in bursts with gaps — a flapping link or a starved
+    process, and the precursor of a spurious abort."""
+    trips_by_rank: Dict[int, float] = _series_totals(
+        ev.snapshots, "hvd_wire_deadline_trips_total")
+    for events in ev.postmortems:
+        for event in events:
+            if event.get("kind") == "deadline_trip" and "rank" in event:
+                rank = int(event["rank"])
+                trips_by_rank[rank] = trips_by_rank.get(rank, 0) + 1
+    for rank in sorted(trips_by_rank):
+        trips = int(trips_by_rank[rank])
+        if trips >= FLAPPING_MIN_TRIPS:
+            sev = ("critical" if trips >= FLAPPING_CRITICAL_TRIPS
+                   else "warning")
+            yield Diagnosis(
+                rule="heartbeat_flapping", severity=sev, rank=rank,
+                summary=(f"tripped its liveness deadline {trips} times "
+                         "without the job dying"),
+                hint=(f"rank {rank} sees heartbeat gaps longer than "
+                      "HOROVOD_COMM_TIMEOUT_SECONDS in bursts; look for "
+                      "GC/GIL pauses, CPU starvation by a co-tenant, or a "
+                      "flapping NIC — each trip is one missed frame away "
+                      "from a job abort"),
+                evidence={"deadline_trips": trips})
+
+
+def check_cache_hit_collapse(ev: Evidence) -> Iterator[Diagnosis]:
+    """Response-cache hit rate collapsed under real traffic. Expected
+    briefly after membership-relevant events (restart, abort, autotune
+    flipping the cache categorical); persistent collapse means the
+    negotiation fast path is off for the steady state."""
+    for rank in sorted(ev.snapshots):
+        snap = ev.snapshots[rank]
+        entry_h = snap.get("hvd_controller_cache_hits_total")
+        entry_m = snap.get("hvd_controller_cache_misses_total")
+        if entry_h is None and entry_m is None:
+            continue
+        hits = sum(v for _, v in (entry_h or {}).get("values", []))
+        misses = sum(v for _, v in (entry_m or {}).get("values", []))
+        total = hits + misses
+        if total < CACHE_MIN_TRAFFIC:
+            continue
+        rate = hits / total
+        if rate < CACHE_COLLAPSE_RATE:
+            membership = {}
+            if ev.restart_epoch:
+                membership["restart_epoch"] = ev.restart_epoch
+            aborts = _series_totals(
+                {rank: snap}, "hvd_controller_aborts_total").get(rank)
+            if aborts:
+                membership["aborts"] = aborts
+            yield Diagnosis(
+                rule="cache_hit_collapse", severity="warning", rank=rank,
+                summary=(f"response-cache hit rate {rate:.0%} over "
+                         f"{int(total)} requests"),
+                hint=("a re-warm after a restart/abort recovers on its "
+                      "own; a persistent collapse means tensor names do "
+                      "not repeat (dynamic graph or unnamed collectives) "
+                      "or HOROVOD_CACHE_CAPACITY is too small for the "
+                      "working set"
+                      + (" — this job shows membership churn: "
+                         f"{membership}" if membership else "")),
+                evidence={"hit_rate": round(rate, 4), "hits": hits,
+                          "misses": misses, **membership})
+
+
+def check_restart_churn(ev: Evidence) -> Iterator[Diagnosis]:
+    """The supervisor keeps relaunching the job: each restart replays
+    init + cache warmup, and a crash loop converges on zero useful
+    work."""
+    restarts = ev.restart_epoch
+    launcher = max(_series_totals(
+        ev.snapshots, "hvd_launcher_restarts_total").values(), default=0)
+    restarts = max(int(restarts), int(launcher))
+    if restarts >= RESTART_CHURN_MIN:
+        sev = ("critical" if restarts >= RESTART_CHURN_CRITICAL
+               else "warning")
+        yield Diagnosis(
+            rule="restart_churn", severity=sev,
+            summary=f"job is on restart epoch {restarts}",
+            hint=("the job is crash-looping under --max-restarts; read "
+                  "the flight-recorder postmortems (the dump tail names "
+                  "the dead rank and in-flight ops) and fix the "
+                  "recurring failure instead of raising the restart "
+                  "budget"),
+            evidence={"restart_epoch": restarts})
+
+
+def check_autotune_search(ev: Evidence) -> Iterator[Diagnosis]:
+    """The GP search itself can be the patient: a tuner that never
+    scores is stalled; one whose current configuration scores far below
+    its own best late in the search is wandering on noise."""
+    active = _gauge(ev.snapshots, "hvd_autotune_active")
+    if active is None or active < 1.0:
+        return
+    steps = _gauge(ev.snapshots, "hvd_autotune_steps_completed") or 0.0
+    if steps == 0:
+        # Zero steps is NORMAL early on (the tuner needs warmup + a full
+        # sample window of payload cycles before its first score); only
+        # a search still scoreless after a meaningful number of cycles
+        # is stalled — without this guard every autotuned job reports
+        # unhealthy from its very first /doctor scrape.
+        cycles = 0
+        for rank in sorted(ev.snapshots):
+            _, count = _hist_quantile_and_count(
+                ev.snapshots[rank], "hvd_controller_cycle_seconds", 0.5)
+            cycles = max(cycles, count)
+        if cycles >= AUTOTUNE_STALLED_MIN_CYCLES:
+            yield Diagnosis(
+                rule="autotune_stalled", severity="info",
+                summary=(f"autotune has scored no configuration after "
+                         f"{cycles} controller cycles"),
+                hint=("the tuner only scores cycles that execute payload "
+                      "bytes; if eager traffic is flowing and this "
+                      "persists, the controller is seeing empty cycles "
+                      "only"),
+                evidence={"steps_completed": 0,
+                          "cycles_observed": cycles})
+        return
+    last = None
+    best = _gauge(ev.snapshots, "hvd_autotune_best_objective")
+    for rank in sorted(ev.snapshots):
+        by_label = _counter_by_first_label(
+            ev.snapshots[rank], "hvd_autotune_objective")
+        if by_label:
+            last = by_label.get("score")
+            break
+    if (last is not None and best is not None and best > 0
+            and steps >= AUTOTUNE_WANDER_MIN_STEPS
+            and last < AUTOTUNE_WANDER_RATIO * best):
+        yield Diagnosis(
+            rule="autotune_wandering", severity="warning",
+            summary=(f"search moved to a configuration scoring "
+                     f"{last / best:.0%} of its own best after "
+                     f"{int(steps)} steps"),
+            hint=("the objective surface is noisy (timeshared host or "
+                  "stragglers distorting cycle timing); consider "
+                  "HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT to discount "
+                  "straggler noise, or pin the knobs you already trust "
+                  "via their HOROVOD_* env vars"),
+            evidence={"last_score": last, "best_score": best,
+                      "steps_completed": int(steps)})
+
+
+ALL_RULES = (
+    check_persistent_straggler,
+    check_clock_sync,
+    check_recv_wait_skew,
+    check_heartbeat_flapping,
+    check_cache_hit_collapse,
+    check_restart_churn,
+    check_autotune_search,
+)
+
+# Every rule slug the catalog can emit — the hvd_doctor_findings gauge
+# zeroes the full set each sweep so a healed finding visibly drops to 0.
+RULE_SLUGS = (
+    "persistent_straggler",
+    "clock_sync_degraded",
+    "recv_wait_skew",
+    "heartbeat_flapping",
+    "cache_hit_collapse",
+    "restart_churn",
+    "autotune_stalled",
+    "autotune_wandering",
+)
+
+
+def diagnose(ev: Evidence) -> List[Diagnosis]:
+    """Run every rule, dedupe (rule, rank) keeping the worse severity,
+    and return findings ordered most-severe first."""
+    best: Dict[Tuple[str, Optional[int]], Diagnosis] = {}
+    order = {s: i for i, s in enumerate(SEVERITIES)}
+    for rule in ALL_RULES:
+        for finding in rule(ev):
+            key = (finding.rule, finding.rank)
+            kept = best.get(key)
+            if kept is None or order[finding.severity] < order[kept.severity]:
+                best[key] = finding
+    return sorted(
+        best.values(),
+        key=lambda d: (order[d.severity], d.rule,
+                       -1 if d.rank is None else d.rank))

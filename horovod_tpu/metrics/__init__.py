@@ -28,7 +28,7 @@ import os
 import threading
 from typing import Dict, Optional
 
-from ..common.config import _env_bool, _env_int, env_rank
+from ..common.config import _env_bool, _env_int, env_rank, env_size
 from ..common.config import flight_recorder_path as _flight_recorder_path
 from .exporter import MetricsExporter, start_exporter  # noqa: F401
 from .recorder import FlightRecorder, expand_rank_path
@@ -182,13 +182,28 @@ def push_cycles() -> int:
     return max(1, _env_int("HOROVOD_METRICS_PUSH_CYCLES", 50))
 
 
+def _doctor_route():
+    """Lazy: the doctor package imports metrics, so the import must live
+    inside the request path, not at module scope."""
+    from .. import doctor
+
+    return doctor.http_body()
+
+
 def maybe_start_exporter(rank: int) -> Optional[MetricsExporter]:
     """Start this rank's endpoint at HOROVOD_METRICS_PORT + rank (None
-    when unset/garbage — snapshot() keeps working without it)."""
+    when unset/garbage — snapshot() keeps working without it). Every
+    rank's endpoint also serves ``GET /doctor`` (the cluster doctor's
+    JSON report) — most useful on rank 0, where the piggybacked worker
+    snapshots give the doctor the whole job."""
     base = _env_int("HOROVOD_METRICS_PORT", 0)
     if base <= 0:
         return None
-    return start_exporter(base + rank, render_all)
+    # On a bind collision, walk in steps of the job size so this rank's
+    # fallback never lands on (and displaces) a sibling rank's slot.
+    return start_exporter(base + rank, render_all,
+                          routes={"/doctor": _doctor_route},
+                          stride=max(1, env_size() or 1))
 
 
 # ---------------------------------------------------------------------------
@@ -249,23 +264,23 @@ def _counter_total(snap: Dict[str, dict], name: str) -> Optional[float]:
 
 def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
     """Compact controller-health summary (bench.py rows, dashboards):
-    cycle-time p50/p99, fused bytes, response-cache hit rate. Fields are
-    None when the series hasn't been populated (e.g. SPMD-only runs with
-    no eager controller)."""
+    cycle-time p50/p99, fused bytes, response-cache hit rate. On a fresh
+    registry — before the first controller cycle, or with any series
+    missing (e.g. SPMD-only runs with no eager controller) — every key
+    is still present with a 0 value: a well-formed all-zeros dict that
+    downstream consumers can index and chart without None-guards."""
     snap = snap if snap is not None else snapshot()
-    hits = _counter_total(snap, "hvd_controller_cache_hits_total")
-    misses = _counter_total(snap, "hvd_controller_cache_misses_total")
-    hit_rate = None
-    if hits is not None or misses is not None:
-        total = (hits or 0.0) + (misses or 0.0)
-        hit_rate = round((hits or 0.0) / total, 4) if total else None
+    hits = _counter_total(snap, "hvd_controller_cache_hits_total") or 0.0
+    misses = _counter_total(snap, "hvd_controller_cache_misses_total") or 0.0
+    total = hits + misses
+    hit_rate = round(hits / total, 4) if total else 0.0
     cycle = snap.get("hvd_controller_cycle_seconds")
-    p50 = quantile(cycle, 0.5)
-    p99 = quantile(cycle, 0.99)
+    p50 = quantile(cycle, 0.5) or 0.0
+    p99 = quantile(cycle, 0.99) or 0.0
     return {
-        "cycle_seconds_p50": round(p50, 6) if p50 is not None else None,
-        "cycle_seconds_p99": round(p99, 6) if p99 is not None else None,
+        "cycle_seconds_p50": round(p50, 6),
+        "cycle_seconds_p99": round(p99, 6),
         "fused_bytes_total": _counter_total(
-            snap, "hvd_controller_fused_bytes_total"),
+            snap, "hvd_controller_fused_bytes_total") or 0,
         "cache_hit_rate": hit_rate,
     }

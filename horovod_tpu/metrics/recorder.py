@@ -25,6 +25,8 @@ small dict per event, dropped from the left when the ring is full.
 from __future__ import annotations
 
 import json
+import os
+import threading
 import time
 from collections import deque
 from typing import Dict, Optional
@@ -104,9 +106,13 @@ class FlightRecorder:
     def dump(self, path: str, reason: str) -> Optional[str]:
         """Write header + ring (oldest first) as JSONL; returns the final
         path. Never raises — a failing dump must not mask the failure that
-        triggered it."""
+        triggered it. Concurrent dumps (an abort handler racing the
+        unclean-shutdown path) each write a private temp file and
+        atomically rename it into place, so the artifact is never a torn
+        interleaving — the last completed dump wins whole."""
         out = expand_rank_path(
             path, str(self.rank) if self.rank is not None else None)
+        tmp = f"{out}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
             events = self.events()
             header = {"kind": "flight_recorder_dump", "reason": reason,
@@ -114,14 +120,19 @@ class FlightRecorder:
                       "ts": round(time.time(), 6), "events": len(events)}
             if self.rank is not None:
                 header["rank"] = self.rank
-            with open(out, "w") as f:
+            with open(tmp, "w") as f:
                 f.write(json.dumps(header) + "\n")
                 for event in events:
                     f.write(json.dumps(event, default=str) + "\n")
+            os.replace(tmp, out)
             logging.warning("flight recorder: dumped %d event(s) to %s "
                             "(reason: %s)", len(events), out, reason)
             return out
         except Exception as exc:  # "never raises" is a hard contract here
             logging.error("flight recorder: dump to %s failed: %s",
                           out, exc)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None

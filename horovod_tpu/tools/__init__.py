@@ -2,6 +2,10 @@
 
 * ``straggler`` — merge a trace directory's per-rank files (if needed)
   and print/write the straggler-attribution report (docs/tracing.md).
+* ``doctor`` — run the cluster doctor's rule catalog over an artifact
+  directory (straggler report, clock offsets, flight-recorder dumps)
+  and print structured diagnoses with remediation hints
+  (docs/doctor.md).
 * ``lint`` — hvdlint: the AST-based distributed-correctness analyzer
   over the package source (rules HVD001..HVD007, suppressions,
   baseline; docs/static-analysis.md).

@@ -1,0 +1,71 @@
+"""Shared parent-side harness for the multi-process eager-tier tests.
+
+One copy of the "spawn N ranks of tests/mp_worker.py and collect their
+output" machinery (previously triplicated across test_metrics /
+test_trace / test_doctor): a fix to the launch env or the hang handling
+lands once, for every chaos/acceptance test.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "mp_worker.py")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_ranks(scenario, size=2, timeout=120.0, extra_env=None,
+              per_rank_env=None):
+    """Run ``size`` ranks of the given mp_worker scenario to completion;
+    returns each rank's combined stdout/stderr. Any rank hanging past
+    ``timeout`` kills the whole job; any nonzero exit fails with that
+    rank's output."""
+    addr = f"127.0.0.1:{free_port()}"
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_CONTROLLER_ADDR": addr,
+            "HOROVOD_ENGINE": "python",
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        env.update(extra_env or {})
+        env.update((per_rank_env or {}).get(rank, {}))
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, scenario], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    deadline = time.monotonic() + timeout
+    outputs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise AssertionError(
+                f"{scenario}: rank {rank} hung past the timeout")
+        outputs.append(out)
+    for rank, proc in enumerate(procs):
+        assert proc.returncode == 0, (
+            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
+            f"{outputs[rank]}")
+    return outputs

@@ -505,6 +505,59 @@ def scenario_metrics_cluster(rank, size):
     np.testing.assert_allclose(out, float(size))
 
 
+def scenario_doctor(rank, size):
+    # Cluster-doctor acceptance (tests/test_doctor.py): the parent sets a
+    # FaultPlan delaying every wire_send on rank 1, plus HOROVOD_TRACE_DIR
+    # and HOROVOD_METRICS_PORT. Rank 0 polls its own /doctor endpoint
+    # until the persistent-straggler rule names rank 1 from the LIVE
+    # evidence (the coordinator's tick-lateness histogram); the offline
+    # half of the acceptance — python -m horovod_tpu.tools.doctor over
+    # the artifact dir — runs in the parent after the lockstep shutdown
+    # has written straggler_report.json.
+    import json as _json
+    import time as _time
+    import urllib.request
+
+    for i in range(30):
+        out = np.asarray(hvd.allreduce(np.ones(16, np.float32) * i,
+                                       average=False, name=f"dr.{i}"))
+        np.testing.assert_allclose(out, float(size) * i)
+    if rank == 0:
+        port = int(os.environ["HOROVOD_METRICS_PORT"])
+        deadline = _time.monotonic() + 60
+        named = None
+        while _time.monotonic() < deadline:
+            try:
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/doctor", timeout=5
+                ).read().decode()
+            except OSError:
+                # The exporter walks to the next free port on a bind
+                # collision (start_exporter) — keep polling rather than
+                # crash on a transient refusal; the 60s deadline still
+                # produces the explicit failure message below.
+                _time.sleep(0.5)
+                continue
+            report = _json.loads(body)
+            hits = [f for f in report["findings"]
+                    if f["rule"] == "persistent_straggler"
+                    and f["rank"] == 1]
+            if hits:
+                named = hits[0]
+                break
+            _time.sleep(0.5)  # controllers keep ticking; evidence grows
+        expect(named is not None,
+               "live /doctor endpoint never produced a persistent-"
+               "straggler finding naming rank 1")
+        print("DOCTOR_HTTP " + _json.dumps(named), flush=True)
+    # Barrier: every worker's controller keeps ticking (and rank 1 keeps
+    # arriving late) until rank 0 has its live verdict.
+    out = np.asarray(hvd.allreduce(np.ones(2, np.float32), average=False,
+                                   name="dr.done"))
+    np.testing.assert_allclose(out, float(size))
+    hvd.shutdown()  # lockstep trace finalize -> straggler_report.json
+
+
 def scenario_stall(rank, size):
     # Reference test/test_stall.py: one rank joins late; the coordinator must
     # warn (HOROVOD_STALL_CHECK_TIME_SECONDS=1 set by the parent) and the op
@@ -1190,6 +1243,7 @@ SCENARIOS = {
     "fault_metrics": scenario_fault_metrics,
     "metrics_cluster": scenario_metrics_cluster,
     "trace": scenario_trace,
+    "doctor": scenario_doctor,
     "allreduce": scenario_allreduce,
     "fusion": scenario_fusion,
     "allgather": scenario_allgather,

@@ -2,6 +2,7 @@
 its CI never unit-tests the GP directly — we do)."""
 
 import numpy as np
+import pytest
 
 from horovod_tpu.common.autotune import (
     BayesianOptimizer,
@@ -145,6 +146,143 @@ def test_make_parameter_manager_env_fixes_knobs(monkeypatch):
     pm2 = make_parameter_manager(Config.from_env())
     assert {"hierarchical_allreduce", "hierarchical_allgather",
             "cache_enabled"} <= pm2.fixed
+
+
+def test_blended_objective_ranks_lower_slack_strictly_higher():
+    """Acceptance (ROADMAP item 5): with HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT
+    in play, two configurations with IDENTICAL throughput must rank
+    strictly by their injected slack — lower slack wins."""
+    # The pure blend function is strictly decreasing in both penalties.
+    clean = ParameterManager.blend(1e9, 0.0, 0.0, 1.0)
+    slacky = ParameterManager.blend(1e9, 0.4, 0.0, 1.0)
+    waity = ParameterManager.blend(1e9, 0.0, 0.4, 1.0)
+    assert clean > slacky and clean > waity
+    assert ParameterManager.blend(1e9, 0.2, 0.0, 1.0) > slacky
+
+    # End-to-end through record(): configuration 1 is scored with heavy
+    # slack, configuration 2 with none, at the same bytes/sec — the
+    # manager's best must move to configuration 2, strictly higher.
+    pm = ParameterManager(64 << 20, 5.0, seed=11, straggler_weight=1.0)
+    out = None
+    while out is None:
+        out = pm.record(1 << 20, 0.005, slack_seconds=0.002,
+                        recv_wait_seconds=0.001)
+    first = dict(pm.last_objective)
+    assert first["slack_penalty"] == pytest.approx(0.4)
+    assert first["recv_wait_penalty"] == pytest.approx(0.2)
+    assert first["score"] < first["throughput_bytes_per_sec"]
+    out = None
+    while out is None:
+        out = pm.record(1 << 20, 0.005)  # identical throughput, no slack
+    second = dict(pm.last_objective)
+    assert second["throughput_bytes_per_sec"] == \
+        pytest.approx(first["throughput_bytes_per_sec"])
+    assert second["score"] > first["score"]  # strictly higher
+    assert pm.best_objective == second  # best moved to the clean config
+
+
+def test_straggler_weight_zero_keeps_pure_throughput_objective():
+    pm = ParameterManager(64 << 20, 5.0, seed=2)  # default weight 0
+    out = None
+    while out is None:
+        out = pm.record(1 << 20, 0.005, slack_seconds=0.004,
+                        recv_wait_seconds=0.004)
+    assert pm.last_objective["slack_penalty"] == 0.0
+    assert pm.last_objective["recv_wait_penalty"] == 0.0
+    assert pm.last_objective["score"] == pytest.approx(
+        pm.last_objective["throughput_bytes_per_sec"])
+
+
+def test_parameter_manager_state_for_gauges():
+    pm = ParameterManager(64 << 20, 5.0, seed=3, straggler_weight=0.5)
+    state = pm.state()
+    assert state["active"] is True
+    assert state["steps_completed"] == 0
+    assert state["steps_remaining"] == pm.BO_MAX_STEPS
+    assert state["last_objective"] is None
+    for _ in range(13):
+        pm.record(1 << 20, 0.005, slack_seconds=0.0005)
+    state = pm.state()
+    assert state["steps_completed"] == 1
+    assert state["steps_remaining"] == pm.BO_MAX_STEPS - 1
+    assert state["straggler_weight"] == 0.5
+    assert state["last_objective"]["score"] > 0
+    import json
+
+    assert state == json.loads(json.dumps(state))  # JSON-clean
+
+
+def test_make_parameter_manager_straggler_weight_env(monkeypatch):
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.controller.autotune_glue import make_parameter_manager
+
+    monkeypatch.delenv("HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT", raising=False)
+    assert make_parameter_manager(
+        Config.from_env()).straggler_weight == 1.0  # on by default
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT", "2.5")
+    assert make_parameter_manager(
+        Config.from_env()).straggler_weight == 2.5
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT", "0")
+    assert make_parameter_manager(
+        Config.from_env()).straggler_weight == 0.0  # explicit opt-out
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STRAGGLER_WEIGHT", "-3")
+    assert make_parameter_manager(
+        Config.from_env()).straggler_weight == 1.0  # garbage -> default
+
+
+def test_publish_tuner_gauges_mirrors_state():
+    from horovod_tpu import metrics
+    from horovod_tpu.controller.autotune_glue import publish_tuner_gauges
+
+    metrics.reset_for_tests()
+    metrics.enable()
+    try:
+        pm = ParameterManager(64 << 20, 5.0, seed=6, straggler_weight=1.0)
+        for _ in range(13):
+            pm.record(1 << 20, 0.005, slack_seconds=0.001)
+        publish_tuner_gauges(pm)
+        snap = metrics.snapshot()
+
+        def gauge(name):
+            return snap[name]["values"][0][1]
+
+        assert gauge("hvd_autotune_active") == 1.0
+        assert gauge("hvd_autotune_steps_completed") == 1
+        assert gauge("hvd_autotune_steps_remaining") == pm.BO_MAX_STEPS - 1
+        assert gauge("hvd_autotune_fusion_threshold_bytes") == \
+            pm.fusion_threshold
+        assert gauge("hvd_autotune_best_cycle_time_ms") == \
+            pm.best_cycle_time_ms
+        objective = dict((tuple(k)[0], v) for k, v in
+                         snap["hvd_autotune_objective"]["values"])
+        assert objective["score"] == pytest.approx(
+            pm.last_objective["score"])
+        assert objective["slack_penalty"] == pytest.approx(
+            pm.last_objective["slack_penalty"])
+        assert gauge("hvd_autotune_best_objective") == pytest.approx(
+            pm.best_objective["score"])
+    finally:
+        metrics.reset_for_tests()
+
+
+def test_autotune_log_records_objective_components(tmp_path):
+    log = tmp_path / "autotune.csv"
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          log_path=str(log), seed=8, straggler_weight=1.0)
+    for _ in range(13):
+        pm.record(1 << 20, 0.005, slack_seconds=0.001,
+                  recv_wait_seconds=0.0005)
+    header, row = log.read_text().strip().splitlines()[:2]
+    cols = header.split(",")
+    # Component columns sit between the categoricals and the blended
+    # score (which stays the LAST column — the r3 log contract).
+    assert cols[-4:] == ["throughput_bytes_per_sec", "slack_penalty",
+                         "recv_wait_penalty", "score_bytes_per_sec"]
+    values = dict(zip(cols, row.split(",")))
+    assert float(values["slack_penalty"]) == pytest.approx(0.2)
+    assert float(values["recv_wait_penalty"]) == pytest.approx(0.1)
+    assert float(values["score_bytes_per_sec"]) < \
+        float(values["throughput_bytes_per_sec"])
 
 
 def test_parameter_manager_log(tmp_path):

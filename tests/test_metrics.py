@@ -7,22 +7,21 @@ counter + postmortem JSONL naming the dead rank).
 import json
 import os
 import socket
-import subprocess
-import sys
 import threading
-import time
 import urllib.error
 import urllib.request
 
 import numpy as np
 import pytest
 
+from mp_harness import free_port as _free_port
+from mp_harness import run_ranks as _run_ranks
+
 from horovod_tpu import metrics
 from horovod_tpu.metrics import MetricsRegistry, render_prometheus
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-WORKER = os.path.join(HERE, "mp_worker.py")
 GOLDEN = os.path.join(HERE, "golden", "metrics_exposition.golden")
 
 
@@ -179,6 +178,27 @@ def test_quantile_estimation():
     assert metrics.quantile(r.snapshot()["hvd_q2_seconds"], 0.5) is None
 
 
+def test_controller_health_fresh_registry_is_well_formed_zeros():
+    """Before the first controller cycle (or on SPMD-only runs) every
+    key must be present with a zero value — consumers index the dict
+    without None-guards."""
+    health = metrics.controller_health()
+    assert health == {"cycle_seconds_p50": 0.0, "cycle_seconds_p99": 0.0,
+                      "fused_bytes_total": 0, "cache_hit_rate": 0.0}
+    # Partial population zero-fills the missing series, including a
+    # registered-but-empty histogram and a 0/0 hit rate.
+    metrics.enable()
+    metrics.counter("hvd_controller_cache_misses_total").inc(0)
+    metrics.histogram("hvd_controller_cycle_seconds",
+                      buckets=(0.001, 0.01, 0.1))
+    health = metrics.controller_health()
+    assert health["cycle_seconds_p50"] == 0.0
+    assert health["cycle_seconds_p99"] == 0.0
+    assert health["fused_bytes_total"] == 0
+    assert health["cache_hit_rate"] == 0.0
+    assert health == json.loads(json.dumps(health))
+
+
 def test_controller_health_summary():
     metrics.enable()
     metrics.counter("hvd_controller_cache_hits_total").inc(30)
@@ -317,6 +337,51 @@ def test_maybe_start_exporter_port_offset_and_unset(monkeypatch):
             exp.close()
 
 
+def test_start_exporter_port_collision_retries_and_warns():
+    """Satellite: two jobs sharing a host both compute base+rank. The
+    loser must come up on the next free port with ONE WARNING naming the
+    port actually serving, not die (or silently vanish) at init."""
+    import logging as pylogging
+
+    from horovod_tpu.common import hvd_logging
+
+    metrics.enable()
+    metrics.counter("hvd_collide_total").inc(3)
+    occupier = socket.socket()
+    occupier.bind(("", 0))
+    occupier.listen(1)
+    port = occupier.getsockname()[1]
+    msgs = []
+    cap = pylogging.Handler()
+    cap.emit = lambda record: msgs.append(record.getMessage())
+    hvd_logging.configure("warning")
+    hvd_logging._logger.addHandler(cap)
+    try:
+        exp = metrics.start_exporter(port, metrics.render_all)
+        assert exp is not None
+        assert exp.port != port  # walked off the occupied port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{exp.port}/metrics", timeout=5
+        ).read().decode()
+        assert "hvd_collide_total 3" in body
+        warned = [m for m in msgs if "metrics exporter" in m]
+        assert len(warned) == 1, msgs
+        assert str(exp.port) in warned[0] and str(port) in warned[0]
+        exp.close()
+        # Per-rank ranges walk in steps of the job size (stride), so a
+        # displaced rank jumps PAST its siblings' slots instead of
+        # stealing the next rank's port. >= rather than == : some other
+        # process may hold port+4 too, in which case walking further —
+        # still on the stride grid — is the correct behavior.
+        exp2 = metrics.start_exporter(port, metrics.render_all, stride=4)
+        assert exp2 is not None and exp2.port > port
+        assert (exp2.port - port) % 4 == 0
+        exp2.close()
+    finally:
+        hvd_logging._logger.removeHandler(cap)
+        occupier.close()
+
+
 def test_cluster_view_renders_remote_snapshots(monkeypatch):
     monkeypatch.setenv("HOROVOD_RANK", "0")
     metrics.enable()
@@ -427,56 +492,6 @@ def test_timeline_drops_counted_warned_and_stamped(tmp_path):
 # ---------------------------------------------------------------------------
 # Multi-process chaos acceptance: FaultPlan drop rules -> deadline-trip
 # counter increments + flight-recorder JSONL names the dead rank.
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _run_ranks(scenario, size=2, timeout=90.0, extra_env=None,
-               per_rank_env=None):
-    addr = f"127.0.0.1:{_free_port()}"
-    procs = []
-    for rank in range(size):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(size),
-            "HOROVOD_CONTROLLER_ADDR": addr,
-            "HOROVOD_ENGINE": "python",
-            "HOROVOD_CYCLE_TIME": "1",
-        })
-        env.update(extra_env or {})
-        env.update((per_rank_env or {}).get(rank, {}))
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    deadline = time.monotonic() + timeout
-    outputs = []
-    for rank, proc in enumerate(procs):
-        try:
-            out, _ = proc.communicate(
-                timeout=max(1.0, deadline - time.monotonic()))
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise AssertionError(
-                f"{scenario}: rank {rank} hung past the timeout")
-        outputs.append(out)
-    for rank, proc in enumerate(procs):
-        assert proc.returncode == 0, (
-            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
-            f"{outputs[rank]}")
-    return outputs
 
 
 def _parse_snapshot(output):

@@ -84,6 +84,18 @@ def test_known_series_present():
         "hvd_launcher_restarts_total",
         "hvd_negotiation_slack_seconds",
         "hvd_straggler_cycles_total",
+        "hvd_controller_tick_lateness_seconds",
+        "hvd_doctor_runs_total",
+        "hvd_doctor_findings",
+        "hvd_autotune_active",
+        "hvd_autotune_steps_completed",
+        "hvd_autotune_steps_remaining",
+        "hvd_autotune_fusion_threshold_bytes",
+        "hvd_autotune_cycle_time_ms",
+        "hvd_autotune_best_fusion_threshold_bytes",
+        "hvd_autotune_best_cycle_time_ms",
+        "hvd_autotune_objective",
+        "hvd_autotune_best_objective",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
 
@@ -177,6 +189,8 @@ def test_no_import_time_registration():
                         "horovod_tpu.controller.controller",
                         "horovod_tpu.run.launch",
                         "horovod_tpu.trace.straggler",
+                        "horovod_tpu.doctor",
+                        "horovod_tpu.controller.autotune_glue",
                         "horovod_tpu.metrics"):
         assert instrumented not in skipped, (
             f"{instrumented} failed to import: {report['skipped']}")

@@ -15,6 +15,8 @@ import time
 import numpy as np  # noqa: F401  (parity with the other mp test modules)
 import pytest
 
+from mp_harness import run_ranks as _run_ranks
+
 from horovod_tpu import metrics
 from horovod_tpu import trace as hvd_trace
 from horovod_tpu.trace import (
@@ -28,7 +30,6 @@ from horovod_tpu.trace import (
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
-WORKER = os.path.join(HERE, "mp_worker.py")
 GOLDEN = os.path.join(HERE, "golden", "merged_trace.golden")
 
 
@@ -316,6 +317,83 @@ def test_attribution_summary_empty_without_data():
                                    "worst_rank": None}
 
 
+def test_attribution_exact_tie_all_ranks_blames_nobody():
+    """All ranks arrive at the identical corrected timestamp: slack is
+    exactly 0 — measured, but below any epsilon, so nobody is blamed."""
+    report = attribute(_synthetic_merged(late_us=0), feed=False)
+    assert report["collectives"] == 10
+    assert report["slack_max_seconds"] == 0.0
+    assert all(stats["straggler_cycles"] == 0
+               for stats in report["per_rank"].values())
+    assert report["worst_collectives"] == []
+
+
+def test_attribution_tie_between_two_late_ranks_is_deterministic():
+    """Two ranks tied for LAST above the epsilon: the blame must land on
+    one deterministic rank (the tie-break is by rank id), not flip-flop
+    between runs or ranks."""
+    events = _synthetic_merged(late_rank=2, late_us=500)
+    for ev in events:
+        # Make rank 1 exactly as late as rank 2 at every negotiation.
+        if ev.get("name") == "negotiate" and ev["pid"] == 1:
+            ev["ts"] += 500
+    report = attribute(events, feed=False)
+    assert report["collectives"] == 10
+    assert report["per_rank"]["2"]["straggler_cycles"] == 10
+    assert report["per_rank"]["1"]["straggler_cycles"] == 0
+    assert report["worst_rank"] == 2
+    assert all(w["straggler"] == 2 for w in report["worst_collectives"])
+    # Both late ranks still show the same lateness distribution — the
+    # tie-break decides blame, not the measurements.
+    assert report["per_rank"]["1"]["lateness_p99_seconds"] == \
+        report["per_rank"]["2"]["lateness_p99_seconds"]
+
+
+def test_attribution_epsilon_boundary_slack():
+    """slack == epsilon is clock noise (not blamed); the first value
+    strictly above the epsilon is. Timestamps are chosen so the slack is
+    float-exact (0.5s), making the boundary comparison exact too."""
+    def span(rank, ts, seq):
+        return {"name": "negotiate", "ph": "X", "pid": rank, "tid": 2,
+                "ts": ts, "dur": 100, "args": {"seq": seq, "op": "t"}}
+
+    events = []
+    for seq in range(3):
+        base = seq * 2_000_000  # /1e6 -> exact small integers
+        events += [span(0, base, seq), span(1, base, seq),
+                   span(2, base + 500_000, seq)]
+    at_eps = attribute(events, epsilon=0.5, feed=False)
+    assert at_eps["slack_max_seconds"] == 0.5
+    assert at_eps["per_rank"]["2"]["straggler_cycles"] == 0
+    assert at_eps["worst_collectives"] == []
+    above_eps = attribute(events, epsilon=0.499, feed=False)
+    assert above_eps["per_rank"]["2"]["straggler_cycles"] == 3
+    assert above_eps["worst_rank"] == 2
+
+
+def test_attribution_single_rank_job_report_is_empty():
+    """A single-rank job has nobody to straggle behind: the report must
+    be empty — no collectives, no worst rank, no self-attribution — and
+    must feed nothing into the metrics registry."""
+    metrics.enable()
+    events = [{"name": "clock_sync", "ph": "M", "pid": 0,
+               "args": {"rank": 0, "applied_offset_seconds": 0.0,
+                        "uncertainty_seconds": 0.0, "synced": True}}]
+    for seq in range(10):
+        events.append({"name": "negotiate", "ph": "X", "pid": 0, "tid": 2,
+                       "ts": 10_000 + seq * 5_000, "dur": 100,
+                       "args": {"seq": seq, "op": f"t.{seq}"}})
+    report = attribute(events)
+    assert report["collectives"] == 0
+    assert report["worst_rank"] is None
+    assert report["worst_collectives"] == []
+    assert report["per_rank"]["0"]["straggler_cycles"] == 0
+    assert report["slack_max_seconds"] is None
+    snap = metrics.snapshot()
+    assert "hvd_negotiation_slack_seconds" not in snap
+    assert "hvd_straggler_cycles_total" not in snap
+
+
 # ---------------------------------------------------------------------------
 # Wire-level clock ping-pong (piggybacked on HEARTBEAT frames)
 
@@ -385,54 +463,6 @@ def test_tools_straggler_cli_merges_and_reports(tmp_path):
 
 # ---------------------------------------------------------------------------
 # Multi-process acceptance
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _run_ranks(scenario, size=3, timeout=120.0, extra_env=None):
-    addr = f"127.0.0.1:{_free_port()}"
-    procs = []
-    for rank in range(size):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(size),
-            "HOROVOD_CONTROLLER_ADDR": addr,
-            "HOROVOD_ENGINE": "python",
-            "HOROVOD_CYCLE_TIME": "1",
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    deadline = time.monotonic() + timeout
-    outputs = []
-    for rank, proc in enumerate(procs):
-        try:
-            out, _ = proc.communicate(
-                timeout=max(1.0, deadline - time.monotonic()))
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise AssertionError(
-                f"{scenario}: rank {rank} hung past the timeout")
-        outputs.append(out)
-    for rank, proc in enumerate(procs):
-        assert proc.returncode == 0, (
-            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
-            f"{outputs[rank]}")
-    return outputs
 
 
 def _parse_snapshot(output):
