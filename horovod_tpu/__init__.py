@@ -66,4 +66,5 @@ from . import parallel  # noqa: F401
 from . import metrics  # noqa: F401  (hvd.metrics.snapshot() et al.)
 from . import trace  # noqa: F401  (hvd.trace.summary() / merge tooling)
 from . import doctor  # noqa: F401  (hvd.doctor.report() / rule catalog)
+from . import elastic  # noqa: F401  (hvd.elastic.run / State, docs/elastic.md)
 from .common import profiler  # noqa: F401

@@ -344,6 +344,15 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         engine = config_mod.engine()
         if engine is None:
             engine = "native" if ring_data_plane_enabled() else "python"
+        if config_mod.elastic_enabled() and engine == "native":
+            # Elastic membership lives in the Python controller (the native
+            # engine's ring is fixed-membership); the pin must be identical
+            # on every rank — it derives from launcher-exported env, so it
+            # is. horovodrun --elastic already exports the python engine.
+            logging.warning(
+                "HOROVOD_ELASTIC=1 requires the python controller engine; "
+                "overriding the native engine selection (docs/elastic.md)")
+            engine = "python"
         use_native = topology.size > 1 and engine == "native"
         if config.timeline_filename and topology.rank == 0 and not use_native:
             # Native engine writes the timeline itself (C++ writer thread).
@@ -368,6 +377,19 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
             topology.local_size, topology.local_num_devices,
             topology.num_devices,
         )
+
+
+def replace_topology(topology: Topology) -> None:
+    """Elastic-reshape hook (``controller/controller.py``): swap the global
+    state's topology after a membership change so ``hvd.rank()``/
+    ``hvd.size()`` and the log prefix track the re-formed world. Runs on
+    the controller thread (or the init thread for a joiner's admission);
+    deliberately lock-free — the topology reference swap is atomic and
+    ``_state_lock`` may be held by the very ``init()`` that is admitting
+    a joiner."""
+    if _state is not None:
+        _state.topology = topology
+    logging.set_rank(topology.rank)
 
 
 def shutdown() -> None:

@@ -231,6 +231,40 @@ def doctor_cycles() -> int:
     return max(0, val)
 
 
+def elastic_enabled() -> bool:
+    """``HOROVOD_ELASTIC``: opt-in elastic membership (docs/elastic.md).
+    When set, a dead rank triggers a coordinator-led reshape (survivors
+    re-form at a bumped membership epoch) instead of a job-wide abort,
+    and late worker hellos are admitted at the next epoch boundary.
+    Unset, behavior is identical to the static fault-tolerance contract
+    (docs/fault-tolerance.md)."""
+    return _env_bool("HOROVOD_ELASTIC")
+
+
+def elastic_join() -> bool:
+    """``HOROVOD_ELASTIC_JOIN``: this worker is a late joiner — it sends
+    a JOIN hello to a live coordinator and waits for its (rank, size,
+    epoch) assignment instead of taking part in the initial rendezvous.
+    Exported by ``horovodrun --elastic`` when it respawns a dead worker
+    slot."""
+    return _env_bool("HOROVOD_ELASTIC_JOIN")
+
+
+def elastic_min_ranks() -> int:
+    """``HOROVOD_ELASTIC_MIN_RANKS``: smallest world size an elastic
+    reshape may re-form (coordinator included). Below it the job aborts
+    exactly like the non-elastic path. Default 1 — the coordinator keeps
+    going alone if it must."""
+    return max(1, _env_int("HOROVOD_ELASTIC_MIN_RANKS", 1))
+
+
+def elastic_max_ranks() -> int:
+    """``HOROVOD_ELASTIC_MAX_RANKS``: largest world size joiners may grow
+    the job to; joiners beyond it stay parked until a slot frees. 0 (the
+    default) means unbounded."""
+    return max(0, _env_int("HOROVOD_ELASTIC_MAX_RANKS", 0))
+
+
 def fault_plan_raw() -> Optional[str]:
     """``HOROVOD_FAULT_PLAN``: inline JSON or ``@file`` reference for the
     deterministic fault-injection plan; None/blank disables."""

@@ -51,7 +51,7 @@ from ..common.message import (
 )
 from ..common.response_cache import ResponseCache
 from ..common.topology import Topology
-from ..common.wire import RemoteAbortError
+from ..common.wire import RanksChangedError, RemoteAbortError
 from .. import fault
 from .. import metrics
 from .service import CoordinatorService, PeerFailureError, WorkerClient
@@ -111,6 +111,38 @@ def _ctl_metrics():
                 "and the autotune objective consume.", ("rank",)),
         )
     return _m
+
+
+_em = None
+
+
+def _elastic_metrics():
+    """Membership series (docs/elastic.md), registered lazily and only
+    once an elastic job actually exists — static jobs never expose them."""
+    global _em
+    if _em is None:
+        from types import SimpleNamespace
+
+        _em = SimpleNamespace(
+            epoch=metrics.gauge(
+                "hvd_membership_epoch",
+                "Current membership epoch (1 at rendezvous; bumped by "
+                "every elastic reshape)."),
+            transitions=metrics.counter(
+                "hvd_membership_transitions_total",
+                "Elastic membership transitions, by direction.", ("kind",)),
+            reshape_seconds=metrics.histogram(
+                "hvd_elastic_reshape_seconds",
+                "Wall time of one elastic reshape: failure detection to "
+                "re-formed lockstep (assignment broadcast + ack drain + "
+                "epoch drain)."),
+            departures=metrics.counter(
+                "hvd_membership_rank_departures_total",
+                "Ranks lost to elastic reshapes, by the departing rank's "
+                "old global rank — the doctor's flapping-rank signal.",
+                ("rank",)),
+        )
+    return _em
 
 
 class _Pending:
@@ -179,13 +211,35 @@ class Controller:
         self._metrics_push_cycles = metrics.push_cycles()
         self._cycles_since_push = 0
 
+        # Elastic membership (docs/elastic.md): versioned epoch, and a
+        # fence that fails ops enqueued BETWEEN a reshape's drain and the
+        # user's acknowledgement (hvd.elastic.run clearing it before the
+        # restore) — without it a rank that slipped an enqueue in right
+        # after the drain would negotiate a tensor no other rank knows
+        # about and hang the new epoch.
+        self._elastic = config_mod.elastic_enabled()
+        self._elastic_max = (config_mod.elastic_max_ranks()
+                             if self._elastic else 0)
+        self._epoch = 1
+        self._reshape_fence: Optional[RanksChangedError] = None
+
         # Native ring data plane (C++ core): enabled when the launcher
         # exported per-rank ring addresses and HOROVOD_CPU_OPS != "star".
         # Init failure is fatal, not a fallback: path selection must be
         # identical on every rank or the lockstep data phases deadlock.
         self._ring = None
         ring_addrs = config_mod.ring_addrs()
-        if topology.size > 1 and ring_data_plane_enabled():
+        if self._elastic and topology.size > 1 and (
+                ring_data_plane_enabled() or config.hierarchical_allreduce
+                or config.hierarchical_allgather):
+            # The ring backends are fixed-membership by construction (every
+            # member binds a pre-assigned address); elastic jobs stay on
+            # the star data plane, whose endpoints survive a reshape.
+            logging.warning(
+                "elastic: ring/hierarchical data planes are static-"
+                "membership; using the TCP star data plane")
+        if (topology.size > 1 and ring_data_plane_enabled()
+                and not self._elastic):
             from ..common.wire import job_secret
             from ..core.bindings import RingBackend
 
@@ -211,7 +265,8 @@ class Controller:
         if ((config.hierarchical_allreduce or config.hierarchical_allgather
              or config.autotune)
                 and topology.local_size > 1 and topology.cross_size > 1
-                and config_mod.cpu_ops() != "star"):
+                and config_mod.cpu_ops() != "star"
+                and not self._elastic):
             # HOROVOD_CPU_OPS=star is the operator's native-ring escape
             # hatch; it must disable the hierarchical rings too. Autotune
             # builds the rings even when the flag starts off so the
@@ -271,12 +326,30 @@ class Controller:
             # name -> {rank: Request}; plus first-seen stamps for stall check.
             self._message_table: Dict[str, Dict[int, Request]] = {}
             self._first_seen: Dict[str, float] = {}
+            if self._elastic:
+                self._service.start_join_listener()
+                if metrics.on():
+                    _elastic_metrics().epoch.set(self._epoch)
             self._service.start_heartbeats(config.heartbeat_interval_seconds)
         else:
             self._service = None
+            joining = self._elastic and config_mod.elastic_join()
             self._client = WorkerClient(
                 addr, topology.rank,
-                comm_timeout=config.comm_timeout_seconds)
+                comm_timeout=config.comm_timeout_seconds, join=joining)
+            if joining:
+                # Late joiner: the assignment (first frame) IS our identity
+                # — the env-derived provisional topology is discarded.
+                assignment = self._client.await_assignment()
+                self._epoch = assignment.epoch
+                self._set_topology(assignment.rank, assignment.size)
+                self._client.wire.send_join({"ack": assignment.epoch})
+                logging.info(
+                    "elastic: joined the job at membership epoch %d as "
+                    "rank %d of %d", assignment.epoch, assignment.rank,
+                    assignment.size)
+                if metrics.on():
+                    _elastic_metrics().epoch.set(self._epoch)
             self._client.start_heartbeats(config.heartbeat_interval_seconds)
 
         # Cluster tracing (docs/tracing.md): per-rank clock-anchored span
@@ -298,17 +371,19 @@ class Controller:
                 1, _env_int("HOROVOD_CLOCK_SYNC_CYCLES", 100))
             try:
                 os.makedirs(config.trace_dir, exist_ok=True)
+                # self.topo, not the env-derived local: a joiner's rank
+                # came from its admission assignment above.
                 self._tracer = TraceWriter(
-                    rank_trace_path(config.trace_dir, topology.rank),
-                    topology.rank)
+                    rank_trace_path(config.trace_dir, self.topo.rank),
+                    self.topo.rank)
             except OSError as exc:
                 # The shutdown trace exchange still runs (the predicate is
                 # the env-derived _trace_enabled, identical on every rank);
                 # this rank just contributes an empty blob.
                 logging.error(
                     "trace: cannot write under %s (%s); rank %d will "
-                    "record no spans", config.trace_dir, exc, topology.rank)
-            if topology.rank == 0:
+                    "record no spans", config.trace_dir, exc, self.topo.rank)
+            if self.topo.rank == 0:
                 self._clock = ClockSync(topology.size)
                 for worker_rank, wire in sorted(self._service.wires.items()):
                     wire.set_clock_callback(
@@ -363,6 +438,14 @@ class Controller:
                     or self._failure is not None):
                 handle.set_error(self._failure or ShutdownError(
                     "Horovod has been shut down"))
+                return handle
+            if self._reshape_fence is not None:
+                # Membership changed under this caller's feet: fail the op
+                # with the same retryable error its in-flight siblings got,
+                # until hvd.elastic.run acknowledges the reshape — a lone
+                # post-drain enqueue would otherwise negotiate a tensor no
+                # peer rank knows about and hang the new epoch.
+                handle.set_error(self._reshape_fence)
                 return handle
             if name in self._table:
                 # Reference IncrementTensorCount duplicate-name error
@@ -493,7 +576,35 @@ class Controller:
                 started = time.monotonic()
                 if self.timeline:
                     self.timeline.mark_cycle_start()
-                self._cycle()
+                try:
+                    if (self._elastic and self._service is not None
+                            and self._service.has_pending_joiners()
+                            and (self._elastic_max == 0
+                                 or self.topo.size < self._elastic_max)):
+                        # Epoch boundary: absorb parked joiners before the
+                        # next cycle's tick exchange. The capacity guard
+                        # matters: at max-ranks a parked joiner must WAIT
+                        # (an unconditional reshape here would admit
+                        # nobody yet bump the epoch and drain in-flight
+                        # work every single cycle — a livelock).
+                        self._elastic_reshape(set())
+                    self._cycle()
+                except PeerFailureError as exc:
+                    # Coordinator side: with elastic on, a dead worker
+                    # re-forms the world instead of failing it (the method
+                    # re-raises when the survivors fall below min-ranks);
+                    # without it, identical to the static abort path.
+                    if not self._elastic or self._service is None:
+                        raise
+                    self._elastic_reshape({exc.rank}, cause=exc)
+                    continue
+                except RanksChangedError as exc:
+                    # Worker side: the coordinator re-formed the world and
+                    # a RESHAPE frame tore us out of the dead epoch.
+                    if not self._elastic or self._client is None:
+                        raise
+                    self._apply_reshape(exc)
+                    continue
                 if self.topo.rank != 0:
                     # Workers pace the lockstep; the coordinator is paced by
                     # their arrivals (reference sleeps cycle_time in every
@@ -1084,6 +1195,122 @@ class Controller:
                                  inflight=[e.name for e in entries[:16]],
                                  last_seq=self._trace_last_seq)
             metrics.dump_flight_recorder("fail_all")
+
+    # ------------------------------------------------------ elastic reshape
+
+    @property
+    def membership_epoch(self) -> int:
+        """Current membership epoch (1 at rendezvous; bumped per reshape)."""
+        return self._epoch
+
+    def clear_reshape_fence(self) -> None:
+        """User-level acknowledgement of a reshape (hvd.elastic.run calls
+        this before re-syncing state): new enqueues ride the new epoch."""
+        with self._lock:
+            self._reshape_fence = None
+
+    def _set_topology(self, new_rank: int, new_size: int) -> None:
+        """Swap in the re-formed world: elastic jobs are one process per
+        member by contract (the launcher respawns workers individually),
+        so local/cross collapse to the subset shape init(ranks) uses."""
+        old = self.topo
+        topo = Topology(
+            rank=new_rank, size=new_size, local_rank=0, local_size=1,
+            cross_rank=new_rank, cross_size=new_size,
+            num_devices=old.num_devices,
+            local_num_devices=old.local_num_devices)
+        self.topo = topo
+        from ..common import basics
+
+        basics.replace_topology(topo)
+
+    def _drain_epoch(self, exc: RanksChangedError) -> None:
+        """Discard every trace of the dead epoch: pending entries fail
+        with the retryable ``exc`` (NOT recorded as a job failure — new
+        enqueues stay allowed behind the fence), and the negotiation
+        state, response cache, and autonaming counters reset so every
+        member of the new epoch starts from the same blank slate —
+        including joiners, whose counters never ran."""
+        with self._lock:
+            self._reshape_fence = exc
+            entries = [self._table[n] for n in sorted(self._table)]
+            self._table.clear()
+            self._queue.clear()
+            self._bit_pending.clear()
+            self._cache = ResponseCache(self.cfg.cache_capacity)
+            self._autoname_counter.clear()
+        if self._service is not None:
+            self._message_table.clear()
+            self._first_seen.clear()
+            self._stall_warned.clear()
+        for entry in entries:
+            if not entry.handle.done():
+                entry.handle.set_error(exc)
+
+    def _reshape_error(self, epoch: int, rank: int, size: int
+                       ) -> RanksChangedError:
+        return RanksChangedError(
+            f"cluster membership changed at epoch {epoch} (this process is "
+            f"now rank {rank} of {size}); in-flight collectives were "
+            "discarded — wrap the training loop in hvd.elastic.run to "
+            "restore state from rank 0 and resume", rank=rank, size=size,
+            epoch=epoch)
+
+    def _elastic_reshape(self, dead: set, cause: Optional[
+            PeerFailureError] = None) -> None:
+        """Coordinator: re-form the world without ``dead`` and with any
+        parked joiners, then resume ticking at the new epoch. Raises the
+        original failure when the survivors fall below min-ranks — the
+        caller's outer handler then aborts exactly like a static job."""
+        t0 = time.monotonic()
+        old_size = self.topo.size
+        res = self._service.reform(
+            dead, min_ranks=config_mod.elastic_min_ranks(),
+            max_ranks=config_mod.elastic_max_ranks())
+        if res is None:
+            if cause is not None:
+                raise cause
+            raise RuntimeError(
+                "elastic: survivors fell below HOROVOD_ELASTIC_MIN_RANKS "
+                f"({config_mod.elastic_min_ranks()}); aborting")
+        self._epoch = res.epoch
+        self._drain_epoch(self._reshape_error(res.epoch, 0, res.size))
+        self._set_topology(0, res.size)
+        took = time.monotonic() - t0
+        logging.warning(
+            "elastic: re-formed at membership epoch %d: size %d -> %d "
+            "(lost ranks %s, admitted %d joiner(s)) in %.3fs",
+            res.epoch, old_size, res.size,
+            list(res.lost) or "none", res.joined, took)
+        if metrics.on():
+            em = _elastic_metrics()
+            em.epoch.set(res.epoch)
+            if res.lost:
+                em.transitions.labels("shrink").inc()
+                for rank in res.lost:
+                    em.departures.labels(str(rank)).inc()
+            if res.joined:
+                em.transitions.labels("grow").inc()
+            em.reshape_seconds.observe(took)
+            metrics.record_event(
+                "reshape", epoch=res.epoch, size=res.size,
+                lost=list(res.lost), joined=res.joined,
+                seconds=round(took, 4))
+
+    def _apply_reshape(self, exc: RanksChangedError) -> None:
+        """Worker: adopt the RESHAPE assignment, drain the dead epoch, and
+        acknowledge so the coordinator knows this wire's stream is clean."""
+        self._epoch = exc.epoch
+        self._drain_epoch(self._reshape_error(exc.epoch, exc.rank, exc.size))
+        self._set_topology(exc.rank, exc.size)
+        self._client.wire.send_join({"ack": exc.epoch})
+        logging.warning(
+            "elastic: membership epoch %d: this process is now rank %d "
+            "of %d", exc.epoch, exc.rank, exc.size)
+        if metrics.on():
+            _elastic_metrics().epoch.set(exc.epoch)
+            metrics.record_event("reshape", epoch=exc.epoch,
+                                 rank=exc.rank, size=exc.size)
 
     # ------------------------------------------------------------ data plane
 

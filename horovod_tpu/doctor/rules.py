@@ -45,6 +45,9 @@ CACHE_COLLAPSE_RATE = 0.2
 # -- restart churn -----------------------------------------------------------
 RESTART_CHURN_MIN = 2
 RESTART_CHURN_CRITICAL = 5
+
+MEMBERSHIP_CHURN_MIN = 3           # elastic transitions before warning
+MEMBERSHIP_CHURN_CRITICAL = 10
 # -- autotune search ---------------------------------------------------------
 AUTOTUNE_STALLED_MIN_CYCLES = 500  # controller cycles before "stalled"
 AUTOTUNE_WANDER_MIN_STEPS = 10     # steps before "wandering" is judged
@@ -374,6 +377,54 @@ def check_restart_churn(ev: Evidence) -> Iterator[Diagnosis]:
             evidence={"restart_epoch": restarts})
 
 
+def check_membership_churn(ev: Evidence) -> Iterator[Diagnosis]:
+    """An elastic job that keeps re-forming is paying the reshape tax —
+    every transition discards in-flight collectives and re-broadcasts
+    state from rank 0 — and usually has ONE sick host behind it. A
+    couple of transitions is elastic working as designed; a stream of
+    them is a flapping rank."""
+    transitions = max(_series_totals(
+        ev.snapshots, "hvd_membership_transitions_total").values(),
+        default=0)
+    if transitions < MEMBERSHIP_CHURN_MIN:
+        return
+    # Name the flapper: the old global rank most often lost to reshapes.
+    # Counters are cumulative, so take each label's max across snapshots
+    # (the coordinator owns the series; workers may echo stale copies).
+    departures: Dict[str, float] = {}
+    for rank in sorted(ev.snapshots):
+        for label, value in _counter_by_first_label(
+                ev.snapshots[rank],
+                "hvd_membership_rank_departures_total").items():
+            departures[label] = max(departures.get(label, 0.0), value)
+    flapper: Optional[int] = None
+    if departures:
+        flapper = int(max(sorted(departures),
+                          key=lambda label: departures[label]))
+    sev = ("critical" if transitions >= MEMBERSHIP_CHURN_CRITICAL
+           else "warning")
+    epoch = _gauge(ev.snapshots, "hvd_membership_epoch")
+    hint = ("each reshape discards in-flight work and re-syncs parameters "
+            "from rank 0, so a flapping member costs far more than its "
+            "own capacity")
+    if flapper is not None:
+        hint = (f"rank {flapper} keeps leaving the job "
+                f"({int(departures[str(flapper)])} departure(s)); suspect "
+                "its host (preemption, OOM kills, flaky NIC) before "
+                "raising --elastic-respawns — " + hint)
+    yield Diagnosis(
+        rule="membership_churn", severity=sev, rank=flapper,
+        summary=(f"{int(transitions)} elastic membership transitions "
+                 f"(grow+shrink) this job"
+                 + (f", now at epoch {int(epoch)}"
+                    if epoch is not None else "")),
+        hint=hint,
+        evidence={"transitions": int(transitions),
+                  "departures_by_rank": {k: int(v) for k, v in
+                                         sorted(departures.items())},
+                  "membership_epoch": epoch})
+
+
 def check_autotune_search(ev: Evidence) -> Iterator[Diagnosis]:
     """The GP search itself can be the patient: a tuner that never
     scores is stalled; one whose current configuration scores far below
@@ -437,6 +488,7 @@ ALL_RULES = (
     check_heartbeat_flapping,
     check_cache_hit_collapse,
     check_restart_churn,
+    check_membership_churn,
     check_autotune_search,
 )
 
@@ -449,6 +501,7 @@ RULE_SLUGS = (
     "heartbeat_flapping",
     "cache_hit_collapse",
     "restart_churn",
+    "membership_churn",
     "autotune_stalled",
     "autotune_wandering",
 )

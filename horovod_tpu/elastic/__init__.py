@@ -1,0 +1,139 @@
+"""Elastic training API (docs/elastic.md) — the user-facing half of
+elastic membership, in the shape upstream Horovod's elastic mode later
+standardized (``hvd.elastic.run`` + a state object):
+
+    state = hvd.elastic.State(step=0, params=params, opt_state=opt_state)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < total_steps:
+            grads = ...                       # uses hvd collectives
+            state.params = update(state.params, grads)
+            state.step += 1
+            if state.step % 100 == 0:
+                state.commit()
+        return state.params
+
+The runtime half lives in the controller (``HOROVOD_ELASTIC=1``): when a
+rank dies or a joiner is admitted, the coordinator re-forms the world at
+a bumped membership epoch and every in-flight collective fails with
+:class:`RanksChangedError`. The ``run`` wrapper catches it, acknowledges
+the reshape, rolls every tracked value back to the last ``commit()``
+synced from rank 0 (``jax.broadcast_parameters`` for array pytrees,
+``broadcast_object`` for everything else), and calls the function again —
+so survivors and joiners alike resume from one consistent point, losing
+at most the work since the last commit.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+from typing import Any, Dict
+
+import numpy as np
+
+from ..common import basics
+from ..common import hvd_logging as logging
+from ..common.wire import RanksChangedError  # noqa: F401  (public API)
+
+__all__ = ["RanksChangedError", "State", "run", "epoch"]
+
+
+def epoch() -> int:
+    """Current membership epoch: 1 at rendezvous (and always 1 for
+    single-process or non-elastic jobs), bumped by every reshape."""
+    ctl = basics.state().controller
+    if ctl is None:
+        return 1
+    return int(getattr(ctl, "membership_epoch", 1))
+
+
+def _is_array_tree(value: Any) -> bool:
+    """True when every leaf is an ndarray-like — the broadcast_parameters
+    fast path, which keeps dtypes/shapes without a pickle round trip."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten(value)[0]
+    return bool(leaves) and all(
+        isinstance(leaf, np.ndarray) or hasattr(leaf, "__array_namespace__")
+        or type(leaf).__module__.startswith(("jax", "jaxlib"))
+        for leaf in leaves)
+
+
+class State:
+    """Tracked training state: every keyword becomes an attribute.
+    ``commit()`` snapshots the current values; ``restore()`` rolls back to
+    the last commit with rank 0's copy winning on every rank — the
+    reference's broadcast-from-root consistency contract, applied at
+    every membership epoch boundary."""
+
+    def __init__(self, **objects: Any):
+        if not objects:
+            raise ValueError(
+                "hvd.elastic.State needs at least one tracked value, e.g. "
+                "State(step=0, params=params)")
+        self._names = tuple(sorted(objects))
+        for name, value in objects.items():
+            setattr(self, name, value)
+        self._committed: Dict[str, Any] = {}
+        self.commit()
+
+    def commit(self) -> None:
+        """Snapshot the current values as the restore point. Purely local
+        (no collective): call it at a point every rank reaches in the
+        same iteration, or ranks will restore to different steps."""
+        self._committed = {name: copy.deepcopy(getattr(self, name))
+                           for name in self._names}
+
+    def restore(self) -> None:
+        """Roll every tracked value back to the last commit, re-synced
+        from rank 0 (reference ``broadcast_parameters`` contract) so all
+        members of the new epoch — joiners included — resume identical."""
+        st = basics.state()
+        for name in self._names:
+            value = self._committed[name]
+            if st.topology.size > 1:
+                if _is_array_tree(value):
+                    from ..jax import broadcast_parameters
+
+                    value = broadcast_parameters(value, root_rank=0)
+                else:
+                    from ..ops.collective_ops import broadcast_object
+
+                    value = broadcast_object(
+                        value, root_rank=0, name=f"elastic.state.{name}")
+            setattr(self, name, copy.deepcopy(value))
+        self.commit()
+
+
+def _acknowledge_reshape() -> None:
+    """Clear the controller's reshape fence: collectives enqueued from
+    here on ride the new epoch (until then they fail with the same
+    RanksChangedError their drained siblings got)."""
+    ctl = basics.state().controller
+    if ctl is not None and hasattr(ctl, "clear_reshape_fence"):
+        ctl.clear_reshape_fence()
+
+
+def run(func):
+    """Decorate the training loop for elastic execution (reference
+    ``hvd.elastic.run`` shape): sync state from rank 0, run ``func(state,
+    *args, **kwargs)``, and on :class:`RanksChangedError` — a reshape
+    interrupted the loop — restore and run it again. Any other exception
+    propagates unchanged."""
+
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        while True:
+            try:
+                _acknowledge_reshape()
+                state.restore()
+                return func(state, *args, **kwargs)
+            except RanksChangedError as exc:
+                logging.warning(
+                    "elastic: %s; restoring state from rank 0 and "
+                    "resuming the training loop", exc)
+                continue
+
+    return wrapper

@@ -24,6 +24,16 @@ Rule fields (JSON object per rule):
              "raise" — raise FaultInjected(``message``)
              "wedge" — init only: raise InitWedged for the first ``times``
                        attempts, succeed afterwards
+             "leave" — cycle only: gracefully retire this worker
+                       (os._exit(0) — a clean departure, the membership-
+                       churn half of elastic chaos; the coordinator sees
+                       the closed wire and re-forms without it)
+             "join"  — cycle only: spawn a CLONE of this process (same
+                       argv/cwd) as an elastic joiner — the clone gets
+                       HOROVOD_ELASTIC_JOIN=1 and a scrubbed fault plan
+                       (it must not replay this rule, or a join storm
+                       becomes a fork bomb) and is admitted at the next
+                       membership epoch boundary
     at       fire on the at-th event at this site (1-based); "wedge"
              ignores it (always the first ``times`` attempts)
     times    how many consecutive events fire (default 1)
@@ -51,7 +61,34 @@ from typing import Dict, List, Optional
 VALID_SITES = ("wire_send", "wire_recv", "cycle", "init",
                "init_distributed")
 _INIT_SITES = ("init", "init_distributed")
-VALID_ACTIONS = ("kill", "exit", "delay", "drop", "raise", "wedge")
+VALID_ACTIONS = ("kill", "exit", "delay", "drop", "raise", "wedge",
+                 "join", "leave")
+# Membership-churn actions fire at controller-cycle granularity only: a
+# join/leave mid-frame would tear a wire stream rather than exercise the
+# elastic reshape path it exists to test.
+_MEMBERSHIP_ACTIONS = ("join", "leave")
+
+
+def _graceful_leave() -> None:
+    """Action "leave": retire this worker cleanly (exit code 0 — the
+    launcher must NOT respawn it, and chaos harnesses asserting on exit
+    codes see an intentional departure). Module-level so tests can stub
+    it."""
+    os._exit(0)
+
+
+def _spawn_joiner() -> None:
+    """Action "join": fork-and-exec a clone of this process as an elastic
+    joiner. Detached — the plan only guarantees a joiner ARRIVES; its
+    admission is the coordinator's job. Module-level so tests can stub."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["HOROVOD_ELASTIC_JOIN"] = "1"
+    env.pop("HOROVOD_FAULT_PLAN", None)  # clones must not replay the plan
+    subprocess.Popen([sys.executable] + sys.argv, env=env,
+                     start_new_session=True)
 
 
 class FaultInjected(RuntimeError):
@@ -88,6 +125,10 @@ class FaultRule:
         if self.action == "drop" and self.site != "wire_send":
             raise ValueError('action "drop" only applies to site '
                              '"wire_send"')
+        if self.action in _MEMBERSHIP_ACTIONS and self.site != "cycle":
+            raise ValueError(
+                f'action "{self.action}" only applies to site "cycle" '
+                "(membership churn is an epoch-boundary event)")
         if self.action != "wedge" and self.at is None:
             # Without "at" the rule would never fire — a chaos run that
             # silently tests nothing. Fail at load, not at runtime.
@@ -166,6 +207,10 @@ class FaultPlan:
                 os.kill(os.getpid(), signal.SIGKILL)
             elif rule.action == "exit":
                 os._exit(1)
+            elif rule.action == "leave":
+                _graceful_leave()
+            elif rule.action == "join":
+                _spawn_joiner()
             elif rule.action == "drop":
                 result = "drop"
             elif rule.action == "wedge":

@@ -92,7 +92,9 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
                    local_rank: int, local_size: int, cross_rank: int,
                    cross_size: int, controller_addr: str, secret: str,
                    bind_chips: bool, spmd: bool = False,
-                   restart_epoch: int = 0) -> Dict[str, str]:
+                   restart_epoch: int = 0, elastic: bool = False,
+                   min_ranks: int = 1, max_ranks: int = 0,
+                   elastic_join: bool = False) -> Dict[str, str]:
     env = dict(base)
     env.update({
         "HOROVOD_RANK": str(rank),
@@ -106,6 +108,28 @@ def build_rank_env(base: Dict[str, str], rank: int, size: int,
         # key restart-vs-fresh on this (utils.checkpoint.restart_epoch()).
         "HOROVOD_RESTART_EPOCH": str(restart_epoch),
     })
+    if elastic:
+        # Elastic membership (docs/elastic.md): pin the python controller
+        # engine (the ring data planes are fixed-membership) and scrub any
+        # inherited ring endpoints so no rank tries to build one.
+        env.update({
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_MIN_RANKS": str(min_ranks),
+            "HOROVOD_ELASTIC_MAX_RANKS": str(max_ranks),
+            "HOROVOD_ENGINE": "python",
+        })
+        for var in ("HOROVOD_RING_ADDRS", "HOROVOD_LOCAL_RING_ADDRS",
+                    "HOROVOD_CROSS_RING_ADDRS"):
+            env.pop(var, None)
+        if elastic_join:
+            env["HOROVOD_ELASTIC_JOIN"] = "1"
+        else:
+            # A fresh (rendezvous) rank must not inherit a stale join flag
+            # from the launcher's own environment.
+            env.pop("HOROVOD_ELASTIC_JOIN", None)
+    else:
+        env.pop("HOROVOD_ELASTIC", None)
+        env.pop("HOROVOD_ELASTIC_JOIN", None)
     # Ranks we spawn watch their parent and die when orphaned (local: this
     # launcher; remote: the ssh session's shell). HOROVOD_PARENT_WATCHDOG=0
     # in the launcher's env opts out and is inherited via `base`.
@@ -516,8 +540,9 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
     # verified-free ports; with remote hosts in play the local entries must
     # be reachable, so use the hostname and a common base port on remote
     # machines (override via HOROVOD_RING_ADDRS if the heuristic clashes).
+    elastic = getattr(args, "elastic", False)
     ring_addrs_env = None
-    if not args.spmd:
+    if not args.spmd and not elastic:
         ring_base = _free_port()
         ring_addrs = []
         for r, host, _, _, _ in assignments:
@@ -543,7 +568,8 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
     for a in assignments:
         groups.setdefault(a[4], []).append(a)
     group_sizes = {len(m) for m in groups.values()}
-    if not args.spmd and len(groups) > 1 and group_sizes.issubset({
+    if not args.spmd and not elastic and len(groups) > 1 and \
+            group_sizes.issubset({
             max(group_sizes)}) and max(group_sizes) > 1:
         # Remote ports share ring_base with the flat ring, in disjoint
         # offset bands — flat [0, size), local [size, 2*size), cross
@@ -577,15 +603,17 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
     threads = []
     failed = threading.Event()
 
-    def spawn(rank, host, local_rank, local_size, cross_rank):
+    def spawn(rank, host, local_rank, local_size, cross_rank, join=False):
         # cross_size counts POPULATED groups: with -np smaller than the total
         # slots, trailing -H entries receive no ranks and must not count.
         env = build_rank_env(
             dict(os.environ), rank, size, local_rank, local_size,
             cross_rank, len(groups), coord_addr, secret, args.bind_chips,
-            spmd=args.spmd, restart_epoch=restart_epoch)
+            spmd=args.spmd, restart_epoch=restart_epoch, elastic=elastic,
+            min_ranks=getattr(args, "min_ranks", 1),
+            max_ranks=getattr(args, "max_ranks", 0), elastic_join=join)
         env["HOROVOD_START_TIMEOUT"] = str(args.start_timeout)
-        if not args.spmd:
+        if not args.spmd and not elastic:
             env["HOROVOD_RING_ADDRS"] = ring_addrs_env
             # A complete user-set hierarchical pair wins (build_rank_env
             # already inherited it); anything less gets the computed pair —
@@ -620,6 +648,7 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
             name=f"hvd-rank-stream-{rank}", daemon=True)
         t.start()
         threads.append(t)
+        return proc
 
     for a in assignments:
         spawn(*a)
@@ -635,22 +664,56 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
     signal.signal(signal.SIGTERM, _terminate_all)
 
     exit_code = 0
+    assignment_by_rank = {a[0]: a for a in assignments}
+    # Elastic (docs/elastic.md): a dead WORKER is respawned individually as
+    # a joiner (the coordinator admits it at the next epoch boundary) up to
+    # --elastic-respawns times per slot, instead of the whole job being
+    # torn down; the job ends when the coordinator's process does.
+    respawns_left = {a[0]: getattr(args, "elastic_respawns", 0)
+                     for a in assignments if a[0] != 0}
     try:
-        pending = list(enumerate(procs))
-        while pending:
-            for i, p in list(pending):
+        pending = [(a[0], procs[i]) for i, a in enumerate(assignments)]
+        done = False
+        while pending and not done:
+            for rank_id, p in list(pending):
                 rc = p.poll()
                 if rc is None:
                     continue
-                pending.remove((i, p))
-                if rc != 0 and exit_code == 0:
+                pending.remove((rank_id, p))
+                if not elastic:
+                    if rc != 0 and exit_code == 0:
+                        exit_code = rc
+                        sys.stderr.write(
+                            f"horovodrun: rank {rank_id} exited with code "
+                            f"{rc}; terminating remaining ranks\n")
+                        failed.set()
+                        _terminate_all()
+                    continue
+                if rank_id == 0:
+                    # The coordinator IS the job in elastic mode: its exit
+                    # (clean or not) ends the run; lingering workers and
+                    # half-admitted joiners are torn down with it.
                     exit_code = rc
-                    sys.stderr.write(
-                        f"horovodrun: rank {i} exited with code {rc}; "
-                        "terminating remaining ranks\n")
-                    failed.set()
+                    done = True
                     _terminate_all()
-            if pending:
+                    break
+                if rc == 0 or interrupted is not None and interrupted.is_set():
+                    continue  # graceful leave / operator teardown: no respawn
+                if respawns_left.get(rank_id, 0) > 0:
+                    respawns_left[rank_id] -= 1
+                    sys.stderr.write(
+                        f"horovodrun: rank {rank_id} exited with code {rc}; "
+                        "respawning its slot as an elastic joiner "
+                        f"({respawns_left[rank_id]} respawn(s) left)\n")
+                    pending.append((
+                        rank_id, spawn(*assignment_by_rank[rank_id],
+                                       join=True)))
+                else:
+                    sys.stderr.write(
+                        f"horovodrun: rank {rank_id} exited with code {rc}; "
+                        "elastic respawn budget exhausted — continuing with "
+                        "the survivors\n")
+            if pending and not done:
                 time.sleep(0.05)
     finally:
         _terminate_all()
@@ -694,6 +757,25 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="seconds to wait for all ranks to start and "
                              "rendezvous before aborting (reference "
                              "horovodrun --start-timeout)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="elastic membership (docs/elastic.md): a dead "
+                             "rank re-forms the job with the survivors at a "
+                             "bumped membership epoch instead of aborting "
+                             "it, dead worker slots are respawned "
+                             "individually as joiners, and late workers "
+                             "are admitted at epoch boundaries; pins the "
+                             "python controller engine")
+    parser.add_argument("--min-ranks", type=int, default=1,
+                        help="elastic: abort (like a static job) if a "
+                             "reshape would drop below this world size "
+                             "(default 1)")
+    parser.add_argument("--max-ranks", type=int, default=0,
+                        help="elastic: park joiners beyond this world size "
+                             "until a slot frees (default 0 = unbounded)")
+    parser.add_argument("--elastic-respawns", type=int, default=3,
+                        help="elastic: times each dead worker slot is "
+                             "respawned as a joiner before the job simply "
+                             "continues with the survivors (default 3)")
     parser.add_argument("--max-restarts", type=int, default=0,
                         help="on a non-zero rank exit, tear the job down "
                              "and relaunch up to N times with exponential "
@@ -725,6 +807,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.spmd and args.bind_chips:
         parser.error("--spmd and --bind-chips conflict: SPMD mode needs "
                      "every process to see all its host's chips")
+    if args.spmd and args.elastic:
+        parser.error("--spmd and --elastic conflict: the JAX distributed "
+                     "runtime is a static world; elastic membership lives "
+                     "in the eager controller tier")
+    if args.elastic and args.min_ranks > args.np:
+        parser.error(f"--min-ranks {args.min_ranks} exceeds -np {args.np}")
     if args.command[0] == "--":
         args.command = args.command[1:]
     return run(args)

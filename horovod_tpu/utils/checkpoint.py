@@ -15,7 +15,8 @@ rank that read a stale/partial file cannot diverge.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import shutil
+from typing import Any, Callable, Optional
 
 from ..common import basics
 from ..common import hvd_logging as logging
@@ -27,15 +28,61 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _write_atomically(path: str, write: Callable[[str], None],
+                      force: bool = True) -> None:
+    """Write a checkpoint directory torn-proof: materialize under a
+    ``<path>.tmp.<pid>`` sibling (same filesystem, so the rename is
+    atomic) and swing it into place only once complete. A rank killed
+    mid-save — the round-11 flight-recorder lesson, and a routine event
+    under elastic membership — leaves transients ``latest_checkpoint``
+    either skips (``.tmp.``) or can fall back to (``.prev``), never a
+    half-written directory the next ``restore_latest`` would load.
+
+    Invariant: at every kill point at least one COMPLETE checkpoint is
+    visible to the resume path. Overwriting retires the old directory to
+    ``<path>.prev`` between the two renames (directories cannot be
+    os.replace'd atomically), and an orphaned ``.prev`` without its
+    primary counts as that step — so even a kill exactly between the
+    renames resumes from the previous complete save."""
+    parent = os.path.dirname(path) or "."
+    base = os.path.basename(path) + ".tmp."
+    for name in os.listdir(parent) if os.path.isdir(parent) else ():
+        if name.startswith(base):
+            # Orphans of ANY earlier attempt (each elastic respawn has a
+            # fresh pid): sweep, or periodic preemption mid-save grows
+            # the directory without bound.
+            shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    write(tmp)
+    if os.path.exists(path):
+        if not force:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise FileExistsError(
+                f"checkpoint {path} already exists (force=False)")
+        old = f"{path}.prev"
+        shutil.rmtree(old, ignore_errors=True)  # stale recovery artifact
+        os.rename(path, old)
+        os.rename(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.rename(tmp, path)
+
+
 def save_checkpoint(path: str, tree: Any, root_rank: int = 0,
                     force: bool = True) -> None:
     """Write ``tree`` at ``path`` from ``root_rank`` only (the reference's
-    rank-0-saves pattern). No-op on other ranks; all ranks may call it."""
+    rank-0-saves pattern). No-op on other ranks; all ranks may call it.
+    The write is atomic: ``path`` either holds the previous complete
+    checkpoint or the new one, never a torn mix."""
     st = basics.state()
     if st.topology.rank != root_rank:
         return
     path = os.path.abspath(path)
-    _checkpointer().save(path, tree, force=force)
+    # force=True on the inner orbax save: the tmp target is ours to
+    # clobber; user-facing `force` gates replacing `path` itself.
+    _write_atomically(path, lambda p: _checkpointer().save(p, tree,
+                                                           force=True),
+                      force=force)
     logging.debug("saved checkpoint at %s", path)
 
 
@@ -83,14 +130,28 @@ def restore_latest(directory: str, like: Optional[Any] = None,
 
 
 def latest_checkpoint(directory: str, prefix: str = "ckpt_") -> Optional[str]:
-    """Newest ``<directory>/<prefix><step>`` path, or None."""
+    """Newest ``<directory>/<prefix><step>`` path, or None. Incomplete
+    entries — the ``.tmp.`` transients of an interrupted
+    :func:`save_checkpoint` — are never candidates: only a name that is
+    exactly ``<prefix><int>`` was renamed into place whole. One
+    exception: a ``<prefix><step>.prev`` WITHOUT its primary is the
+    complete previous save of an overwrite killed between its two
+    renames, and counts as that step (the primary, when present, wins)."""
     if not os.path.isdir(directory):
         return None
+    names = set(os.listdir(directory))
     best, best_step = None, -1
-    for name in os.listdir(directory):
+    for name in sorted(names):
         if name.startswith(prefix):
+            if ".tmp." in name:
+                continue  # torn save leftover (see _write_atomically)
+            stem = name
+            if name.endswith(".prev"):
+                stem = name[:-len(".prev")]
+                if stem in names:
+                    continue  # the primary is whole; .prev is garbage
             try:
-                step = int(name[len(prefix):])
+                step = int(stem[len(prefix):])
             except ValueError:
                 continue
             if step > best_step:

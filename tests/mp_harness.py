@@ -25,33 +25,42 @@ def free_port():
     return port
 
 
+def launch_rank(scenario, rank, size, addr, extra_env=None):
+    """Spawn ONE mp_worker rank against an existing controller address.
+    Building block for run_ranks and for elastic tests that add late
+    joiners to a live job."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_SIZE": str(size),
+        "HOROVOD_LOCAL_RANK": str(rank),
+        "HOROVOD_LOCAL_SIZE": str(size),
+        "HOROVOD_CONTROLLER_ADDR": addr,
+        "HOROVOD_ENGINE": "python",
+        "HOROVOD_CYCLE_TIME": "1",
+    })
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, WORKER, scenario], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
 def run_ranks(scenario, size=2, timeout=120.0, extra_env=None,
-              per_rank_env=None):
+              per_rank_env=None, allowed_exit=None):
     """Run ``size`` ranks of the given mp_worker scenario to completion;
     returns each rank's combined stdout/stderr. Any rank hanging past
-    ``timeout`` kills the whole job; any nonzero exit fails with that
-    rank's output."""
+    ``timeout`` kills the whole job; a rank exiting outside its allowed
+    codes (default: only 0; chaos tests allow e.g. ``{2: (-9,)}`` for a
+    SIGKILLed rank) fails with that rank's output."""
     addr = f"127.0.0.1:{free_port()}"
     procs = []
     for rank in range(size):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(size),
-            "HOROVOD_CONTROLLER_ADDR": addr,
-            "HOROVOD_ENGINE": "python",
-            "HOROVOD_CYCLE_TIME": "1",
-        })
-        env.update(extra_env or {})
+        env = dict(extra_env or {})
         env.update((per_rank_env or {}).get(rank, {}))
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        procs.append(launch_rank(scenario, rank, size, addr, extra_env=env))
     deadline = time.monotonic() + timeout
     outputs = []
     for rank, proc in enumerate(procs):
@@ -65,7 +74,8 @@ def run_ranks(scenario, size=2, timeout=120.0, extra_env=None,
                 f"{scenario}: rank {rank} hung past the timeout")
         outputs.append(out)
     for rank, proc in enumerate(procs):
-        assert proc.returncode == 0, (
-            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
-            f"{outputs[rank]}")
+        ok = (allowed_exit or {}).get(rank, (0,))
+        assert proc.returncode in ok, (
+            f"{scenario}: rank {rank} failed (exit {proc.returncode}, "
+            f"allowed {ok}):\n{outputs[rank]}")
     return outputs

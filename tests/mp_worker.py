@@ -7,6 +7,7 @@ mpirun-launched test bodies (SURVEY.md §4: "2 MPI ranks on one container").
 
 import os
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -435,6 +436,115 @@ def scenario_fault_metrics(rank, size):
               flush=True)
     else:
         raise AssertionError("injected fault did not surface")
+
+
+def _elastic_summary(steps):
+    # One parseable line per member + rank 0's registry (the parent
+    # asserts the membership series off it).
+    import json as _json
+
+    print(f"ELASTIC size={hvd.size()} epoch={hvd.elastic.epoch()} "
+          f"steps={steps}", flush=True)
+    if hvd.rank() == 0:
+        print("METRICS_SNAPSHOT " + _json.dumps(hvd.metrics.snapshot()),
+              flush=True)
+
+
+def _elastic_train(target_size, min_epoch=2, settle_steps=10,
+                   max_steps=20000):
+    """Shared elastic loop (docs/elastic.md): allreduce-driven steps under
+    hvd.elastic.run until the world settles at ``target_size`` ranks and
+    epoch >= ``min_epoch`` for ``settle_steps`` consecutive steps. Every
+    sum must equal some plausible world size exactly — a reshape may
+    change WHICH size, but never tear one collective."""
+    state = hvd.elastic.State(step=0, weights=np.zeros(4, np.float32))
+
+    @hvd.elastic.run
+    def train(state):
+        settled = 0
+        while True:
+            total = np.asarray(hvd.allreduce(
+                np.ones(4, np.float32), average=False,
+                name=f"el.{state.step}"))
+            k = float(total[0])
+            expect(k == int(k) and 1 <= k <= target_size + 1,
+                   f"allreduce saw impossible world size {k}")
+            expect(np.all(total == k), f"torn allreduce result {total}")
+            state.weights = state.weights + total
+            state.step += 1
+            state.commit()
+            if hvd.size() == target_size and \
+                    hvd.elastic.epoch() >= min_epoch and k == target_size:
+                settled += 1
+                if settled >= settle_steps:
+                    return state.step
+            else:
+                settled = 0
+            expect(state.step < max_steps,
+                   f"world never settled at size {target_size} / epoch "
+                   f">= {min_epoch} (now size {hvd.size()}, epoch "
+                   f"{hvd.elastic.epoch()})")
+
+    steps = train(state)
+    # Survivors and joiners must agree bit-for-bit on the restored state.
+    gathered = hvd.allgather_object(
+        (int(steps), state.weights.tolist()), name="el.final")
+    expect(len(gathered) == target_size,
+           f"expected {target_size} members, got {len(gathered)}")
+    expect(all(g == gathered[0] for g in gathered),
+           f"divergent state after reshape: {gathered}")
+    return steps
+
+
+def scenario_elastic_shrink(rank, size):
+    # ISSUE 7 acceptance: 3-rank elastic job; a seeded FaultPlan takes
+    # rank 2 out mid-run (SIGKILL or graceful leave — parent's env).
+    # Survivors re-form at membership epoch 2 with size 2, keep
+    # completing consistent allreduces, and rank 0's snapshot carries the
+    # shrink transition. No job-level failure anywhere.
+    steps = _elastic_train(target_size=2, min_epoch=2)
+    expect(hvd.elastic.epoch() == 2,
+           f"expected exactly one reshape; epoch {hvd.elastic.epoch()}")
+    _elastic_summary(steps)
+
+
+def scenario_elastic_join(rank, size):
+    # A live 2-rank job absorbs a late 3rd worker (spawned by the parent
+    # with HOROVOD_ELASTIC_JOIN=1): existing members see a grow reshape
+    # at the next epoch boundary, the joiner syncs state from rank 0, and
+    # all three train on in lockstep.
+    steps = _elastic_train(target_size=3, min_epoch=2)
+    _elastic_summary(steps)
+
+
+def scenario_elastic_parked(rank, size):
+    # Livelock guard (docs/elastic.md): with the world already at
+    # --max-ranks, a parked joiner must WAIT — no reshape, no epoch bump,
+    # no drained collectives — while the members train on undisturbed.
+    # Wall-clock bounded so the joiner is provably parked DURING steps.
+    deadline = time.monotonic() + 6.0
+    step = 0
+    while time.monotonic() < deadline:
+        total = np.asarray(hvd.allreduce(np.ones(2, np.float32),
+                                         average=False, name=f"pk.{step}"))
+        expect(float(total[0]) == size,
+               f"world changed under a parked joiner: {total}")
+        expect(hvd.elastic.epoch() == 1,
+               f"epoch bumped to {hvd.elastic.epoch()} with no churn")
+        step += 1
+        time.sleep(0.01)
+    print(f"PARKED_OK size={hvd.size()} epoch={hvd.elastic.epoch()} "
+          f"steps={step}", flush=True)
+
+
+def scenario_elastic_storm(rank, size):
+    # Kill+join storm, fully scripted by FaultPlan membership kinds:
+    # rank 2 is SIGKILLed at its cycle 40 (shrink) and rank 1 spawns a
+    # clone of itself as a joiner at its cycle 400 (grow). Whatever order
+    # the boundaries land in, the job must settle back at 3 ranks with a
+    # bumped epoch and bit-identical state on every member.
+    steps = _elastic_train(target_size=3, min_epoch=2, max_steps=40000)
+    _elastic_summary(steps)
 
 
 def scenario_trace(rank, size):
@@ -1241,6 +1351,10 @@ SCENARIOS = {
     "peer_death": scenario_peer_death,
     "fault_survivor": scenario_fault_survivor,
     "fault_metrics": scenario_fault_metrics,
+    "elastic_shrink": scenario_elastic_shrink,
+    "elastic_join": scenario_elastic_join,
+    "elastic_parked": scenario_elastic_parked,
+    "elastic_storm": scenario_elastic_storm,
     "metrics_cluster": scenario_metrics_cluster,
     "trace": scenario_trace,
     "doctor": scenario_doctor,

@@ -87,6 +87,10 @@ def test_known_series_present():
         "hvd_controller_tick_lateness_seconds",
         "hvd_doctor_runs_total",
         "hvd_doctor_findings",
+        "hvd_membership_epoch",
+        "hvd_membership_transitions_total",
+        "hvd_membership_rank_departures_total",
+        "hvd_elastic_reshape_seconds",
         "hvd_autotune_active",
         "hvd_autotune_steps_completed",
         "hvd_autotune_steps_remaining",
