@@ -10,12 +10,25 @@ complementary ways:
 
 * :mod:`~horovod_tpu.analysis.framework` + :mod:`~horovod_tpu.analysis.rules`
   — an AST-based lint over the package source with distributed-correctness
-  rules (HVD001..HVD007), ``# hvdlint: disable=RULE`` suppressions, a
+  rules (HVD001..HVD009), ``# hvdlint: disable=RULE`` suppressions, a
   checked-in baseline for grandfathered findings, and JSON/text reporters.
   CLI: ``python -m horovod_tpu.tools.lint``; gate: ``tests/test_lint.py``.
+* :mod:`~horovod_tpu.analysis.dataflow` — the call-graph + rank-taint
+  machinery behind the interprocedural rules (HVD001 catches a
+  collective reached through helper calls under a rank conditional).
+* :mod:`~horovod_tpu.analysis.protocol` — the machine-readable wire/epoch
+  protocol: ONE declarative state-machine spec per wire-peer role,
+  checked statically against the real handler dispatch (HVD008,
+  ``python -m horovod_tpu.tools.protocheck``) and dynamically by the
+  opt-in ``HOROVOD_PROTOCHECK=1`` runtime monitor in ``Wire``.
 * :mod:`~horovod_tpu.analysis.lockorder` — a runtime lock-order detector
   (``HOROVOD_LOCKCHECK=1``): tracked locks record the global acquisition-
-  order graph and report cycles (potential deadlocks) with both stacks.
+  order graph and report cycles (potential deadlocks) with both stacks;
+  plus the STATIC potential-order graph (:func:`lockorder.static_graph`)
+  and the static×runtime join (:func:`lockorder.join_reports`) that
+  reports statically-possible cycles never observed at runtime.
+* :mod:`~horovod_tpu.analysis.autofix` — mechanical ``--fix`` repairs
+  for HVD002/HVD005 (idempotent by construction).
 
 Everything here is stdlib-only and import-light: ``common/wire.py`` (and
 every other hot module) imports :func:`~horovod_tpu.analysis.lockorder.make_lock`
@@ -41,14 +54,27 @@ from .framework import (  # noqa: F401
 from .lockorder import (  # noqa: F401
     LockGraph,
     TrackedLock,
+    find_cycles,
+    join_reports,
     lockcheck_enabled,
     make_lock,
+    static_graph,
 )
-from .rules import ALL_RULES, get_rule  # noqa: F401
+from .protocol import (  # noqa: F401
+    ProtocolMonitor,
+    ProtocolViolationError,
+    epoch_advances,
+    epoch_is_stale,
+    protocheck_enabled,
+)
+from .rules import ALL_RULES, aux_rules, get_rule  # noqa: F401
 
 __all__ = [
     "Finding", "LintResult", "Rule", "SourceFile", "baseline_key",
     "iter_python_files", "lint_source", "load_baseline", "render_json",
-    "render_text", "run_lint", "write_baseline", "ALL_RULES", "get_rule",
-    "LockGraph", "TrackedLock", "lockcheck_enabled", "make_lock",
+    "render_text", "run_lint", "write_baseline", "ALL_RULES", "aux_rules",
+    "get_rule", "LockGraph", "TrackedLock", "find_cycles", "join_reports",
+    "lockcheck_enabled", "make_lock", "static_graph", "ProtocolMonitor",
+    "ProtocolViolationError", "epoch_advances", "epoch_is_stale",
+    "protocheck_enabled",
 ]

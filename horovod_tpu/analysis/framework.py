@@ -131,11 +131,16 @@ class LintResult:
 
 
 def iter_python_files(paths: Sequence[str],
-                      root: Optional[str] = None) -> Iterator[Tuple[str, str]]:
+                      root: Optional[str] = None,
+                      exclude_dirs: Sequence[str] = ("__pycache__",),
+                      ) -> Iterator[Tuple[str, str]]:
     """Yield ``(abspath, relpath)`` for every ``.py`` under ``paths``
-    (files accepted directly), skipping ``__pycache__``. ``relpath`` is
-    relative to ``root`` (default: each path's parent directory), with
-    ``/`` separators so baselines are platform-stable."""
+    (files accepted directly), skipping directories named in
+    ``exclude_dirs`` (``__pycache__`` by default; the aux test/example
+    scan also drops ``lint_fixtures`` — fixtures fire by design).
+    ``relpath`` is relative to ``root`` (default: each path's parent
+    directory), with ``/`` separators so baselines are platform-stable."""
+    skip = set(exclude_dirs) | {"__pycache__"}
     for path in paths:
         path = os.path.abspath(path)
         base = os.path.abspath(root) if root else os.path.dirname(path)
@@ -143,7 +148,7 @@ def iter_python_files(paths: Sequence[str],
             yield path, os.path.relpath(path, base).replace(os.sep, "/")
             continue
         for dirpath, dirnames, filenames in os.walk(path):
-            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            dirnames[:] = sorted(d for d in dirnames if d not in skip)
             for fname in sorted(filenames):
                 if fname.endswith(".py"):
                     ap = os.path.join(dirpath, fname)
@@ -153,7 +158,8 @@ def iter_python_files(paths: Sequence[str],
 def run_lint(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
              baseline: Optional[Iterable[dict]] = None,
              root: Optional[str] = None,
-             select: Optional[Sequence[str]] = None) -> LintResult:
+             select: Optional[Sequence[str]] = None,
+             exclude_dirs: Sequence[str] = ("__pycache__",)) -> LintResult:
     """Run ``rules`` over every python file under ``paths``.
 
     ``baseline`` is an iterable of entry dicts (see :func:`load_baseline`);
@@ -182,7 +188,8 @@ def run_lint(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     suppressed = 0
     scanned = 0
     errors: List[Tuple[str, str]] = []
-    for abspath, relpath in iter_python_files(paths, root=root):
+    for abspath, relpath in iter_python_files(paths, root=root,
+                                              exclude_dirs=exclude_dirs):
         try:
             src = SourceFile.read(abspath, relpath)
         except (SyntaxError, UnicodeDecodeError, OSError) as exc:

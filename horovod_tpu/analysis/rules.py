@@ -1,4 +1,4 @@
-"""hvdlint distributed-correctness rules (HVD001..HVD007).
+"""hvdlint distributed-correctness rules (HVD001..HVD009).
 
 Each rule encodes one invariant the runtime depends on but cannot check
 until a job is already hung:
@@ -34,6 +34,15 @@ until a job is already hung:
   registered via ``counter()``/``gauge()``/``histogram()`` must be
   ``hvd_``-prefixed snake_case and have exactly one owning call site
   (the AST successor of the regex checks in tests/test_metrics_lint.py).
+* HVD008 — wire-protocol handler completeness: the frame-kind dispatch
+  in ``wire.py`` must stay a bijection with the declarative protocol
+  spec (``analysis/protocol.py``) — a missing branch is a frame the
+  code cannot handle, an extra branch is a transition the spec does not
+  know (drift either way; the C++ port inherits the spec).
+* HVD009 — membership epochs compared with raw ``<``/``>`` instead of
+  the sanctioned monotonic helpers (``epoch_advances``/
+  ``epoch_is_stale``): one auditable definition of "newer epoch" for
+  the runtime, the reshape drain, and the conformance monitor.
 """
 
 from __future__ import annotations
@@ -42,47 +51,19 @@ import ast
 import re
 from typing import Dict, Iterator, List, Optional, Tuple, Type
 
+from .dataflow import (  # noqa: F401  (COLLECTIVE_NAMES/RANK_NAMES/
+    COLLECTIVE_NAMES,    # _mentions_rank are part of this module's
+    RANK_NAMES,          # historical public surface)
+    call_name as _call_name,
+    iter_divergent_collectives,
+    mentions_rank as _mentions_rank,
+)
 from .framework import Finding, Rule, SourceFile
 
 CONFIG_MODULE_SUFFIX = "common/config.py"
 
-# Names that enqueue a collective on the eager tier (package API surface
-# plus the in-place/async variants and ring-backend methods).
-COLLECTIVE_NAMES = frozenset({
-    "allreduce", "allreduce_", "allreduce_async",
-    "allgather", "allgather_", "allgather_async",
-    "broadcast", "broadcast_", "broadcast_async",
-    "alltoall", "reducescatter", "barrier",
-    "grouped_allreduce", "grouped_allreduce_",
-    "broadcast_parameters", "broadcast_optimizer_state",
-    "broadcast_object", "allgather_object", "broadcast_variables",
-})
-
-# Identifiers whose appearance in an ``if`` test marks it rank-conditional.
-RANK_NAMES = frozenset({"rank", "local_rank", "cross_rank", "process_index"})
-
 METRIC_FACTORIES = frozenset({"counter", "gauge", "histogram"})
 _METRIC_NAME_RE = re.compile(r"^hvd_[a-z][a-z0-9_]*$")
-
-
-def _call_name(node: ast.Call) -> Optional[str]:
-    """Trailing identifier of the called object: ``hvd.allreduce`` ->
-    ``allreduce``, ``barrier`` -> ``barrier``."""
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return None
-
-
-def _mentions_rank(test: ast.AST) -> bool:
-    for node in ast.walk(test):
-        if isinstance(node, ast.Name) and node.id in RANK_NAMES:
-            return True
-        if isinstance(node, ast.Attribute) and node.attr in RANK_NAMES:
-            return True
-    return False
 
 
 def _is_os_environ(node: ast.AST) -> bool:
@@ -93,36 +74,25 @@ def _is_os_environ(node: ast.AST) -> bool:
 class DivergentCollectiveRule(Rule):
     code = "HVD001"
     name = "divergent-collective"
-    description = ("collective call lexically inside a rank-conditional "
-                   "branch: ranks taking the other branch never enqueue it "
-                   "and the job deadlocks at negotiation")
+    description = ("collective call inside a rank-conditional branch — "
+                   "directly or reached through module-local helper calls "
+                   "(call-graph + rank-taint dataflow): ranks taking the "
+                   "other branch never enqueue it and the job deadlocks "
+                   "at negotiation")
+
+    def __init__(self, interprocedural: bool = True):
+        # interprocedural=False reproduces the round-10 lexical rule
+        # exactly; tests pin its blind spots against the upgraded pass.
+        self.interprocedural = interprocedural
 
     def check(self, src: SourceFile) -> Iterator[Finding]:
-        findings: List[Finding] = []
+        def suppressed(line: int) -> bool:
+            return src.is_suppressed(self.code, line)
 
-        def visit(node: ast.AST, inside: bool) -> None:
-            if isinstance(node, ast.If) and _mentions_rank(node.test):
-                # The test expression itself runs on every rank.
-                visit_children([node.test], inside)
-                visit_children(node.body + node.orelse, True)
-                return
-            if isinstance(node, ast.Call) and inside:
-                cname = _call_name(node)
-                if cname in COLLECTIVE_NAMES:
-                    findings.append(self.finding(
-                        src, node,
-                        f"collective '{cname}' inside a rank-conditional "
-                        "branch (divergent-collective deadlock): hoist it "
-                        "out, or suppress if the subgroup genuinely "
-                        "matches the conditional"))
-            visit_children(ast.iter_child_nodes(node), inside)
-
-        def visit_children(children, inside: bool) -> None:
-            for child in children:
-                visit(child, inside)
-
-        visit(src.tree, False)
-        yield from findings
+        for node, message in iter_divergent_collectives(
+                src.tree, is_suppressed=suppressed,
+                interprocedural=self.interprocedural):
+            yield self.finding(src, node, message)
 
 
 class UnorderedIterationRule(Rule):
@@ -135,8 +105,15 @@ class UnorderedIterationRule(Rule):
     PATH_MARKERS = ("controller/",)
     METHODS = frozenset({"items", "keys", "values"})
 
+    def __init__(self, all_paths: bool = False):
+        # all_paths=True drops the controller/ scoping — the aux gate
+        # over tests/ and examples/ uses it (mp scenario bodies run on
+        # every rank; a dict-order-dependent expectation is a flake).
+        self.all_paths = all_paths
+
     def check(self, src: SourceFile) -> Iterator[Finding]:
-        if not any(m in src.relpath for m in self.PATH_MARKERS):
+        if not self.all_paths and \
+                not any(m in src.relpath for m in self.PATH_MARKERS):
             return
         sorted_args = set()
         for node in ast.walk(src.tree):
@@ -379,6 +356,74 @@ class MetricCatalogRule(Rule):
                 yield node.args[0].value, node
 
 
+class ProtocolHandlerRule(Rule):
+    code = "HVD008"
+    name = "protocol-handler-completeness"
+    description = ("frame-kind dispatch must stay a bijection with the "
+                   "declarative wire-protocol spec "
+                   "(analysis/protocol.py): a missing branch is a frame "
+                   "the code cannot handle, an extra one is a transition "
+                   "the spec does not know — drift either way, and the "
+                   "C++ port inherits the spec")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        from . import protocol
+
+        relsuffix = next(
+            (s for s in protocol.PROTOCOL_SURFACE
+             if src.relpath.endswith(s)), None)
+        if relsuffix is None:
+            return
+        for entry in protocol.check_module(relsuffix, src.tree):
+            yield Finding(rule=self.code, path=src.relpath,
+                          line=entry["line"], col=0,
+                          message=entry["message"])
+
+
+class RawEpochComparisonRule(Rule):
+    code = "HVD009"
+    name = "raw-epoch-comparison"
+    description = ("membership epoch compared with raw </>: use the "
+                   "sanctioned monotonic helpers (epoch_advances / "
+                   "epoch_is_stale in analysis/protocol.py) so the "
+                   "runtime, the reshape drain, and the conformance "
+                   "monitor share ONE definition of \"newer epoch\"")
+
+    # The membership-epoch protocol surface. keras/run training/restart
+    # "epoch"s are a different concept and stay out of scope.
+    PATH_MARKERS = ("common/wire.py", "controller/", "elastic/")
+    _ORDERING_OPS = (ast.Lt, ast.Gt, ast.LtE, ast.GtE)
+
+    @staticmethod
+    def _names_epoch(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            name = None
+            if isinstance(sub, ast.Name):
+                name = sub.id
+            elif isinstance(sub, ast.Attribute):
+                name = sub.attr
+            if name is not None and "epoch" in name.lower():
+                return True
+        return False
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not any(m in src.relpath for m in self.PATH_MARKERS):
+            return
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not any(isinstance(op, self._ORDERING_OPS)
+                       for op in node.ops):
+                continue  # ==/!= are fine: equality is not an ordering
+            if self._names_epoch(node):
+                yield self.finding(
+                    src, node,
+                    "membership epoch compared with a raw ordering "
+                    "operator; use epoch_advances()/epoch_is_stale() "
+                    "(analysis/protocol.py) — the sanctioned monotonic "
+                    "helpers the conformance monitor shares")
+
+
 ALL_RULES: List[Type[Rule]] = [
     DivergentCollectiveRule,
     UnorderedIterationRule,
@@ -387,7 +432,21 @@ ALL_RULES: List[Type[Rule]] = [
     AnonymousThreadRule,
     ImportTimeSideEffectRule,
     MetricCatalogRule,
+    ProtocolHandlerRule,
+    RawEpochComparisonRule,
 ]
+
+
+def aux_rules() -> List[Rule]:
+    """The scoped rule-set for the ``tests/`` + ``examples/`` scan
+    (docs/static-analysis.md): mp scenario bodies run on every rank, so
+    a dict-order-dependent expectation (HVD002, unscoped here) is a
+    flake and an anonymous thread (HVD005) hides hangs; example scripts
+    are copied into user jobs, so import-time side effects (HVD006)
+    propagate. Pre-existing findings live in .hvdlint-aux-baseline.json
+    — a ratchet like the package baseline, minus the size cap."""
+    return [UnorderedIterationRule(all_paths=True), AnonymousThreadRule(),
+            ImportTimeSideEffectRule()]
 
 
 def get_rule(code: str) -> Type[Rule]:

@@ -590,6 +590,24 @@ class Controller:
                         self._elastic_reshape(set())
                     self._cycle()
                 except PeerFailureError as exc:
+                    if self._shutdown_requested:
+                        # Teardown race: a worker whose own shutdown was
+                        # requested tears down promptly after its current
+                        # reply (see _process_reply) and may close its
+                        # wire before this coordinator's next recv. The
+                        # job is ending either way — finish the local
+                        # teardown instead of diagnosing a death or, far
+                        # worse, elastically re-forming a DYING world and
+                        # admitting a parked joiner into it (the joiner
+                        # would sync, enqueue once, and die with the
+                        # shutdown).
+                        logging.debug(
+                            "shutdown: rank %d closed its wire before "
+                            "the final echo (%s)", exc.rank, exc.cause)
+                        self._closed.set()
+                        self._fail_all(ShutdownError(
+                            "Horovod has been shut down"))
+                        continue  # loop exits on _closed
                     # Coordinator side: with elastic on, a dead worker
                     # re-forms the world instead of failing it (the method
                     # re-raises when the survivors fall below min-ranks);
@@ -769,6 +787,9 @@ class Controller:
             self._stamp_sent(tick)  # rank 0's "send" is the local build
             t0 = time.monotonic()
             reply = self._coordinate(tick)
+            # Both sides of the rank conditional run _process_reply on
+            # the SAME negotiated response list, so every rank executes
+            # identical collectives. hvdlint: disable=HVD001
             nbytes = self._process_reply(reply)
             if self._param_manager is not None:
                 tuned = self._param_manager.record(
@@ -806,6 +827,9 @@ class Controller:
             self._client.send(tick)
             self._stamp_sent(tick)
             reply = self._client.recv()
+            # Same response list as the coordinator branch above: the
+            # per-response execution is identical on every rank.
+            # hvdlint: disable=HVD001
             self._process_reply(reply)
         if mon:
             _ctl_metrics().cycle.observe(time.monotonic() - t_start)

@@ -167,6 +167,10 @@ class CoordinatorService(_HeartbeatMixin):
             conn.settimeout(
                 min(5.0, max(0.1, deadline - time.monotonic())))
             wire = Wire(conn)
+            # Conformance role (HOROVOD_PROTOCHECK, analysis/protocol.py):
+            # assigned before the first frame so the hello itself is
+            # checked against the coordinator's handshake state.
+            wire.set_protocol_role("coordinator")
             try:
                 hello = wire.recv_obj()
                 rank = int(hello["rank"])
@@ -269,6 +273,7 @@ class CoordinatorService(_HeartbeatMixin):
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.settimeout(5.0)  # real joiners send the hello at once
                 wire = Wire(conn)
+                wire.set_protocol_role("coordinator")
                 try:
                     kind, hello = wire.recv_hello()
                     if kind != FRAME_JOIN or not hello.get("join"):
@@ -432,6 +437,10 @@ class WorkerClient(_HeartbeatMixin):
         sock.settimeout(None)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.wire = Wire(sock)
+        # Conformance role (HOROVOD_PROTOCHECK): a joiner plays the
+        # parked-joiner machine until its admission commits, after which
+        # the spec aliases it onto the worker machine.
+        self.wire.set_protocol_role("joiner" if join else "worker")
         if join:
             # Elastic late joiner (docs/elastic.md): a JOIN hello instead
             # of the rendezvous hello; the coordinator parks this wire and

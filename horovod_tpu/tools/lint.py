@@ -14,6 +14,9 @@ Workflows (docs/static-analysis.md):
 * ``... --select HVD003,HVD004`` — run a subset of rules.
 * ``... --write-baseline`` — grandfather today's findings; the gate
   then fails only on NEW ones. Shrink the baseline, never grow it.
+* ``... --fix`` — apply the mechanical autofixes (HVD002 ``sorted()``
+  wrap, HVD005 thread ``name=``/``daemon=`` kwargs) in place, then
+  report whatever remains. Idempotent: a second ``--fix`` is a no-op.
 * ``... --list-rules`` — the rule catalog with one-line rationales.
 """
 
@@ -57,6 +60,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--select", default=None,
                         help="comma-separated rule codes to run "
                              "(default: all)")
+    parser.add_argument("--fix", action="store_true",
+                        help="apply the mechanical autofixes (HVD002/"
+                             "HVD005) in place before reporting")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     parser.add_argument("--verbose", action="store_true",
@@ -80,6 +86,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("--write-baseline on the default baseline requires a "
                      "full default scan (no --select, no explicit paths); "
                      "pass --baseline <file> to write a scoped one")
+    if args.fix:
+        from ..analysis import iter_python_files
+        from ..analysis.autofix import fix_file
+
+        total = files_changed = 0
+        # lint_fixtures excluded like the aux scan: rule-proof fixtures
+        # fire by design and must never be "repaired" in place.
+        for abspath, relpath in iter_python_files(
+                paths, root=_REPO_DIR,
+                exclude_dirs=("__pycache__", "lint_fixtures")):
+            try:
+                n = fix_file(abspath, relpath, select=select)
+            except (OSError, SyntaxError):
+                continue  # the lint run below reports it as a parse error
+            if n:
+                total += n
+                files_changed += 1
+        print(f"hvdlint: --fix applied {total} fix(es) in "
+              f"{files_changed} file(s)")
     baseline = None
     if args.baseline and args.baseline.lower() != "none" \
             and not args.write_baseline:

@@ -1,0 +1,148 @@
+"""``python -m horovod_tpu.tools.protocheck`` — protocol conformance CLI.
+
+The static side of the wire/epoch protocol spec
+(``horovod_tpu/analysis/protocol.py``, docs/static-analysis.md):
+
+* default run — spec self-check (every role covers every frame kind,
+  guards known, states reachable) + handler↔spec bijection against the
+  real ``wire.py``/``service.py``/``controller.py`` dispatch. **Exit 1
+  on any drift**, which is what keeps the spec from rotting: a new
+  frame kind, state, or dispatch branch fails CI until spec and code
+  agree again (gated in tier-1 by ``tests/test_protocol.py``).
+* ``--runtime PATH...`` — additionally validate ``protocheck.json``
+  artifacts from monitored runs (``HOROVOD_PROTOCHECK=1``): exit 1 if
+  any recorded off-spec transition.
+* ``--lockgraph PATH...`` — the static×runtime lock-graph join: build
+  the potential lock-order graph from source, merge the runtime
+  ``lockgraph.json`` dumps, and report (a) runtime edges the static
+  graph misses (a bug in the static pass — it must be a superset) and
+  (b) statically-possible cycles no run has ever exhibited (the races
+  we could have; exit 1 when any exist).
+* ``--dump-spec`` — render the three role state tables as markdown
+  (the source of the tables in docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..analysis import lockorder, protocol
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _static_findings() -> List[dict]:
+    findings = [{"path": "analysis/protocol.py", "line": 0,
+                 "message": f"spec inconsistency: {p}"}
+                for p in protocol.check_spec()]
+    findings.extend(protocol.check_handlers(_PKG_DIR))
+    return findings
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.tools.protocheck",
+        description="wire/epoch protocol conformance: spec self-check + "
+                    "handler bijection (exit 1 on drift), runtime "
+                    "artifact validation, static x runtime lock-graph "
+                    "join (docs/static-analysis.md)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--dump-spec", action="store_true",
+                        help="print the role state tables as markdown "
+                             "and exit")
+    parser.add_argument("--runtime", nargs="*", default=None,
+                        metavar="PROTOCHECK_JSON",
+                        help="validate runtime protocheck.json artifacts "
+                             "(exit 1 on recorded violations)")
+    parser.add_argument("--lockgraph", nargs="*", default=None,
+                        metavar="LOCKGRAPH_JSON",
+                        help="join the static lock-order graph with "
+                             "runtime lockgraph.json dumps; exit 1 on "
+                             "unobserved static cycles or a broken "
+                             "superset")
+    args = parser.parse_args(argv)
+
+    if args.dump_spec:
+        sys.stdout.write(protocol.render_state_tables())
+        return 0
+
+    report = {"static_findings": _static_findings()}
+    rc = 1 if report["static_findings"] else 0
+
+    if args.runtime is not None:
+        runtime = {"artifacts": [], "violations": []}
+        for path in args.runtime:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError) as exc:
+                runtime["artifacts"].append(
+                    {"path": path, "error": str(exc)})
+                rc = 1
+                continue
+            runtime["artifacts"].append(
+                {"path": path,
+                 "transitions": data.get("transitions", 0),
+                 "violations": len(data.get("violations", []))})
+            for v in data.get("violations", []):
+                runtime["violations"].append({"artifact": path, **v})
+        if runtime["violations"]:
+            rc = 1
+        report["runtime"] = runtime
+
+    if args.lockgraph is not None:
+        static = lockorder.static_graph()
+        reports = []
+        for path in args.lockgraph:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    reports.append(json.load(f))
+            except (OSError, ValueError) as exc:
+                report.setdefault("lockgraph_errors", []).append(
+                    {"path": path, "error": str(exc)})
+                rc = 1
+        join = lockorder.join_reports(static, reports)
+        report["lock_join"] = join
+        if not join["superset"] or join["unobserved_cycles"]:
+            rc = 1
+
+    if args.format == "json":
+        sys.stdout.write(json.dumps(report, indent=1, sort_keys=True)
+                         + "\n")
+        return rc
+
+    for f in report["static_findings"]:
+        print(f"{f['path']}:{f['line']}: {f['message']}")
+    print(f"protocheck: {len(report['static_findings'])} static "
+          "finding(s)")
+    if "runtime" in report:
+        for v in report["runtime"]["violations"]:
+            print(f"{v['artifact']}: OFF-SPEC {v['role']}.{v['state']} "
+                  f"{v['direction']} {v['kind']}: {v['detail']}")
+        total = sum(a.get("transitions", 0)
+                    for a in report["runtime"]["artifacts"])
+        print(f"protocheck: {len(report['runtime']['violations'])} "
+              f"runtime violation(s) over {total} transition(s) in "
+              f"{len(report['runtime']['artifacts'])} artifact(s)")
+    if "lock_join" in report:
+        join = report["lock_join"]
+        for edge in join["uncovered_runtime_edges"]:
+            print(f"lockgraph: runtime edge {edge[0]} -> {edge[1]} is "
+                  "MISSING from the static graph (static pass bug)")
+        for cyc in join["unobserved_cycles"]:
+            print("lockgraph: statically-possible cycle never observed "
+                  "at runtime: " + " -> ".join(cyc))
+        print(f"lockgraph: {join['static_edges']} static edge(s), "
+              f"{join['runtime_edges']} runtime edge(s), superset="
+              f"{join['superset']}, "
+              f"{len(join['unobserved_cycles'])} unobserved cycle(s)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

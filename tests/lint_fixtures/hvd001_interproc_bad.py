@@ -1,0 +1,24 @@
+"""Interprocedural HVD001 fixture: the collective is TWO calls deep
+under a rank conditional — the round-10 lexical rule is blind to all of
+this (pinned by test_lexical_hvd001_misses_interprocedural_fixture); the
+call-graph pass must flag both call sites."""
+import horovod_tpu as hvd
+
+
+def _sync():
+    hvd.barrier()          # not itself under any conditional
+
+
+def warm_up():
+    _sync()                # one call deep
+
+
+def maybe_warm(rank):
+    if rank == 0:
+        warm_up()          # two calls from the collective: HVD001
+
+
+def renamed_rank_conditional(local_rank):
+    is_root = local_rank == 0    # rank-taint: is_root derives from rank
+    if is_root:
+        _sync()                  # one call deep, renamed test: HVD001

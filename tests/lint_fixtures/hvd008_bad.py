@@ -1,0 +1,30 @@
+"""HVD008 bad fixture: linted AS IF it were horovod_tpu/common/wire.py
+(the relpath is mapped in test_lint.py). Two drifts: recv_hello lost its
+RESHAPE branch (missing transition), and an undeclared helper dispatches
+on a frame kind (handler drift)."""
+
+FRAME_DATA = 0
+FRAME_HEARTBEAT = 1
+FRAME_ABORT = 2
+FRAME_JOIN = 3
+FRAME_RESHAPE = 4
+
+
+class Wire:
+    def recv_bytes(self):
+        return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
+                FRAME_RESHAPE)
+
+    def recv_hello(self):
+        # Missing FRAME_RESHAPE: the spec declares a reshape-during-hello
+        # violation branch this handler no longer has.
+        return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN)
+
+    def recv_reshape_ack(self, epoch):
+        return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
+                FRAME_RESHAPE)
+
+
+def sneaky_dispatch(kind):
+    # Frame-kind dispatch outside protocol.HANDLERS: drift.
+    return kind == FRAME_ABORT

@@ -1,0 +1,26 @@
+"""HVD008 good fixture: every declared handler branches on exactly the
+frame kinds the spec declares for its states (see protocol.HANDLERS);
+no dispatch outside the declared table."""
+
+FRAME_DATA = 0
+FRAME_HEARTBEAT = 1
+FRAME_ABORT = 2
+FRAME_JOIN = 3
+FRAME_RESHAPE = 4
+
+
+class Wire:
+    def recv_bytes(self):
+        return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
+                FRAME_RESHAPE)
+
+    def recv_hello(self):
+        return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
+                FRAME_RESHAPE)
+
+    def recv_reshape_ack(self, epoch):
+        return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
+                FRAME_RESHAPE)
+
+    def send_join(self, info):
+        return FRAME_JOIN  # sender plumbing: an allowed non-dispatch site

@@ -1,0 +1,14 @@
+"""HVD009 bad fixture: raw ordering comparisons on membership epochs
+(linted as a controller/ path)."""
+
+
+def drain(ack, epoch):
+    if ack < epoch:          # raw ordering on an epoch: HVD009
+        return "stale"
+    return "current"
+
+
+def admit(assignment, current_epoch):
+    while assignment.epoch >= current_epoch:   # HVD009
+        break
+    return current_epoch

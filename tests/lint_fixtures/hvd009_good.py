@@ -1,0 +1,22 @@
+"""HVD009 good fixture: epochs compared only through the sanctioned
+monotonic helpers (or equality, which is not an ordering)."""
+
+from horovod_tpu.analysis.protocol import epoch_advances, epoch_is_stale
+
+
+def drain(ack, epoch):
+    if epoch_is_stale(ack, epoch):
+        return "stale"
+    if ack == epoch:
+        return "commit"
+    return "future"
+
+
+def admit(new_epoch, current_epoch):
+    if epoch_advances(new_epoch, current_epoch):
+        return new_epoch
+    return current_epoch
+
+
+def unrelated(count, limit):
+    return count < limit  # no epoch involved: not a finding

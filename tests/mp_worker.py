@@ -521,17 +521,28 @@ def scenario_elastic_parked(rank, size):
     # Livelock guard (docs/elastic.md): with the world already at
     # --max-ranks, a parked joiner must WAIT — no reshape, no epoch bump,
     # no drained collectives — while the members train on undisturbed.
-    # Wall-clock bounded so the joiner is provably parked DURING steps.
+    # Wall-clock bounded so the joiner is provably parked DURING steps —
+    # but the EXIT is agreed through the collective itself (element 1
+    # carries "my deadline passed"; any rank's flag ends the loop for
+    # every rank in the SAME step). Independent wall-clock exits would
+    # let the faster member finish a step early and prompt-exit, which
+    # elastic correctly treats as that member LEAVING — a reshape this
+    # scenario exists to prove does NOT happen while everyone stays.
     deadline = time.monotonic() + 6.0
     step = 0
-    while time.monotonic() < deadline:
-        total = np.asarray(hvd.allreduce(np.ones(2, np.float32),
-                                         average=False, name=f"pk.{step}"))
+    while True:
+        mine = np.array(
+            [1.0, 1.0 if time.monotonic() >= deadline else 0.0],
+            np.float32)
+        total = np.asarray(hvd.allreduce(mine, average=False,
+                                         name=f"pk.{step}"))
         expect(float(total[0]) == size,
                f"world changed under a parked joiner: {total}")
         expect(hvd.elastic.epoch() == 1,
                f"epoch bumped to {hvd.elastic.epoch()} with no churn")
         step += 1
+        if total[1] > 0:  # synchronized: all ranks exit this same step
+            break
         time.sleep(0.01)
     print(f"PARKED_OK size={hvd.size()} epoch={hvd.elastic.epoch()} "
           f"steps={step}", flush=True)
@@ -695,7 +706,10 @@ def scenario_stall_shutdown(rank, size):
         else:
             raise AssertionError("expected shutdown error on stalled op")
     else:
-        _time.sleep(8)  # never participate
+        # Never participate; just outlive the 2s shutdown threshold (+
+        # warn interval + margin) the parent test configures. Was 8s —
+        # pure wall time on the tier-1 budget.
+        _time.sleep(6)
 
 
 def scenario_torch(rank, size):

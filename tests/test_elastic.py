@@ -10,11 +10,18 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 
 import pytest
 
-from mp_harness import free_port, launch_rank, run_ranks
+from mp_harness import (
+    assert_protocheck_clean,
+    free_port,
+    launch_rank,
+    protocheck_env,
+    run_ranks,
+)
 
 import horovod_tpu.fault.plan as plan_mod
 from horovod_tpu.common.wire import (
@@ -507,27 +514,35 @@ def test_elastic_join_admits_third_rank():
     from rank 0, and all three members settle into lockstep."""
     addr = f"127.0.0.1:{free_port()}"
     base = _elastic_env()
-    procs = [launch_rank("elastic_join", rank, 2, addr, extra_env=base)
-             for rank in range(2)]
-    time.sleep(2.5)  # the 2-rank job is rendezvoused and training
-    procs.append(launch_rank(
-        "elastic_join", 2, 3, addr,
-        extra_env={**base, "HOROVOD_ELASTIC_JOIN": "1"}))
-    deadline = time.monotonic() + 120.0
-    outputs = []
-    for rank, proc in enumerate(procs):
-        try:
-            out, _ = proc.communicate(
-                timeout=max(1.0, deadline - time.monotonic()))
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise AssertionError(f"elastic_join: rank {rank} hung")
-        outputs.append(out)
-    for rank, proc in enumerate(procs):
-        assert proc.returncode == 0, (
-            f"elastic_join: rank {rank} failed:\n{outputs[rank]}")
-        assert "ELASTIC size=3" in outputs[rank], outputs[rank]
+    # The join handshake (JOIN hello -> parked -> admission RESHAPE ->
+    # ack) runs under the conformance monitor: the grow path must be
+    # violation-free end to end, joiner included.
+    with tempfile.TemporaryDirectory(prefix="hvd-protocheck-") as pc_dir:
+        base = {**base, **protocheck_env(pc_dir)}
+        procs = [launch_rank("elastic_join", rank, 2, addr, extra_env=base)
+                 for rank in range(2)]
+        time.sleep(1.5)  # the 2-rank job is rendezvoused and training
+        # (~1.3s to rendezvous; a joiner dialing DURING rendezvous is
+        # rejected and retried by init anyway, so early is safe)
+        procs.append(launch_rank(
+            "elastic_join", 2, 3, addr,
+            extra_env={**base, "HOROVOD_ELASTIC_JOIN": "1"}))
+        deadline = time.monotonic() + 120.0
+        outputs = []
+        for rank, proc in enumerate(procs):
+            try:
+                out, _ = proc.communicate(
+                    timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                raise AssertionError(f"elastic_join: rank {rank} hung")
+            outputs.append(out)
+        for rank, proc in enumerate(procs):
+            assert proc.returncode == 0, (
+                f"elastic_join: rank {rank} failed:\n{outputs[rank]}")
+            assert "ELASTIC size=3" in outputs[rank], outputs[rank]
+        assert assert_protocheck_clean(pc_dir, "elastic_join") == 3
     snap = _rank0_snapshot(outputs)
     transitions = _counter_by_label(snap,
                                     "hvd_membership_transitions_total")
@@ -541,7 +556,9 @@ def test_elastic_parked_joiner_at_max_ranks_does_not_livelock():
     cycle — a livelock), and the coordinator keeps the parked wire alive
     with heartbeats instead of letting its deadline kill it."""
     addr = f"127.0.0.1:{free_port()}"
-    base = {"HOROVOD_ELASTIC": "1", "HOROVOD_ELASTIC_MAX_RANKS": "2"}
+    pc_dir = tempfile.mkdtemp(prefix="hvd-protocheck-")
+    base = {"HOROVOD_ELASTIC": "1", "HOROVOD_ELASTIC_MAX_RANKS": "2",
+            **protocheck_env(pc_dir)}
     procs = [launch_rank("elastic_parked", rank, 2, addr, extra_env=base)
              for rank in range(2)]
     time.sleep(1.5)  # members are rendezvoused and mid-run
@@ -562,12 +579,27 @@ def test_elastic_parked_joiner_at_max_ranks_does_not_livelock():
                 f"elastic_parked: rank {rank} failed:\n{outputs[rank]}")
             assert "PARKED_OK size=2 epoch=1" in outputs[rank], \
                 outputs[rank]
+        # The members' wires (and the coordinator's parked-joiner wire,
+        # heartbeats only) stayed on-spec the whole time.
+        assert_protocheck_clean(pc_dir, "elastic_parked", require=2)
     finally:
-        # The joiner is still (correctly) parked when the members finish.
-        assert joiner.poll() is None, \
-            f"parked joiner died:\n{joiner.communicate()[0]}"
-        joiner.kill()
-        joiner.communicate()
+        # The joiner stayed (correctly) parked for the members' whole
+        # run: either it is still blocked in await_assignment, or — the
+        # members having just exited and closed the coordinator — it
+        # died of the teardown's "peer closed connection" moments ago
+        # (a photo-finish race this assertion must not depend on). What
+        # it must NEVER show is a liveness-deadline death while parked:
+        # that would mean the coordinator's heartbeats stopped reaching
+        # the parked wire.
+        if joiner.poll() is None:
+            joiner.kill()
+            joiner.communicate()
+        else:
+            out = joiner.communicate()[0]
+            assert "peer closed connection" in out, (
+                f"parked joiner died for the wrong reason:\n{out}")
+            assert "CommTimeoutError" not in out, (
+                f"parked joiner was deadline-killed while parked:\n{out}")
 
 
 @pytest.mark.slow

@@ -65,7 +65,8 @@ def test_word2vec_example_smoke():
     assert "pairs/sec" in out
 
 
-def test_tensorflow_word2vec_two_ranks():
+@pytest.mark.slow  # ~14 s; test_word2vec_example_smoke keeps the
+def test_tensorflow_word2vec_two_ranks():  # word2vec path in tier-1
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable, os.path.join(EX, "tensorflow_word2vec.py"),
                 "--steps", "10", "--batch-size", "64",
@@ -76,6 +77,7 @@ def test_tensorflow_word2vec_two_ranks():
     assert "proj grad: EagerTensor" in out
 
 
+@pytest.mark.slow  # ~11 s; spark coverage stays in test_spark{,_e2e}.py
 def test_keras_spark_rossmann_fallback_path():
     # pyspark is absent in this image; the example's in-process path still
     # runs the full feature-engineering + entity-embedding pipeline.
@@ -175,7 +177,8 @@ def test_scaling_efficiency_smoke():
     assert '"efficiency":' in out
 
 
-def test_tensorflow_mnist_two_ranks():
+@pytest.mark.slow  # ~15 s; tensorflow_mnist_eager_two_ranks keeps the tf
+def test_tensorflow_mnist_two_ranks():  # 2-rank mnist path in tier-1
     # The tf.function path: allreduce rides a py_function node inside the
     # traced step.
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
@@ -199,7 +202,8 @@ def test_tensorflow_keras_mnist_two_ranks(tmp_path):
     assert "final: acc=" in out
 
 
-def test_keras_mnist_advanced_two_ranks():
+@pytest.mark.slow  # ~14 s; tensorflow_keras_mnist_two_ranks keeps the
+def test_keras_mnist_advanced_two_ranks():  # keras 2-rank path in tier-1
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable, os.path.join(EX, "keras_mnist_advanced.py"),
                 "--epochs", "2", "--batch-size", "256",
@@ -207,7 +211,8 @@ def test_keras_mnist_advanced_two_ranks():
     assert "final: acc=" in out
 
 
-def test_torch_imagenet_resnet50_two_ranks_resume(tmp_path):
+@pytest.mark.slow  # ~24 s (two launches); torch_mnist_two_ranks keeps
+def test_torch_imagenet_resnet50_two_ranks_resume(tmp_path):  # torch 2-rank
     fmt = str(tmp_path / "checkpoint-{epoch}.pth.tar")
     script = os.path.join(EX, "torch_imagenet_resnet50.py")
     args = ["--steps-per-epoch", "2", "--batch-size", "2", "--image-size",
@@ -253,7 +258,8 @@ def test_mxnet_imagenet_resnet50_two_ranks():
     assert "epoch 0" in out
 
 
-def test_tensorflow_synthetic_benchmark_two_ranks():
+@pytest.mark.slow  # ~22 s model build; torch_synthetic_benchmark keeps
+def test_tensorflow_synthetic_benchmark_two_ranks():  # the bench path
     out = _run([sys.executable, "-m", "horovod_tpu.run", "-np", "2",
                 sys.executable,
                 os.path.join(EX, "tensorflow_synthetic_benchmark.py"),

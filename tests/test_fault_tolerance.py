@@ -431,7 +431,15 @@ def test_wedged_init_exhausted_budget_fails_loudly():
 def _run_chaos(scenario, plan, size=2, timeout=90.0, extra_env=None,
                expect_killed=()):
     """Spawn ranks like tests/test_multiprocess.run_ranks, with a shared
-    seeded fault plan; returns (outputs, returncodes)."""
+    seeded fault plan; returns (outputs, returncodes). Every chaos run
+    also runs under the wire-protocol conformance monitor
+    (HOROVOD_PROTOCHECK=1) and asserts zero recorded violations — the
+    kill/drop chaos suite doubles as a conformance suite."""
+    import shutil
+    import tempfile
+
+    from mp_harness import assert_protocheck_clean, protocheck_env
+
     def free_port():
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
@@ -440,49 +448,56 @@ def _run_chaos(scenario, plan, size=2, timeout=90.0, extra_env=None,
         return port
 
     addr = f"127.0.0.1:{free_port()}"
+    pc_dir = tempfile.mkdtemp(prefix="hvd-protocheck-")
     procs = []
-    for rank in range(size):
-        env = dict(os.environ)
-        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-        env["JAX_PLATFORMS"] = "cpu"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update({
-            "HOROVOD_RANK": str(rank),
-            "HOROVOD_SIZE": str(size),
-            "HOROVOD_LOCAL_RANK": str(rank),
-            "HOROVOD_LOCAL_SIZE": str(size),
-            "HOROVOD_CONTROLLER_ADDR": addr,
-            "HOROVOD_ENGINE": "python",  # fault hooks live in the python
-            "HOROVOD_CYCLE_TIME": "1",   # controller's star control plane
-            "HOROVOD_FAULT_PLAN": json.dumps(plan),
-            "HOROVOD_STALL_CHECK_TIME_SECONDS": "5",
-        })
-        env.update(extra_env or {})
-        procs.append(subprocess.Popen(
-            [sys.executable, WORKER, scenario], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
-    deadline = time.monotonic() + timeout
-    outputs = []
-    for rank, proc in enumerate(procs):
-        try:
-            out, _ = proc.communicate(
-                timeout=max(1.0, deadline - time.monotonic()))
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise AssertionError(
-                f"chaos {scenario}: rank {rank} hung past the timeout")
-        outputs.append(out)
-    for rank in expect_killed:
-        assert procs[rank].returncode == -9, (
-            f"rank {rank} expected SIGKILL, got {procs[rank].returncode}:\n"
-            f"{outputs[rank]}")
-    for rank, proc in enumerate(procs):
-        if rank not in expect_killed:
-            assert proc.returncode == 0, (
-                f"chaos {scenario}: rank {rank} failed "
-                f"(exit {proc.returncode}):\n{outputs[rank]}")
-    return outputs
+    try:
+        for rank in range(size):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.update({
+                "HOROVOD_RANK": str(rank),
+                "HOROVOD_SIZE": str(size),
+                "HOROVOD_LOCAL_RANK": str(rank),
+                "HOROVOD_LOCAL_SIZE": str(size),
+                "HOROVOD_CONTROLLER_ADDR": addr,
+                "HOROVOD_ENGINE": "python",  # fault hooks live in the python
+                "HOROVOD_CYCLE_TIME": "1",   # controller's star control plane
+                "HOROVOD_FAULT_PLAN": json.dumps(plan),
+                "HOROVOD_STALL_CHECK_TIME_SECONDS": "5",
+            })
+            env.update(protocheck_env(pc_dir))
+            env.update(extra_env or {})
+            procs.append(subprocess.Popen(
+                [sys.executable, WORKER, scenario], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        deadline = time.monotonic() + timeout
+        outputs = []
+        for rank, proc in enumerate(procs):
+            try:
+                out, _ = proc.communicate(
+                    timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                raise AssertionError(
+                    f"chaos {scenario}: rank {rank} hung past the timeout")
+            outputs.append(out)
+        for rank in expect_killed:
+            assert procs[rank].returncode == -9, (
+                f"rank {rank} expected SIGKILL, got {procs[rank].returncode}"
+                f":\n{outputs[rank]}")
+        for rank, proc in enumerate(procs):
+            if rank not in expect_killed:
+                assert proc.returncode == 0, (
+                    f"chaos {scenario}: rank {rank} failed "
+                    f"(exit {proc.returncode}):\n{outputs[rank]}")
+        assert_protocheck_clean(pc_dir, context=f"chaos {scenario}",
+                                require=1)
+        return outputs
+    finally:
+        shutil.rmtree(pc_dir, ignore_errors=True)
 
 
 def test_worker_death_mid_allreduce_aborts_survivors_descriptively():

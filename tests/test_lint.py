@@ -86,7 +86,11 @@ def test_baseline_entries_still_exist():
 # 2. Per-rule fixture proofs
 
 
-_RELPATHS = {"HVD002": "horovod_tpu/controller/_fixture.py"}
+_RELPATHS = {"HVD002": "horovod_tpu/controller/_fixture.py",
+             # HVD008 is scoped to the protocol surface; the fixture is
+             # linted AS the real wire module path.
+             "HVD008": "horovod_tpu/common/wire.py",
+             "HVD009": "horovod_tpu/controller/_epochs.py"}
 
 
 @pytest.mark.parametrize("code", [cls.code for cls in ALL_RULES])
@@ -114,6 +118,86 @@ def test_hvd002_is_scoped_to_controller_paths():
     findings = lint_source(src, "horovod_tpu/utils/elsewhere.py",
                            rules=[get_rule("HVD002")()])
     assert not findings
+
+
+def test_hvd002_all_paths_mode_for_the_aux_scan():
+    src = _fixture("hvd002_bad.py")
+    findings = lint_source(src, "tests/anywhere.py",
+                           rules=[get_rule("HVD002")(all_paths=True)])
+    assert findings and all(f.rule == "HVD002" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 2b. Interprocedural HVD001 (call graph + rank taint, ISSUE 8)
+
+
+def test_interprocedural_hvd001_catches_two_calls_deep():
+    """The acceptance fixture: the collective sits two helper calls
+    below the rank conditional; the upgraded rule must flag the call
+    site under the conditional and name the chain down to the
+    collective."""
+    src = _fixture("hvd001_interproc_bad.py")
+    findings = lint_source(src, "horovod_tpu/x.py",
+                           rules=[get_rule("HVD001")()])
+    assert len(findings) == 2, "\n".join(f.render() for f in findings)
+    by_msg = sorted(f.message for f in findings)
+    assert "warm_up -> _sync -> barrier" in by_msg[1]
+    assert "_sync -> barrier" in by_msg[0]
+
+
+def test_lexical_hvd001_misses_interprocedural_fixture():
+    """Pin of the round-10 rule's blindness: the SAME fixture produces
+    zero findings for the lexical-only mode — the regression this PR
+    closes, kept visible."""
+    src = _fixture("hvd001_interproc_bad.py")
+    findings = lint_source(
+        src, "horovod_tpu/x.py",
+        rules=[get_rule("HVD001")(interprocedural=False)])
+    assert findings == []
+
+
+def test_interprocedural_hvd001_rank_taint_reaches_renamed_test():
+    """``is_root = local_rank == 0; if is_root: _sync()`` — the taint
+    pass marks is_root rank-derived, so the conditional counts."""
+    src = _fixture("hvd001_interproc_bad.py")
+    findings = lint_source(src, "horovod_tpu/x.py",
+                           rules=[get_rule("HVD001")()])
+    lines = {f.line for f in findings}
+    tainted_call_line = src.splitlines().index(
+        "        _sync()                  # one call deep, renamed test: "
+        "HVD001") + 1
+    assert tainted_call_line in lines
+
+
+def test_interprocedural_hvd001_respects_suppressed_collectives():
+    """A collective already justified inline (subgroup == conditional)
+    must not re-flag its callers through the closure."""
+    src = ("def cross_ring():\n"
+           "    ring.allreduce_(buf)  # hvdlint: disable=HVD001 subgroup\n"
+           "\n"
+           "def maybe(rank):\n"
+           "    if rank == 0:\n"
+           "        cross_ring()\n")
+    findings = lint_source(src, "horovod_tpu/x.py",
+                           rules=[get_rule("HVD001")()])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_hvd008_names_missing_and_drifted_branches():
+    src = _fixture("hvd008_bad.py")
+    findings = lint_source(src, "horovod_tpu/common/wire.py",
+                           rules=[get_rule("HVD008")()])
+    messages = "\n".join(f.message for f in findings)
+    assert "missing transition" in messages
+    assert "'reshape'" in messages
+    assert "handler drift" in messages and "sneaky_dispatch" in messages
+
+
+def test_hvd009_is_scoped_to_the_protocol_surface():
+    src = _fixture("hvd009_bad.py")
+    findings = lint_source(src, "horovod_tpu/run/launch.py",
+                           rules=[get_rule("HVD009")()])
+    assert findings == []  # restart/training epochs are out of scope
 
 
 def test_hvd007_counts_duplicates_and_bad_names():
@@ -244,6 +328,124 @@ def test_cli_json_and_exit_codes(tmp_path):
     res = subprocess.run(base + ["--baseline", bl], env=env,
                          capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_fix_autofixes_mechanical_rules_idempotently(tmp_path):
+    """--fix satellite: HVD002 gets its sorted() wrap, HVD005 its
+    name=/daemon= kwargs; a second --fix changes NOTHING (idempotence:
+    --fix twice == once), and the fixed files lint clean."""
+    pkg = tmp_path / "controller"
+    pkg.mkdir()
+    f2 = pkg / "walks.py"
+    f2.write_text(_fixture("hvd002_bad.py"))
+    f5 = pkg / "threads.py"
+    f5.write_text(_fixture("hvd005_bad.py"))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable, "-m", "horovod_tpu.tools.lint", str(pkg),
+           "--fix", "--select", "HVD002,HVD005", "--baseline", "none"]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "applied" in res.stdout
+    once = f2.read_text(), f5.read_text()
+    assert "sorted(ticks.items())" in once[0]
+    assert 'name="hvd-worker"' in once[1] and "daemon=True" in once[1]
+    res = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "applied 0 fix(es)" in res.stdout
+    assert (f2.read_text(), f5.read_text()) == once  # twice == once
+    from horovod_tpu.analysis.rules import get_rule as _gr
+
+    assert not lint_source(once[0], "horovod_tpu/controller/walks.py",
+                           rules=[_gr("HVD002")()])
+    assert not lint_source(once[1], "horovod_tpu/threads.py",
+                           rules=[_gr("HVD005")()])
+
+
+def test_fix_leaves_suppressed_sites_alone(tmp_path):
+    from horovod_tpu.analysis.autofix import fix_source
+
+    src = ("def f(d, wire):\n"
+           "    for k, v in d.items():  # hvdlint: disable=HVD002 why\n"
+           "        wire.send((k, v))\n")
+    fixed, n = fix_source(src, "horovod_tpu/controller/x.py")
+    assert n == 0 and fixed == src
+
+
+def test_fix_handles_trailing_comma_and_stays_parseable():
+    """A multi-line Thread(...) that already ends with a trailing comma
+    must not grow a second one — and any fix whose output does not
+    parse is refused outright rather than written to disk."""
+    import ast
+
+    from horovod_tpu.analysis.autofix import fix_source
+
+    src = ("import threading\n"
+           "t = threading.Thread(\n"
+           "    target=print,\n"
+           ")\n")
+    fixed, n = fix_source(src, "horovod_tpu/x.py")
+    assert n == 1
+    ast.parse(fixed)  # the corruption mode: ',\n, name=...' SyntaxError
+    assert 'name="hvd-worker"' in fixed and "daemon=True" in fixed
+
+
+def test_fix_respects_select():
+    from horovod_tpu.analysis.autofix import fix_source
+
+    src = ("import threading\n"
+           "def f(d, t):\n"
+           "    for k in d.items():\n"
+           "        threading.Thread(target=print).start()\n")
+    fixed, n = fix_source(src, "horovod_tpu/controller/x.py",
+                          select=["HVD002"])
+    assert n == 1
+    assert "sorted(d.items())" in fixed
+    assert "daemon" not in fixed  # HVD005 not selected: untouched
+
+
+# ---------------------------------------------------------------------------
+# 3b. Aux coverage: tests/ + examples/ under the scoped rule-set
+
+
+AUX_BASELINE = os.path.join(REPO, ".hvdlint-aux-baseline.json")
+
+
+def _aux_scan(baseline):
+    from horovod_tpu.analysis.rules import aux_rules
+
+    return run_lint([os.path.join(REPO, "tests"),
+                     os.path.join(REPO, "examples")],
+                    rules=aux_rules(), root=REPO, baseline=baseline,
+                    exclude_dirs=("__pycache__", "lint_fixtures"))
+
+
+def test_aux_scan_tests_and_examples_clean_against_baseline():
+    """New test/example code can't reintroduce unordered-dict (HVD002,
+    unscoped — mp scenario bodies run on every rank), anonymous-thread
+    (HVD005), or import-time-side-effect (HVD006) bugs: pre-existing
+    findings are grandfathered in .hvdlint-aux-baseline.json (48
+    entries at introduction, a ratchet — shrink it, never grow it)."""
+    baseline = load_baseline(AUX_BASELINE)
+    result = _aux_scan(baseline)
+    assert not result.parse_errors, result.parse_errors
+    assert result.files_scanned > 80, "aux scan looks truncated"
+    assert not result.findings, (
+        "NEW aux findings in tests/ or examples/ (fix them or suppress "
+        "with a rationale — do not grow the aux baseline):\n"
+        + "\n".join(f.render() for f in result.findings))
+
+
+def test_aux_baseline_entries_still_exist():
+    baseline = load_baseline(AUX_BASELINE)
+    result = _aux_scan(baseline)
+    live = {baseline_key(f.as_dict()) for f in result.baselined}
+    stale = [e for e in baseline if baseline_key(e) not in live]
+    assert not stale, f"stale aux baseline entries (remove): {stale}"
 
 
 def test_cli_refuses_partial_rewrite_of_default_baseline(tmp_path):
@@ -437,10 +639,12 @@ def test_lockcheck_three_rank_run_produces_acyclic_graph(tmp_path):
         assert proc.returncode == 0, (
             f"rank {rank} failed under lockcheck:\n{stdout[-3000:]}")
     edges_seen = 0
+    reports = []
     for rank in range(size):
         path = f"{out}.rank{rank}"
         assert os.path.exists(path), f"rank {rank} wrote no lock graph"
         payload = json.loads(open(path).read())
+        reports.append(payload)
         assert payload["acyclic"] is True, (
             f"rank {rank} lock-order CYCLE: {payload['cycles']}")
         edges_seen += len(payload["edges"])
@@ -448,3 +652,13 @@ def test_lockcheck_three_rank_run_produces_acyclic_graph(tmp_path):
     # observations — an all-empty graph would mean the factory isn't
     # actually wired into the runtime locks.
     assert edges_seen > 0, "no lock-order edges recorded on any rank"
+    # Static×runtime join (ISSUE 8 acceptance): the AST-extracted
+    # potential lock-order graph must be a SUPERSET of every runtime
+    # graph this real job just produced — otherwise "statically possible
+    # cycles never observed" would be a hollow claim.
+    from horovod_tpu.analysis import lockorder
+
+    join = lockorder.join_reports(lockorder.static_graph(), reports)
+    assert join["superset"], (
+        "runtime lock edges missing from the static graph: "
+        f"{join['uncovered_runtime_edges']}")
