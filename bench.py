@@ -286,6 +286,20 @@ FULL_ROWS = {
         "args": ["--model", "300m", "--prompt-len", "128",
                  "--max-new-tokens", "256", "--batch-size", "32"],
         "regex": r"(\d+) decode tokens/sec", "unit": "decode tok/s/chip"},
+    # Serving row (round 9): the continuous batcher + paged KV cache over
+    # the same decode path, driven by the seeded open-loop load generator
+    # (fixed arrival trace: seed 9, Poisson-ish at 64 req/s, prompt
+    # lengths spanning 4x). Reports tokens/sec and p99 TTFT; the full
+    # record — block accounting, preemptions, doctor verdict — lands in
+    # artifacts/serving_r9.json beside the training rows.
+    "llama_300m_serving_b8_loadgen": {
+        "script": "examples/serving_loadgen.py",
+        "args": ["--model", "300m", "--requests", "32", "--seed", "9",
+                 "--rate", "64", "--min-prompt", "32", "--max-prompt",
+                 "128", "--min-new", "32", "--max-new", "128",
+                 "--max-seq-len", "256",
+                 "--out", "artifacts/serving_r9.json"],
+        "json": True},
 }
 
 

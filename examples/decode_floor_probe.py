@@ -93,6 +93,12 @@ def main() -> int:
                     help="weights+cache roofline for the config (r5 "
                     "artifact models b8 at ~9.3k tok/s on v5e); when "
                     "set, the artifact records pct_of_roofline")
+    ap.add_argument("--serving", action="store_true",
+                    help="also run the same b-request workload through "
+                    "the hvd.serving continuous batcher (the floor's "
+                    "first customer: the batcher amortizes exactly the "
+                    "per-iteration cost this probe pins) and record the "
+                    "amortized rate beside the bare rows")
     ap.add_argument("--out", default="artifacts/decode_ceiling_r6.json")
     args = ap.parse_args()
 
@@ -148,6 +154,61 @@ def main() -> int:
         "empty_loop_us_per_iter": round(floor_us_per_iter, 2),
         "decode_tok_s": rows,
     }
+    if args.serving:
+        # The serving tier over the same workload: the probe's batch
+        # becomes batch-size individual requests through the continuous
+        # batcher — per-request arrivals, one shared decode loop. The
+        # amortized rate lands beside the bare b8 floor rows so the
+        # artifact answers "what does the batcher buy over bare
+        # generate() at this batch" directly.
+        from horovod_tpu.serving import ServingConfig
+        from horovod_tpu.serving.engine import ServingEngine
+
+        scfg = ServingConfig(
+            max_batch=args.batch_size, block_size=16, num_blocks=0,
+            queue_depth=max(2 * args.batch_size, 8),
+            max_seq_len=args.prompt_len + args.max_new_tokens + 1)
+        engine = ServingEngine(model, variables, config=scfg)
+        handles = [engine.submit(np.asarray(prompt)[i],
+                                 args.max_new_tokens)
+                   for i in range(args.batch_size)]
+        engine.run_until_idle()          # compile pass (unmeasured)
+        for h in handles:
+            h.result(timeout=0)
+        # Drop the warmup engine's pools before the measured pass: two
+        # fully-provisioned pools during measurement would double the
+        # serving tier's HBM footprint (the module-level jit cache keeps
+        # the compiled programs either way).
+        engine.shutdown()
+        del engine
+        engine2 = ServingEngine(model, variables, config=scfg)
+        t0 = time.perf_counter()
+        handles = [engine2.submit(np.asarray(prompt)[i],
+                                  args.max_new_tokens)
+                   for i in range(args.batch_size)]
+        engine2.run_until_idle()
+        serving_s = time.perf_counter() - t0
+        outs = [h.result(timeout=0) for h in handles]
+        mism = sum(int(np.any(np.asarray(o)
+                              != np.asarray(baseline)[i, args.prompt_len:]))
+                   for i, o in enumerate(outs))
+        if mism:
+            print(f"WARNING: serving changed tokens in {mism} request(s) "
+                  "(bf16 tie noise)", file=sys.stderr)
+        st = engine2.stats()
+        rate = args.batch_size * args.max_new_tokens / serving_s
+        print(f"serving b{args.batch_size}: {rate:.0f} tok/s "
+              f"({st['steps']} steps)", file=sys.stderr)
+        # Compare against the first measured bare row — --unrolls need
+        # not include 1.
+        bare_key = next(iter(rows))
+        record["serving"] = {
+            "tok_s": round(rate, 1),
+            "steps": st["steps"],
+            "preemptions": st["preemptions"],
+            "blocks_peak": st["blocks_peak"],
+            f"vs_bare_{bare_key}": round(rate / rows[bare_key], 3),
+        }
     if args.roofline_tok_s:
         record["roofline_tok_s"] = args.roofline_tok_s
         record["pct_of_roofline"] = {

@@ -1,0 +1,189 @@
+"""Seeded open-loop load generator for the serving tier (docs/serving.md).
+
+Drives ``hvd.serving`` with a fully deterministic workload derived from
+one seed: Poisson-ish arrivals (exponential inter-arrival gaps at
+``--rate`` requests/sec; ``--rate 0`` = one burst at t=0), prompt
+lengths uniform over ``[--min-prompt, --max-prompt]`` (the default span
+is 4x — the heterogeneity a paged cache exists for), and per-request
+output budgets uniform over ``[--min-new, --max-new]``. The *trace* is
+reproducible bit-for-bit from the seed; only the measured latencies
+depend on the hardware.
+
+Prints one JSON record (tokens/sec, TTFT/TPOT p50/p99, block
+accounting incl. the paged-vs-contiguous peak comparison, the doctor's
+serving verdict) and writes it to ``--out`` — the serving bench row
+(``bench.py --full``) runs exactly this with
+``--out artifacts/serving_r9.json``. The acceptance test drives the
+same module in-process for the deterministic scheduling checks.
+
+Run: python examples/serving_loadgen.py --model tiny --requests 32 \
+         --seed 9 --rate 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def build_trace(seed: int, requests: int, rate: float, min_prompt: int,
+                max_prompt: int, min_new: int, max_new: int,
+                vocab_size: int):
+    """The deterministic workload: [(arrival_s, prompt_ids, new_tokens)].
+    Pure function of the arguments — the bench row's 'fixed arrival
+    trace'."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    trace = []
+    for _ in range(requests):
+        if rate > 0:
+            t += float(rng.exponential(1.0 / rate))
+        plen = int(rng.randint(min_prompt, max_prompt + 1))
+        new = int(rng.randint(min_new, max_new + 1))
+        prompt = rng.randint(0, vocab_size, (plen,)).astype(np.int32)
+        trace.append((t, prompt, new))
+    return trace
+
+
+def run_workload(engine, trace, timeout_s: float = 600.0):
+    """Replay the trace open-loop against a started engine. Returns
+    (handles, rejected, wall_seconds) — rejected submissions are
+    counted, not retried (open loop: the client does not slow down)."""
+    from horovod_tpu.serving import RejectedError
+
+    handles = []
+    rejected = 0
+    t0 = time.monotonic()
+    for arrival, prompt, new in trace:
+        now = time.monotonic() - t0
+        if arrival > now:
+            time.sleep(arrival - now)
+        try:
+            handles.append(engine.submit(prompt, new))
+        except RejectedError:
+            rejected += 1
+    for handle in handles:
+        try:
+            handle.result(timeout=timeout_s)
+        except (RuntimeError, TimeoutError):
+            pass  # counted via engine stats; the record stays honest
+    return handles, rejected, time.monotonic() - t0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny",
+                    choices=["tiny", "300m", "1b"])
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=9)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="mean arrivals/sec (0 = burst at t=0)")
+    ap.add_argument("--min-prompt", type=int, default=16)
+    ap.add_argument("--max-prompt", type=int, default=64)
+    ap.add_argument("--min-new", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=64)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="0 = fully provisioned")
+    ap.add_argument("--queue-depth", type=int, default=128)
+    ap.add_argument("--max-seq-len", type=int, default=256)
+    ap.add_argument("--f32", action="store_true",
+                    help="run the model in f32 (exact cross-path parity)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the unmeasured compile pass")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON record here")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import LLAMA_1B, LLAMA_300M, LLAMA_TINY, LlamaLM
+    from horovod_tpu.serving import ServingConfig
+    from horovod_tpu.serving.engine import ServingEngine
+
+    hvd.init()
+    cfg = {"tiny": LLAMA_TINY, "300m": LLAMA_300M,
+           "1b": LLAMA_1B}[args.model]
+    if args.f32:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    model = LlamaLM(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    scfg = ServingConfig(
+        max_batch=args.max_batch, block_size=args.block_size,
+        num_blocks=args.num_blocks, queue_depth=args.queue_depth,
+        max_seq_len=args.max_seq_len)
+
+    trace = build_trace(args.seed, args.requests, args.rate,
+                        args.min_prompt, args.max_prompt, args.min_new,
+                        args.max_new, cfg.vocab_size)
+
+    if not args.no_warmup:
+        # Unmeasured pass: compiles the decode step and every distinct
+        # prefill block count, so the measured TTFT is serving latency,
+        # not XLA compile time. The jit cache is module-level — the
+        # measured engine below hits it. Metrics stay OFF here (enabled
+        # just below) and the engine is dropped before the measured one
+        # exists: the doctor verdict and the block gauges in the record
+        # must describe the MEASURED run only, with one pool's HBM.
+        warm = ServingEngine(model, variables, config=scfg).start()
+        run_workload(warm, trace)
+        warm.shutdown()
+        del warm
+
+    hvd.metrics.enable()  # gauges feed the doctor's serving verdict
+    engine = ServingEngine(model, variables, config=scfg).start()
+    path = engine.decode_path
+    handles, rejected, wall = run_workload(engine, trace)
+    stats = engine.stats()
+    health = hvd.doctor.summary()
+    engine.shutdown()
+
+    contiguous_blocks = scfg.max_batch * (
+        (scfg.max_seq_len + scfg.block_size - 1) // scfg.block_size)
+    record = {
+        "metric": "serving_loadgen",
+        "value": (round(stats["tokens_generated"] / wall, 1)
+                  if wall > 0 else None),
+        "unit": "decode tok/s",
+        "model": args.model, "requests": args.requests,
+        "seed": args.seed, "rate_per_s": args.rate,
+        "prompt_lens": [args.min_prompt, args.max_prompt],
+        "new_tokens": [args.min_new, args.max_new],
+        "substrate": jax.default_backend(),
+        "path": path.path, "path_reason": path.reason,
+        "wall_s": round(wall, 3),
+        "ttft_p50_s": stats["ttft_p50_seconds"],
+        "ttft_p99_s": stats["ttft_p99_seconds"],
+        "tpot_p50_s": stats["tpot_p50_seconds"],
+        "tpot_p99_s": stats["tpot_p99_seconds"],
+        "finished": stats["requests_finished"],
+        "rejected": rejected,
+        "preemptions": stats["preemptions"],
+        "steps": stats["steps"],
+        "blocks_peak": stats["blocks_peak"],
+        "blocks_total": stats["blocks_total"],
+        "blocks_contiguous_equiv": contiguous_blocks,
+        "paged_vs_contiguous_peak": (
+            round(stats["blocks_peak"] / contiguous_blocks, 4)
+            if contiguous_blocks else None),
+        "health": health,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,4 +67,5 @@ from . import metrics  # noqa: F401  (hvd.metrics.snapshot() et al.)
 from . import trace  # noqa: F401  (hvd.trace.summary() / merge tooling)
 from . import doctor  # noqa: F401  (hvd.doctor.report() / rule catalog)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, docs/elastic.md)
+from . import serving  # noqa: F401  (hvd.serving.serve / stats, docs/serving.md)
 from .common import profiler  # noqa: F401

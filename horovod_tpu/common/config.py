@@ -265,6 +265,47 @@ def elastic_max_ranks() -> int:
     return max(0, _env_int("HOROVOD_ELASTIC_MAX_RANKS", 0))
 
 
+def serving_max_batch() -> int:
+    """``HOROVOD_SERVING_MAX_BATCH``: decode-batch slots in the serving
+    engine — the most sequences one continuous-batching decode step
+    carries (docs/serving.md). Garbage/non-positive falls back to the
+    default 8 (the b8 decode floor the batcher exists to amortize)."""
+    val = _env_int("HOROVOD_SERVING_MAX_BATCH", 8)
+    return val if val > 0 else 8
+
+
+def serving_block_size() -> int:
+    """``HOROVOD_SERVING_BLOCK_SIZE``: KV-cache page size in token
+    positions. Default 16 — on real models the flat head width is a
+    128-lane multiple, so a 16-row block is one bf16 Mosaic tile."""
+    val = _env_int("HOROVOD_SERVING_BLOCK_SIZE", 16)
+    return val if val > 0 else 16
+
+
+def serving_num_blocks() -> int:
+    """``HOROVOD_SERVING_NUM_BLOCKS``: physical KV pool capacity in
+    blocks (the null block is extra). 0 (default) = fully provisioned —
+    every decode slot can hold a max-length sequence, so preemption is
+    impossible; operators lower it to oversubscribe HBM and let
+    preemption-by-recompute absorb the tail."""
+    return max(0, _env_int("HOROVOD_SERVING_NUM_BLOCKS", 0))
+
+
+def serving_queue_depth() -> int:
+    """``HOROVOD_SERVING_QUEUE_DEPTH``: admission bound — submissions
+    beyond this many WAITING requests are rejected loudly
+    (``hvd.serving.RejectedError``) instead of queueing without bound."""
+    val = _env_int("HOROVOD_SERVING_QUEUE_DEPTH", 128)
+    return val if val > 0 else 128
+
+
+def serving_max_seq_len() -> int:
+    """``HOROVOD_SERVING_MAX_SEQ_LEN``: per-sequence position budget
+    (prompt + generated) in the serving engine. 0 (default) = the
+    model's own ``max_seq_len``."""
+    return max(0, _env_int("HOROVOD_SERVING_MAX_SEQ_LEN", 0))
+
+
 def fault_plan_raw() -> Optional[str]:
     """``HOROVOD_FAULT_PLAN``: inline JSON or ``@file`` reference for the
     deterministic fault-injection plan; None/blank disables."""

@@ -52,6 +52,11 @@ MEMBERSHIP_CHURN_CRITICAL = 10
 AUTOTUNE_STALLED_MIN_CYCLES = 500  # controller cycles before "stalled"
 AUTOTUNE_WANDER_MIN_STEPS = 10     # steps before "wandering" is judged
 AUTOTUNE_WANDER_RATIO = 0.5        # last score vs best score
+# -- serving tier ------------------------------------------------------------
+SERVING_QUEUE_SATURATION_SHARE = 0.9   # waiting depth vs admission bound
+SERVING_CRITICAL_REJECTS = 10          # shed requests before "critical"
+SERVING_MIN_PREEMPTIONS = 3            # pool-dry recomputes before warning
+SERVING_CRITICAL_PREEMPTIONS = 20
 
 
 @dataclasses.dataclass
@@ -481,6 +486,63 @@ def check_autotune_search(ev: Evidence) -> Iterator[Diagnosis]:
                       "steps_completed": int(steps)})
 
 
+def check_serving_pressure(ev: Evidence) -> Iterator[Diagnosis]:
+    """The serving tier is saturated: admission control is shedding
+    load (queue at its bound / rejects counted), or the paged KV pool
+    keeps running dry (preemption-by-recompute replaying whole
+    prefixes). Both are capacity verdicts with direct knobs —
+    docs/serving.md. Evidence: the ``hvd_serving_*`` series in any
+    rank's snapshot; ``rank`` is attached only when more than one rank
+    serves (a lone serving process needs no rank attribution)."""
+    many = len(ev.snapshots) > 1
+    for rank in sorted(ev.snapshots):
+        snap = ev.snapshots[rank]
+        subject = rank if many else None
+        limit = _gauge({rank: snap}, "hvd_serving_queue_limit")
+        depth = _gauge({rank: snap}, "hvd_serving_queue_depth") or 0.0
+        rejects = _counter_by_first_label(
+            snap, "hvd_serving_requests_total").get("rejected", 0.0)
+        if limit and (rejects > 0
+                      or depth >= SERVING_QUEUE_SATURATION_SHARE * limit):
+            sev = ("critical" if rejects >= SERVING_CRITICAL_REJECTS
+                   else "warning")
+            yield Diagnosis(
+                rule="serving_queue_saturation", severity=sev, rank=subject,
+                summary=(f"serving queue at {int(depth)}/{int(limit)} "
+                         f"with {int(rejects)} rejected request(s)"),
+                hint=("admission control is shedding load — arrivals "
+                      "outpace the decode loop; add serving capacity "
+                      "(another replica, or a larger "
+                      "HOROVOD_SERVING_MAX_BATCH if the chip has "
+                      "headroom) or slow the client, and check "
+                      "hvd_serving_ttft_seconds for how far the backlog "
+                      "already pushed first-token latency"),
+                evidence={"queue_depth": int(depth),
+                          "queue_limit": int(limit),
+                          "rejected": int(rejects)})
+        total_preempts = sum(
+            v for _, v in (snap.get("hvd_serving_preemptions_total")
+                           or {}).get("values", []))
+        if total_preempts >= SERVING_MIN_PREEMPTIONS:
+            sev = ("critical"
+                   if total_preempts >= SERVING_CRITICAL_PREEMPTIONS
+                   else "warning")
+            blocks = _gauge({rank: snap}, "hvd_serving_blocks_total")
+            yield Diagnosis(
+                rule="serving_block_exhaustion", severity=sev, rank=subject,
+                summary=(f"paged KV pool ran dry {int(total_preempts)} "
+                         "time(s) (preemption-by-recompute)"),
+                hint=("each preemption drops a sequence's KV blocks and "
+                      "re-prefills its whole prefix later — correct but "
+                      "pure overhead; raise HOROVOD_SERVING_NUM_BLOCKS "
+                      "(more HBM for the pool) or lower "
+                      "HOROVOD_SERVING_MAX_BATCH so fewer sequences "
+                      "share it"),
+                evidence={"preemptions": int(total_preempts),
+                          "blocks_total": (int(blocks)
+                                           if blocks is not None else None)})
+
+
 ALL_RULES = (
     check_persistent_straggler,
     check_clock_sync,
@@ -490,6 +552,7 @@ ALL_RULES = (
     check_restart_churn,
     check_membership_churn,
     check_autotune_search,
+    check_serving_pressure,
 )
 
 # Every rule slug the catalog can emit — the hvd_doctor_findings gauge
@@ -504,6 +567,8 @@ RULE_SLUGS = (
     "membership_churn",
     "autotune_stalled",
     "autotune_wandering",
+    "serving_queue_saturation",
+    "serving_block_exhaustion",
 )
 
 

@@ -81,8 +81,11 @@ class RMSNorm(nn.Module):
 
 
 def rotary_embedding(x, theta: float, positions=None):
-    """Apply RoPE to (B, S, H, D). ``positions`` (shape (S,)) are the
-    GLOBAL token positions of the rows — defaults to 0..S-1, but under
+    """Apply RoPE to (B, S, H, D). ``positions`` are the GLOBAL token
+    positions of the rows — defaults to 0..S-1. Shape (S,) rotates every
+    batch row alike (training, whole-batch decode); shape (B, S) gives
+    each sequence its own positions (the serving tier's continuous
+    batches mix sequences at heterogeneous decode positions). Under
     sequence parallelism each shard must pass its own global offsets
     (e.g. ``axis_index * S_local + arange(S_local)``) or every shard
     would rotate as if it held the sequence start."""
@@ -97,9 +100,13 @@ def rotary_embedding(x, theta: float, positions=None):
     # in [-1, 1] where bf16 is at its densest, and the f32 elementwise
     # over (B, S, H, D) this replaces was ~8% of the Llama-300M step
     # (XProf round 3).
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions.astype(jnp.float32)[..., :, None] * freqs
+    if angles.ndim == 2:                               # (S, half)
+        cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+        sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    else:                                              # (B, S, half)
+        cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+        sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
@@ -204,6 +211,20 @@ def decode_kernel_sharded(mesh, head_axis: str, batch_axis=None):
     return _decode_tp_override((mesh, head_axis, batch_axis))
 
 
+def decode_path_context(path: str, mesh=None, head_axis=None,
+                        batch_axis=None):
+    """THE path -> trace-time-context switch, shared by ``_decode`` and
+    the serving engine's compiled programs — one place decides what each
+    classifier verdict means. ``"kernel"`` explicitly CLEARS any ambient
+    TP context: the traced program must match its jit cache key, not
+    whatever context the caller happens to hold."""
+    if path == "kernel_tp":
+        return decode_kernel_sharded(mesh, head_axis, batch_axis)
+    if path == "kernel":
+        return _decode_tp_override(None)
+    return decode_kernel_disabled()
+
+
 def _cached_attention(q, k, v, cache, cache_index):
     """Decode-mode attention: write the s new K/V rows at ``cache_index``,
     attend every query (global position ``cache_index + i``) over the full
@@ -234,6 +255,48 @@ def _cached_attention(q, k, v, cache, cache_index):
     kc = k.astype(cache["k"].dtype)
     vc = v.astype(cache["v"].dtype)
     scale = 1.0 / np.sqrt(d)
+    if "tables" in cache:
+        # PAGED decode (hvd.serving): the cache entry is the shared
+        # block pool plus this batch's block tables, and ``cache_index``
+        # is the per-sequence position VECTOR (B,) — one batch mixes
+        # sequences at heterogeneous decode positions (continuous
+        # batching). Prefill never lands here: it runs on a contiguous
+        # scratch cache and the engine scatters whole blocks into the
+        # pool (serving.engine._paged_prefill).
+        if s != 1:
+            raise ValueError(
+                f"paged cache is single-token decode only (s={s})")
+        from ..ops.decode_attention import (
+            paged_cache_write,
+            paged_decode_attention,
+            paged_gather_attention,
+            sharded_paged_decode_step,
+        )
+
+        tables = cache["tables"]
+        if _DECODE_KERNEL and _DECODE_TP is not None:
+            mesh, head_axis, batch_axis = _DECODE_TP
+            with jax.named_scope("hvd.decode.paged_tp"):
+                ctx, k_pool, v_pool = sharded_paged_decode_step(
+                    q, kc, vc, cache["k"], cache["v"], tables,
+                    cache_index, hkv, mesh=mesh, head_axis=head_axis,
+                    batch_axis=batch_axis, sm_scale=scale)
+        else:
+            k_pool, v_pool = paged_cache_write(
+                cache["k"], cache["v"], kc, vc, tables, cache_index)
+            if _DECODE_KERNEL:
+                with jax.named_scope("hvd.decode.paged"):
+                    ctx = paged_decode_attention(
+                        q, k_pool, v_pool, tables, cache_index, hkv,
+                        sm_scale=scale)
+            else:
+                # The gather-einsum fallback shares the einsum marker:
+                # it IS the einsum path, reading through the tables.
+                with jax.named_scope("hvd.decode.einsum"):
+                    ctx = paged_gather_attention(
+                        q, k_pool, v_pool, tables, cache_index, hkv,
+                        sm_scale=scale)
+        return ctx, {"k": k_pool, "v": v_pool, "tables": tables}
     if s == 1 and _DECODE_KERNEL and _DECODE_TP is not None:
         # TP-sharded serving: cache-row write AND kernel run per-shard
         # inside shard_map — the outer dynamic_update_slice below never
@@ -349,7 +412,14 @@ class LlamaLM(nn.Module):
         :func:`init_kv_cache` + :func:`generate`."""
         cfg = self.config
         if cache is not None and positions is None:
-            positions = cache_index + jnp.arange(input_ids.shape[1])
+            steps = jnp.arange(input_ids.shape[1])
+            if getattr(cache_index, "ndim", 0):
+                # Per-sequence positions (paged/serving decode): the
+                # index is a (B,) vector, each row rotates at its own
+                # global position.
+                positions = cache_index[:, None] + steps
+            else:
+                positions = cache_index + steps
         x = nn.Embed(cfg.vocab_size, cfg.dim, param_dtype=jnp.float32,
                      name="tok_embeddings")(input_ids).astype(cfg.dtype)
         new_cache = {}
@@ -603,16 +673,7 @@ def _decode(model, variables, prompt_ids, rng, temperature, max_new_tokens,
     ``path`` (+ mesh/axes for the shard_mapped kernel; Mesh hashes by
     devices and axis names) is part of the jit cache key — a bare global
     flag would be ignored on a cache hit."""
-    if path == "kernel_tp":
-        ctx = decode_kernel_sharded(mesh, head_axis, batch_axis)
-    elif path == "kernel":
-        # Clear any AMBIENT decode_kernel_sharded() context: the traced
-        # program must match this cache key (path="kernel", mesh=None),
-        # not whatever context the caller happens to hold.
-        ctx = _decode_tp_override(None)
-    else:
-        ctx = decode_kernel_disabled()
-    with ctx:
+    with decode_path_context(path, mesh, head_axis, batch_axis):
         return _decode_body(model, variables, prompt_ids, rng, temperature,
                             max_new_tokens, max_len, greedy, unroll)
 

@@ -1,0 +1,87 @@
+"""hvd.serving — continuous batching + paged KV cache over the sharded
+decode kernel (docs/serving.md).
+
+Round 6 built the decode data path ("as fast as the hardware allows"):
+a TP-shardable Pallas decode step with in-place cache writes. This
+package is the layer that turns it into a serving product ("heavy
+traffic from millions of users"): requests are admitted against an
+explicit queue bound, join and leave the decode batch **between**
+steps (iteration-level scheduling), and share one paged KV pool so
+heterogeneous sequence lengths never fragment HBM — with preemption-
+by-recompute when the pool runs dry, ``hvd_serving_*`` metrics, trace
+spans, and a cluster-doctor rule watching saturation.
+
+Quick start::
+
+    import horovod_tpu as hvd
+    engine = hvd.serving.serve(model, variables)      # starts the loop
+    handle = engine.submit(prompt_ids, max_new_tokens=128)
+    for token in handle.stream():
+        ...
+    hvd.serving.stats()     # well-formed zeros before the first request
+
+The engine module (jax, flax) loads lazily — importing ``horovod_tpu``
+stays light, and ``stats()`` answers without ever touching jax when no
+engine exists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .kv_blocks import NULL_BLOCK, BlockPool, OutOfBlocks  # noqa: F401
+from .scheduler import (  # noqa: F401
+    CancelledError,
+    RejectedError,
+    Request,
+    Scheduler,
+    ServingConfig,
+    zero_stats,
+)
+
+__all__ = [
+    "BlockPool", "OutOfBlocks", "NULL_BLOCK", "Request", "Scheduler",
+    "ServingConfig", "RejectedError", "CancelledError", "ServingEngine",
+    "RequestHandle", "serve", "default_engine", "stats", "zero_stats",
+]
+
+_default_engine = None
+
+
+def __getattr__(name):
+    # PEP 562 lazy loading: ServingEngine/RequestHandle pull in jax and
+    # the model stack; `import horovod_tpu` must not pay for that.
+    if name in ("ServingEngine", "RequestHandle"):
+        from . import engine as _engine
+
+        return getattr(_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def serve(model, variables, config: Optional[ServingConfig] = None,
+          seed: int = 0, start: bool = True):
+    """Create a :class:`ServingEngine`, register it as the module
+    default (``stats()`` reports it), and start its background loop
+    (pass ``start=False`` to drive it synchronously)."""
+    global _default_engine
+    from .engine import ServingEngine
+
+    engine = ServingEngine(model, variables, config=config, seed=seed)
+    _default_engine = engine
+    if start:
+        engine.start()
+    return engine
+
+
+def default_engine():
+    """The engine ``serve()`` registered, or None."""
+    return _default_engine
+
+
+def stats() -> dict:
+    """The default engine's stats — or, before any engine exists, the
+    same dict with every key present and zero (the
+    ``controller_health()`` zero-state convention, pinned by test)."""
+    if _default_engine is None:
+        return zero_stats()
+    return _default_engine.stats()

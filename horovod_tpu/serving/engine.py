@@ -1,0 +1,767 @@
+"""The serving engine: continuous batching over the paged decode path.
+
+One :class:`ServingEngine` owns (a) the physical paged KV pools (one
+``(num_blocks + 1, block_size, Hkv*head_dim)`` device array pair per
+layer — block 0 is the null block), (b) the
+:class:`~horovod_tpu.serving.scheduler.Scheduler` bookkeeping, and (c)
+two compiled programs that do all device work:
+
+* ``_paged_prefill`` — one request's (re-)prefill: the prompt runs the
+  model's ordinary contiguous prefill (``hvd.decode.prefill`` — the
+  exact computation ``generate()`` performs, so serving prefill is
+  bit-identical to bare decode), the produced KV rows scatter into the
+  request's blocks as whole pages, and the first new token is sampled
+  from the final logits.
+* ``_paged_step`` — ONE decode step for the whole slot batch: every
+  running sequence advances one token through the paged decode kernel
+  (``ops.decode_attention.paged_decode_attention``; per-sequence
+  positions, block-table indirection in the kernel's index_map), riding
+  the same sharding classifier as ``generate()`` — a heads-on-TP mesh
+  keeps the Pallas fast path per shard
+  (``sharded_paged_decode_step``), with in-place per-shard pool writes.
+
+Between the two sits iteration-level scheduling: sequences join and
+leave the decode batch at step boundaries, so a finished short request
+never holds the batch hostage and a newly arrived one starts on the
+next step (the continuous-batching answer to the b8 decode latency
+floor, ``examples/decode_floor_probe.py``).
+
+Both programs compile once per shape class (the step exactly once per
+engine; prefill once per distinct prompt-block count) and both donate
+the pools, so the cache update stays in place step over step.
+
+Threading: the engine is driven either synchronously
+(``run_until_idle()`` — deterministic, what the tests and the parity
+acceptance use) or by its own daemon loop (``start()``; thread named
+``hvd-serving-engine``). All state lives under one lock; device calls
+run outside it so ``submit``/``stream`` never block on a decode step.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.lockorder import make_lock
+from ..common import config as hvd_config
+from ..common import hvd_logging as logging
+from .kv_blocks import BlockPool, padded_table
+from .scheduler import (
+    CANCELLED,
+    FAILED,
+    FINISHED,
+    REJECTED,
+    RUNNING,
+    TERMINAL_STATES,
+    WAITING,
+    CancelledError,
+    RejectedError,
+    Request,
+    Scheduler,
+    ServingConfig,
+    zero_stats,
+)
+
+_m = None
+
+
+def _serving_metrics():
+    """Lazy registration (tests/test_metrics_lint.py: never at import
+    time). One owner per ``hvd_serving_*`` series — docs/metrics.md."""
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        from .. import metrics
+
+        _m = SimpleNamespace(
+            queue_depth=metrics.gauge(
+                "hvd_serving_queue_depth",
+                "Requests waiting for a decode slot."),
+            queue_limit=metrics.gauge(
+                "hvd_serving_queue_limit",
+                "Admission bound on the waiting queue "
+                "(HOROVOD_SERVING_QUEUE_DEPTH)."),
+            active=metrics.gauge(
+                "hvd_serving_active_sequences",
+                "Sequences in the decode batch right now."),
+            blocks_in_use=metrics.gauge(
+                "hvd_serving_blocks_in_use",
+                "Allocated KV-cache blocks."),
+            blocks_total=metrics.gauge(
+                "hvd_serving_blocks_total",
+                "KV-cache pool capacity in blocks (null block excluded)."),
+            block_util=metrics.gauge(
+                "hvd_serving_block_utilization",
+                "blocks_in_use / blocks_total, 0..1."),
+            requests=metrics.counter(
+                "hvd_serving_requests_total",
+                "Serving requests by terminal outcome.", ("outcome",)),
+            preemptions=metrics.counter(
+                "hvd_serving_preemptions_total",
+                "Sequences preempted (blocks dropped, recompute queued) "
+                "because the block pool ran dry."),
+            tokens=metrics.counter(
+                "hvd_serving_tokens_generated_total",
+                "Tokens produced across all requests."),
+            steps=metrics.counter(
+                "hvd_serving_steps_total",
+                "Continuous-batching decode steps executed."),
+            ttft=metrics.histogram(
+                "hvd_serving_ttft_seconds",
+                "Submit-to-first-token latency per request."),
+            tpot=metrics.histogram(
+                "hvd_serving_tpot_seconds",
+                "Inter-token latency per generated token (decode steps "
+                "plus any scheduling/preemption stall between them)."),
+        )
+    return _m
+
+
+# ---------------------------------------------------------------------------
+# Compiled programs. Module-level with the model STATIC (flax modules hash
+# by structure) so repeated engine steps hit the jit cache — the _decode
+# convention. ``path`` (+ mesh/axes) is part of the cache key for the same
+# reason it is in generate(): a bare global flag would be ignored on a
+# cache hit. Both donate the pools: the KV update must stay in place.
+
+
+def _decode_path_ctx(path, mesh, head_axis, batch_axis):
+    from ..models.llama import decode_path_context
+
+    return decode_path_context(path, mesh, head_axis, batch_axis)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "greedy", "path", "mesh",
+                     "head_axis", "batch_axis"),
+    donate_argnums=(1,))
+def _paged_prefill(model, pools, variables, prompt, plen, table_row, rng,
+                   temperature, greedy=True, path="kernel",
+                   mesh=None, head_axis=None, batch_axis=None):
+    """(Re-)prefill ONE request into its blocks; returns
+    ``(first_token, new_pools)``. ``prompt`` arrives PADDED to the
+    page-aligned window (``plen`` real tokens rounded up to the block
+    size), so the jit cache is keyed per block COUNT, not per prompt
+    length — a production length mix compiles ~window/block_size
+    programs, not one per length. The pad rows are causally inert: the
+    picked logit (position ``plen - 1``) attends only positions below
+    it, and the garbage KV rows they scatter into the last page sit
+    above every later causal bound until the decode loop overwrites
+    them position by position.
+
+    The prompt runs the model's contiguous prefill on a scratch cache
+    (the einsum-over-fresh-rows path — no matmul consumes the scratch
+    buffers), then each layer's KV rows scatter into the pool as whole
+    pages."""
+    cfg = model.config
+    head_dim = cfg.dim // cfg.num_heads
+    f = cfg.num_kv_heads * head_dim
+    layers = sorted(pools)
+    dtype = pools[layers[0]]["k"].dtype
+    block_size = pools[layers[0]]["k"].shape[1]
+    window = prompt.shape[1]
+    scratch = {
+        layer: {"k": jnp.zeros((1, window, f), dtype),
+                "v": jnp.zeros((1, window, f), dtype)}
+        for layer in layers
+    }
+    with _decode_path_ctx(path, mesh, head_axis, batch_axis):
+        logits, scratch = model.apply(variables, prompt, cache=scratch,
+                                      cache_index=0)
+    nb = window // block_size
+    new_pools = {}
+    for layer in layers:
+        pages_k = scratch[layer]["k"][0].reshape(nb, block_size, f)
+        pages_v = scratch[layer]["v"][0].reshape(nb, block_size, f)
+        new_pools[layer] = {
+            "k": pools[layer]["k"].at[table_row].set(pages_k),
+            "v": pools[layer]["v"].at[table_row].set(pages_v),
+        }
+    last = logits[0, plen - 1].astype(jnp.float32)
+    if greedy:
+        token = jnp.argmax(last, axis=-1)
+    else:
+        token = jax.random.categorical(rng, last / temperature)
+    return token, new_pools
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "all_greedy", "path", "mesh", "head_axis",
+                     "batch_axis"),
+    donate_argnums=(1,))
+def _paged_step(model, pools, variables, tokens, lens, tables, temps, rng,
+                all_greedy=True, path="kernel", mesh=None, head_axis=None,
+                batch_axis=None):
+    """One continuous-batching decode step over the whole slot batch:
+    every slot's incoming token (position ``lens[i]``) writes its KV row
+    into its block and attends its own window. Inactive slots point at
+    the null block with lens 0 — their lane computes garbage that the
+    host discards. ``all_greedy`` is static (known when the host builds
+    the batch): the default temperature-0 workload then never traces the
+    discarded gumbel sampling over (max_batch, vocab). Returns
+    ``(next_tokens, new_pools)``."""
+    cache = {
+        layer: {"k": pools[layer]["k"], "v": pools[layer]["v"],
+                "tables": tables}
+        for layer in pools
+    }
+    with _decode_path_ctx(path, mesh, head_axis, batch_axis):
+        logits, cache = model.apply(variables, tokens[:, None],
+                                    cache=cache, cache_index=lens)
+    last = logits[:, -1].astype(jnp.float32)
+    next_tokens = jnp.argmax(last, axis=-1)
+    if not all_greedy:
+        sampled = jax.random.categorical(
+            rng, last / jnp.maximum(temps, 1e-6)[:, None])
+        next_tokens = jnp.where(temps > 0.0, sampled, next_tokens)
+    new_pools = {layer: {"k": cache[layer]["k"], "v": cache[layer]["v"]}
+                 for layer in cache}
+    return next_tokens, new_pools
+
+
+class RequestHandle:
+    """Caller's view of one submitted request: block on the result,
+    stream tokens as they are produced, or cancel."""
+
+    def __init__(self, engine: "ServingEngine", req: Request):
+        self._engine = engine
+        self._req = req
+
+    @property
+    def rid(self) -> int:
+        return self._req.rid
+
+    @property
+    def state(self) -> str:
+        with self._engine._cond:
+            return self._req.state
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated token ids (prompt excluded). Raises
+        :class:`CancelledError` on cancellation, ``RuntimeError`` on
+        engine failure, ``TimeoutError`` on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._engine._cond:
+            while self._req.state not in TERMINAL_STATES:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"request {self._req.rid} still "
+                            f"{self._req.state} after {timeout}s")
+                self._engine._cond.wait(remaining)
+            return self._finish_locked()
+
+    def _finish_locked(self) -> List[int]:
+        if self._req.state == FINISHED:
+            return list(self._req.tokens)
+        if self._req.state == CANCELLED:
+            raise CancelledError(f"request {self._req.rid} was cancelled")
+        raise RuntimeError(
+            f"request {self._req.rid} {self._req.state}: "
+            f"{self._req.error}")
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield generated token ids as they are produced. The lock is
+        dropped while the consumer runs, so slow consumers never stall
+        the engine loop."""
+        sent = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._engine._cond:
+                while (len(self._req.tokens) <= sent
+                       and self._req.state not in TERMINAL_STATES):
+                    remaining = None
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                f"request {self._req.rid} produced no "
+                                f"token within {timeout}s")
+                    self._engine._cond.wait(remaining)
+                chunk = self._req.tokens[sent:]
+                state = self._req.state
+            for token in chunk:
+                yield token
+            sent += len(chunk)
+            if state in TERMINAL_STATES and not chunk:
+                if state != FINISHED:
+                    with self._engine._cond:
+                        self._finish_locked()
+                return
+
+    def cancel(self) -> None:
+        """Cancel: a waiting request leaves the queue immediately, a
+        running one is evicted (blocks freed) at the next step
+        boundary."""
+        self._engine._cancel(self._req)
+
+
+class ServingEngine:
+    """See module docstring. ``model`` is any causal LM with the cache
+    call contract (``LlamaLM``, ``MoeLM``); ``variables`` may be
+    TP-sharded with the Megatron specs — the engine classifies the
+    sharding exactly like ``generate()`` and keeps the Pallas kernel
+    through ``shard_map`` on heads-on-TP meshes."""
+
+    def __init__(self, model, variables, config: Optional[ServingConfig]
+                 = None, seed: int = 0):
+        from ..models.llama import classify_decode_sharding
+
+        self._model = model
+        self._variables = variables
+        cfg = config if config is not None else ServingConfig.from_env()
+        mcfg = model.config
+        model_max = int(getattr(mcfg, "max_seq_len", 0) or 0)
+        max_seq = cfg.max_seq_len or model_max
+        if not max_seq:
+            raise ValueError(
+                "the model declares no max_seq_len; set "
+                "ServingConfig.max_seq_len (HOROVOD_SERVING_MAX_SEQ_LEN)")
+        if model_max:
+            max_seq = min(max_seq, model_max)
+        self._config = cfg = ServingConfig(
+            max_batch=cfg.max_batch, block_size=cfg.block_size,
+            num_blocks=cfg.num_blocks, queue_depth=cfg.queue_depth,
+            max_seq_len=max_seq)
+        self._table_slots = (max_seq + cfg.block_size - 1) // cfg.block_size
+        num_blocks = cfg.num_blocks or cfg.max_batch * self._table_slots
+        pool = BlockPool(num_blocks, cfg.block_size)
+        self._sched = Scheduler(pool, cfg.max_batch, cfg.queue_depth,
+                                max_seq)
+
+        # Decode-path classification, exactly generate()'s: the dummy
+        # prompt is host-resident (replicated), so the verdict follows
+        # the VARIABLES' sharding.
+        dummy = jnp.zeros((cfg.max_batch, 1), jnp.int32)
+        self._path = classify_decode_sharding(variables, dummy,
+                                              mcfg.num_kv_heads)
+        if self._path.batch_axis is not None:
+            # Serving batches are host-built and replicated, and the
+            # shared block pool has no batch dim to shard over dp (see
+            # sharded_paged_decode_step) — dp x tp means one engine per
+            # dp replica. The replicated dummy above already yields
+            # None; this is belt and braces against future classifier
+            # inputs.
+            import dataclasses
+
+            self._path = dataclasses.replace(self._path, batch_axis=None)
+
+        head_dim = mcfg.dim // mcfg.num_heads
+        f = mcfg.num_kv_heads * head_dim
+        shape = (num_blocks + 1, cfg.block_size, f)
+        sharding = None
+        if self._path.path == "kernel_tp":
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            sharding = NamedSharding(self._path.mesh,
+                                     P(None, None, self._path.head_axis))
+
+        def _pool_array():
+            arr = jnp.zeros(shape, mcfg.dtype)
+            return jax.device_put(arr, sharding) if sharding else arr
+
+        self._pools = {
+            f"layer_{i}": {"k": _pool_array(), "v": _pool_array()}
+            for i in range(mcfg.num_layers)
+        }
+
+        self._lock = make_lock("serving.engine")
+        self._cond = threading.Condition(self._lock)
+        self._rng = jax.random.PRNGKey(seed)
+        self._rid = itertools.count()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._closed = False
+        self._submitted = 0
+        self._finished = 0
+        self._cancelled = 0
+        self._tokens_generated = 0
+        self._steps = 0
+        # Sliding latency windows: one float per token would grow RSS
+        # without bound on a long-lived engine, and stats() sorts these
+        # under the lock — bound both costs. The metrics histograms keep
+        # the full-lifetime distribution.
+        self._ttfts: deque = deque(maxlen=4096)
+        self._tpots: deque = deque(maxlen=4096)
+        self._tracer = None
+        self._trace_checked = False
+
+    # -- public API ---------------------------------------------------------
+
+    @property
+    def config(self) -> ServingConfig:
+        return self._config
+
+    @property
+    def decode_path(self):
+        """The :class:`~horovod_tpu.models.llama.DecodePath` verdict the
+        engine's compiled programs ride (proof-of-path for harnesses)."""
+        return self._path
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> RequestHandle:
+        """Admit one generation request. Raises
+        :class:`~horovod_tpu.serving.RejectedError` when admission
+        control refuses (queue at bound / request can never fit), and
+        ``ValueError`` on malformed arguments."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            try:
+                self._sched.check_admissible(prompt.shape[0],
+                                             int(max_new_tokens))
+            except RejectedError:
+                if _metrics_on():
+                    m = _serving_metrics()
+                    m.requests.labels(REJECTED).inc()
+                    # Publish the queue gauges here too: an engine whose
+                    # every submission is rejected would otherwise never
+                    # set them, and the doctor's saturation rule gates
+                    # on the limit gauge being present. (Metric locks
+                    # only — _update_gauges would re-take the engine
+                    # lock we hold.)
+                    m.queue_depth.set(self._sched.queue_depth_now())
+                    m.queue_limit.set(self._sched.queue_depth)
+                raise
+            req = Request(rid=next(self._rid), prompt=prompt,
+                          max_new_tokens=int(max_new_tokens),
+                          temperature=float(temperature),
+                          submit_t=time.monotonic())
+            self._sched.enqueue(req)
+            self._submitted += 1
+            self._cond.notify_all()
+        self._update_gauges()
+        return RequestHandle(self, req)
+
+    def step(self) -> bool:
+        """One engine iteration: retire cancellations, admit + prefill
+        joiners, top up block tables (preempting on exhaustion), run one
+        batched decode step. Returns whether work remains. Thread-safe
+        against submit/stream, but only ONE driver may call it (the
+        loop thread, or the caller in synchronous mode)."""
+        t_sched = time.monotonic()
+        with self._cond:
+            for req in list(self._sched.running.values()):
+                if req.cancel_requested:
+                    self._sched.retire(req, CANCELLED)
+                    self._cancelled += 1
+                    if _metrics_on():
+                        _serving_metrics().requests.labels(CANCELLED).inc()
+                    self._cond.notify_all()
+            # A cancel that landed while the request sat RUNNING may have
+            # been overtaken by a preemption (RUNNING -> WAITING with the
+            # flag still set); purge those here or admit() would pay a
+            # full recompute prefill for a request the very next scan
+            # retires.
+            for req in [r for r in self._sched.waiting
+                        if r.cancel_requested]:
+                self._sched.cancel_waiting(req)
+                self._cancelled += 1
+                if _metrics_on():
+                    _serving_metrics().requests.labels(CANCELLED).inc()
+                self._cond.notify_all()
+            admitted = self._sched.admit()
+        tracer = self._maybe_tracer()
+        if tracer is not None:
+            tracer.span("schedule", t_sched, time.monotonic(),
+                        admitted=len(admitted),
+                        running=len(self._sched.running))
+
+        for req in admitted:
+            self._prefill(req)
+
+        with self._cond:
+            preempted = self._sched.ensure_decode_capacity()
+            if preempted and _metrics_on():
+                _serving_metrics().preemptions.inc(len(preempted))
+            batch = self._sched.active()
+            arrays = self._build_batch(batch) if batch else None
+        if preempted:
+            logging.warning(
+                "serving: block pool exhausted — preempted %d sequence(s) "
+                "for recompute (%s)", len(preempted),
+                ", ".join(f"rid {r.rid}" for r in preempted))
+
+        if arrays is not None:
+            t_dec = time.monotonic()
+            tokens, lens, tables, temps = arrays
+            rng = self._next_rng()
+            out_tokens, self._pools = _paged_step(
+                self._model, self._pools, self._variables, tokens, lens,
+                tables, temps, rng,
+                all_greedy=bool((temps <= 0.0).all()),
+                path=self._path.path,
+                mesh=self._path.mesh, head_axis=self._path.head_axis,
+                batch_axis=self._path.batch_axis)
+            out_host = np.asarray(out_tokens)
+            with self._cond:
+                for req in batch:
+                    if req.state == RUNNING and req.slot is not None:
+                        self._append_token(req, int(out_host[req.slot]))
+                self._steps += 1
+            if _metrics_on():
+                _serving_metrics().steps.inc()
+            if tracer is not None:
+                tracer.span("decode", t_dec, time.monotonic(),
+                            batch=len(batch))
+        self._update_gauges()
+        with self._cond:
+            return self._sched.has_work()
+
+    def run_until_idle(self, max_steps: int = 100000) -> None:
+        """Drive the engine synchronously until no request is waiting or
+        running (tests, benches: fully deterministic scheduling)."""
+        for _ in range(max_steps):
+            if not self.step():
+                return
+        raise RuntimeError(f"engine still busy after {max_steps} steps")
+
+    def start(self) -> "ServingEngine":
+        """Spawn the background loop (daemon thread, named per the
+        threading discipline). Idempotent."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("engine is shut down")
+            if self._thread is not None:
+                return self
+            self._stop = False
+            self._thread = threading.Thread(
+                target=self._run_loop, name="hvd-serving-engine",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Stop the loop, fail whatever is still queued or running, and
+        close the trace file. Idempotent."""
+        with self._cond:
+            self._stop = True
+            self._closed = True
+            thread = self._thread
+            self._thread = None
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout)
+        with self._cond:
+            for req in list(self._sched.waiting) + list(
+                    self._sched.running.values()):
+                if req.state not in TERMINAL_STATES:
+                    self._sched.cancel_waiting(req)
+                    self._sched.retire(req, FAILED, "engine shut down")
+                    if _metrics_on():
+                        _serving_metrics().requests.labels(FAILED).inc()
+            self._sched.waiting.clear()
+            self._cond.notify_all()
+        if self._tracer is not None:
+            self._tracer.close()
+
+    def stats(self) -> Dict[str, float]:
+        """Serving stats snapshot — ``zero_stats()`` shape, every key
+        always present (docs/serving.md has the catalog)."""
+        with self._cond:
+            s = zero_stats()
+            pool = self._sched.pool
+            s.update({
+                "queue_depth": self._sched.queue_depth_now(),
+                "queue_limit": self._sched.queue_depth,
+                "active_sequences": len(self._sched.running),
+                "blocks_total": pool.num_blocks,
+                "blocks_in_use": pool.blocks_in_use,
+                "blocks_peak": pool.peak_in_use,
+                "block_utilization": round(pool.utilization(), 4),
+                "requests_submitted": self._submitted,
+                "requests_finished": self._finished,
+                "requests_rejected": self._sched.rejected,
+                "requests_cancelled": self._cancelled,
+                "preemptions": self._sched.preempted,
+                "tokens_generated": self._tokens_generated,
+                "steps": self._steps,
+                "ttft_p50_seconds": _quantile(self._ttfts, 0.5),
+                "ttft_p99_seconds": _quantile(self._ttfts, 0.99),
+                "tpot_p50_seconds": _quantile(self._tpots, 0.5),
+                "tpot_p99_seconds": _quantile(self._tpots, 0.99),
+            })
+            return s
+
+    # -- internals ----------------------------------------------------------
+
+    def _run_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and not self._sched.has_work():
+                    self._cond.wait(0.05)
+                if self._stop:
+                    return
+            try:
+                self.step()
+            except Exception as exc:  # the loop must fail LOUDLY
+                logging.error("serving engine loop died: %s", exc)
+                with self._cond:
+                    # The engine is dead, not idle: close it so later
+                    # submit() raises instead of queueing requests no
+                    # loop will ever process (and start() can't silently
+                    # no-op on the stale thread handle).
+                    self._closed = True
+                    self._stop = True
+                    self._thread = None
+                    for req in list(self._sched.waiting) + list(
+                            self._sched.running.values()):
+                        if req.state not in TERMINAL_STATES:
+                            self._sched.retire(req, FAILED, str(exc))
+                            if _metrics_on():
+                                _serving_metrics().requests.labels(
+                                    FAILED).inc()
+                    self._sched.waiting.clear()
+                    self._cond.notify_all()
+                return
+
+    def _cancel(self, req: Request) -> None:
+        with self._cond:
+            if req.state in TERMINAL_STATES:
+                return
+            if req.state == WAITING:
+                self._sched.cancel_waiting(req)
+                self._cancelled += 1
+                if _metrics_on():
+                    _serving_metrics().requests.labels(CANCELLED).inc()
+                self._cond.notify_all()
+            else:
+                req.cancel_requested = True
+                self._cond.notify_all()
+        self._update_gauges()
+
+    def _prefill(self, req: Request) -> None:
+        t0 = time.monotonic()
+        prompt = req.current_prompt()
+        plen = int(prompt.shape[0])
+        nb = self._sched.pool.blocks_for(plen)
+        window = nb * self._config.block_size
+        # Pad to the page boundary so prefill compiles per block count,
+        # not per length (see _paged_prefill).
+        padded = np.zeros((1, window), np.int32)
+        padded[0, :plen] = prompt
+        table_row = jnp.asarray(req.blocks[:nb], jnp.int32)
+        rng = self._next_rng()
+        greedy = req.temperature <= 0.0
+        token, self._pools = _paged_prefill(
+            self._model, self._pools, self._variables,
+            jnp.asarray(padded), jnp.int32(plen), table_row, rng,
+            jnp.float32(max(req.temperature, 1e-6)),
+            greedy=greedy, path=self._path.path, mesh=self._path.mesh,
+            head_axis=self._path.head_axis,
+            batch_axis=self._path.batch_axis)
+        token = int(np.asarray(token))
+        with self._cond:
+            if req.state == RUNNING:       # not cancelled mid-prefill
+                self._append_token(req, token)
+        tracer = self._maybe_tracer()
+        if tracer is not None:
+            tracer.span("prefill", t0, time.monotonic(), rid=req.rid,
+                        len=int(prompt.shape[0]),
+                        recompute=req.preemptions)
+
+    def _append_token(self, req: Request, token: int) -> None:
+        """Caller holds the lock."""
+        now = time.monotonic()
+        req.tokens.append(token)
+        self._tokens_generated += 1
+        if _metrics_on():
+            _serving_metrics().tokens.inc()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            ttft = now - req.submit_t
+            self._ttfts.append(ttft)
+            if _metrics_on():
+                _serving_metrics().ttft.observe(ttft)
+        elif req.last_token_t is not None:
+            tpot = now - req.last_token_t
+            self._tpots.append(tpot)
+            if _metrics_on():
+                _serving_metrics().tpot.observe(tpot)
+        req.last_token_t = now
+        if req.is_done():
+            self._sched.retire(req, FINISHED)
+            self._finished += 1
+            if _metrics_on():
+                _serving_metrics().requests.labels(FINISHED).inc()
+        self._cond.notify_all()
+
+    def _build_batch(self, batch: List[Request]):
+        """Caller holds the lock. Slot arrays for one decode step."""
+        size = self._config.max_batch
+        tokens = np.zeros((size,), np.int32)
+        lens = np.zeros((size,), np.int32)
+        tables = np.zeros((size, self._table_slots), np.int32)
+        temps = np.zeros((size,), np.float32)
+        for req in batch:
+            slot = req.slot
+            tokens[slot] = req.tokens[-1]
+            lens[slot] = req.position_of_last_token()
+            tables[slot] = padded_table(req.blocks, self._table_slots)
+            temps[slot] = req.temperature
+        return tokens, lens, tables, temps
+
+    def _next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _update_gauges(self) -> None:
+        if not _metrics_on():
+            return
+        m = _serving_metrics()
+        with self._cond:
+            pool = self._sched.pool
+            m.queue_depth.set(self._sched.queue_depth_now())
+            m.queue_limit.set(self._sched.queue_depth)
+            m.active.set(len(self._sched.running))
+            m.blocks_in_use.set(pool.blocks_in_use)
+            m.blocks_total.set(pool.num_blocks)
+            m.block_util.set(pool.utilization())
+
+    # -- tracing ------------------------------------------------------------
+
+    def _maybe_tracer(self):
+        if not self._trace_checked:
+            self._trace_checked = True
+            tdir = (hvd_config.env_str("HOROVOD_TRACE_DIR") or "").strip()
+            if tdir:
+                from ..common.config import env_rank
+                from ..trace import TraceWriter
+
+                os.makedirs(tdir, exist_ok=True)
+                rank = env_rank() or 0
+                self._tracer = TraceWriter(
+                    os.path.join(tdir, f"trace.serving.rank{rank}.json"),
+                    rank)
+        return self._tracer
+
+
+def _metrics_on() -> bool:
+    from .. import metrics
+
+    return metrics.on()
+
+
+def _quantile(values, q: float) -> float:
+    """Exact-list percentile, same convention as the straggler report's
+    (one definition of 'p99' across the repo)."""
+    from ..trace.straggler import _pctl
+
+    est = _pctl(sorted(values), q)
+    return round(est, 6) if est is not None else 0.0

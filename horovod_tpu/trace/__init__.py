@@ -37,16 +37,18 @@ from .merge import (  # noqa: F401
 )
 from .straggler import attribute, summary, write_report  # noqa: F401
 from .tracer import (  # noqa: F401
+    ALL_PHASES,
     MERGED_TRACE_FILE,
     OFFSETS_FILE,
     PHASES,
     REPORT_FILE,
+    SERVING_PHASES,
     TraceWriter,
     rank_trace_path,
 )
 
 __all__ = [
-    "ClockSync", "TraceWriter", "PHASES",
+    "ClockSync", "TraceWriter", "PHASES", "SERVING_PHASES", "ALL_PHASES",
     "rank_trace_path", "rank_trace_files", "merge_trace_dir",
     "merge_events", "write_trace", "attribute", "write_report", "summary",
     "load_offsets", "MERGED_TRACE_FILE", "OFFSETS_FILE", "REPORT_FILE",

@@ -35,7 +35,18 @@ from ..analysis.lockorder import make_lock
 from ..common.config import _env_int
 
 # The fixed phase vocabulary: one chrome "thread" per phase per rank.
+# PHASES is the collective pipeline (what the controller emits, what the
+# merge's straggler attribution consumes); SERVING_PHASES is the serving
+# engine's iteration loop (schedule / prefill / decode, written to its
+# own ``trace.serving.rank<N>.json`` — deliberately NOT matched by the
+# merge's rank-file pattern, so serving spans never pollute collective
+# straggler attribution). ALL_PHASES is the writer's legal set; both
+# sub-vocabularies stay fixed and lint-enforced
+# (tests/test_metrics_lint.py). New entries append — tids are
+# positional and pinned by the merge golden file.
 PHASES = ("enqueue", "negotiate", "fuse", "execute", "done")
+SERVING_PHASES = ("schedule", "prefill", "decode")
+ALL_PHASES = PHASES + SERVING_PHASES
 
 DEFAULT_MAX_EVENTS = 1 << 20
 
@@ -75,10 +86,10 @@ class TraceWriter:
         stamps from this process; they are stored relative to the file's
         monotonic origin, which the ``clock_sync`` anchor ties to wall
         time."""
-        if phase not in PHASES:
+        if phase not in ALL_PHASES:
             raise ValueError(
                 f"unknown trace phase {phase!r}; the vocabulary is fixed: "
-                f"{PHASES}")
+                f"{ALL_PHASES}")
         a = dict(args)
         if seq is not None:
             a["seq"] = int(seq)
@@ -91,7 +102,7 @@ class TraceWriter:
             # One chrome thread per phase: overlapping spans of DIFFERENT
             # phases (enqueue of op B during execute of op A) land on
             # separate tracks instead of mis-nesting.
-            "tid": PHASES.index(phase) + 1,
+            "tid": ALL_PHASES.index(phase) + 1,
             "ts": int(round((t0 - self._mono0) * 1e6)),
             "dur": max(0, int(round((t1 - t0) * 1e6))),
             "args": a,
@@ -121,7 +132,7 @@ class TraceWriter:
             "name": "process_sort_index", "ph": "M", "pid": self.rank,
             "args": {"sort_index": self.rank},
         }]
-        for i, phase in enumerate(PHASES):
+        for i, phase in enumerate(ALL_PHASES):
             meta.append({"name": "thread_name", "ph": "M", "pid": self.rank,
                          "tid": i + 1, "args": {"name": phase}})
         return meta

@@ -169,7 +169,8 @@ def payload_by_op(colls: List[Collective]) -> Dict[str, int]:
 #: twin). The same labels show up as ``tf_op_name`` prefixes in profiler
 #: traces, so phase tables attribute attention time per path too.
 DECODE_PATH_MARKERS = ("hvd.decode.kernel_tp", "hvd.decode.kernel",
-                       "hvd.decode.einsum", "hvd.decode.prefill")
+                       "hvd.decode.einsum", "hvd.decode.prefill",
+                       "hvd.decode.paged_tp", "hvd.decode.paged")
 
 
 def decode_path_markers(compiled_or_text) -> Dict[str, int]:

@@ -100,6 +100,18 @@ def test_known_series_present():
         "hvd_autotune_best_cycle_time_ms",
         "hvd_autotune_objective",
         "hvd_autotune_best_objective",
+        "hvd_serving_queue_depth",
+        "hvd_serving_queue_limit",
+        "hvd_serving_active_sequences",
+        "hvd_serving_blocks_in_use",
+        "hvd_serving_blocks_total",
+        "hvd_serving_block_utilization",
+        "hvd_serving_requests_total",
+        "hvd_serving_preemptions_total",
+        "hvd_serving_tokens_generated_total",
+        "hvd_serving_steps_total",
+        "hvd_serving_ttft_seconds",
+        "hvd_serving_tpot_seconds",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
 
@@ -116,10 +128,11 @@ def test_no_import_time_registration_static():
 def test_trace_phase_names_fixed_vocabulary():
     """Same discipline for trace spans as for metric names: phase strings
     at every ``.span(...)`` emission site must come from the fixed
-    enqueue/negotiate/fuse/execute/done vocabulary (ad-hoc strings would
-    silently fall out of the merge's straggler attribution), and every
-    phase must actually be emitted somewhere."""
-    from horovod_tpu.trace import PHASES
+    vocabulary — the collective pipeline (enqueue/negotiate/fuse/
+    execute/done) plus the serving loop (schedule/prefill/decode); ad-hoc
+    strings would silently fall out of the merge's straggler attribution
+    — and every phase must actually be emitted somewhere."""
+    from horovod_tpu.trace import ALL_PHASES
 
     span_call = re.compile(r"\.span\(\s*\n?\s*[\"']([a-z_]+)[\"']")
     found = []
@@ -129,13 +142,13 @@ def test_trace_phase_names_fixed_vocabulary():
         for name in span_call.findall(src):
             found.append((name, os.path.relpath(path, REPO)))
     assert found, "no trace span emission sites found — did the regex rot?"
-    bad = [(n, p) for n, p in found if n not in PHASES]
+    bad = [(n, p) for n, p in found if n not in ALL_PHASES]
     assert not bad, (
-        f"ad-hoc trace phase names (the vocabulary is fixed: {PHASES}): "
-        f"{bad}")
-    assert {n for n, _ in found} == set(PHASES), (
+        f"ad-hoc trace phase names (the vocabulary is fixed: "
+        f"{ALL_PHASES}): {bad}")
+    assert {n for n, _ in found} == set(ALL_PHASES), (
         "a phase in the fixed vocabulary is never emitted: "
-        f"{set(PHASES) - {n for n, _ in found}}")
+        f"{set(ALL_PHASES) - {n for n, _ in found}}")
 
 
 def test_no_import_time_registration():

@@ -1,0 +1,632 @@
+"""Serving tier: paged KV blocks, continuous-batching scheduler, engine
+parity vs bare ``generate()``, admission control, preemption-by-
+recompute, serving metrics, and the doctor's saturation rules
+(docs/serving.md).
+
+The parity contract under test is the acceptance bar: a mixed-length
+workload through the continuous batcher produces, per request, EXACTLY
+the tokens that request gets from ``generate()`` alone — in f32, where
+greedy argmax is reproducible across decode paths (the
+``tp_decode_profile`` convention). The heavy 32-request TP acceptance
+run is @slow; a light sibling covers both paths in tier-1.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import metrics
+from horovod_tpu.models.llama import (
+    LLAMA_TINY,
+    LlamaLM,
+    generate,
+    llama_tp_param_specs,
+)
+from horovod_tpu.ops.decode_attention import (
+    decode_attention,
+    paged_cache_write,
+    paged_decode_attention,
+    paged_gather_attention,
+)
+from horovod_tpu.serving import (
+    NULL_BLOCK,
+    BlockPool,
+    CancelledError,
+    OutOfBlocks,
+    RejectedError,
+    Request,
+    Scheduler,
+    ServingConfig,
+    zero_stats,
+)
+from horovod_tpu.serving.engine import ServingEngine
+from horovod_tpu.serving.kv_blocks import padded_table
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# f32 end to end: greedy argmax is then exactly reproducible across the
+# contiguous, paged, and TP decode paths (bf16 reduction order flips
+# argmax ties — examples/tp_decode_profile.py documents the same).
+CFG = dataclasses.replace(LLAMA_TINY, dtype=jnp.float32, max_seq_len=64)
+MODEL = LlamaLM(CFG)
+# One config shared by the parity tests so the decode step compiles once
+# for the whole file.
+SCFG = ServingConfig(max_batch=4, block_size=8, num_blocks=0,
+                     queue_depth=64, max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def tiny_variables():
+    return MODEL.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def tp_setup(tiny_variables):
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 2),
+                ("data", "model"))
+    specs = llama_tp_param_specs(tiny_variables["params"], axis="model")
+    sharded = {"params": jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tiny_variables["params"], specs)}
+    return mesh, sharded
+
+
+def _mixed_workload(rng, n, prompt_lens, new_tokens):
+    prompts = [rng.randint(0, CFG.vocab_size,
+                           (prompt_lens[i % len(prompt_lens)],)
+                           ).astype(np.int32) for i in range(n)]
+    news = [new_tokens[i % len(new_tokens)] for i in range(n)]
+    return prompts, news
+
+
+def _assert_parity(engine, variables, prompts, news, handles, mesh=None):
+    for i, (prompt, n, handle) in enumerate(zip(prompts, news, handles)):
+        got = handle.result(timeout=0)
+        if mesh is not None:
+            with mesh:
+                ref = generate(MODEL, variables, jnp.asarray(prompt[None]),
+                               max_new_tokens=n)
+        else:
+            ref = generate(MODEL, variables, jnp.asarray(prompt[None]),
+                           max_new_tokens=n)
+        want = list(np.asarray(ref)[0, len(prompt):])
+        assert got == want, (
+            f"request {i} (prompt {len(prompt)}, {n} new) diverged from "
+            f"bare generate():\n got={got}\nwant={want}")
+
+
+# ---------------------------------------------------------------------------
+# Block pool
+
+
+def test_block_pool_alloc_free_reuse():
+    pool = BlockPool(4, block_size=8)
+    assert pool.blocks_for(0) == 0
+    assert pool.blocks_for(1) == 1
+    assert pool.blocks_for(8) == 1
+    assert pool.blocks_for(9) == 2
+    a, b = pool.alloc(), pool.alloc()
+    assert {a, b} == {1, 2} and NULL_BLOCK not in (a, b)
+    assert pool.blocks_in_use == 2 and pool.free_blocks == 2
+    pool.free([a])
+    # The freed block is reusable immediately; accounting stays exact.
+    c = pool.alloc()
+    assert c == a
+    assert pool.peak_in_use == 2
+    assert pool.stats()["block_allocs"] == 3
+    assert pool.stats()["block_frees"] == 1
+    assert pool.utilization() == 0.5
+    pool.free([b, c])
+    assert pool.blocks_in_use == 0 and pool.free_blocks == 4
+
+
+def test_block_pool_exhaustion_and_all_or_nothing():
+    pool = BlockPool(3, block_size=4)
+    held = pool.alloc_many(2)
+    with pytest.raises(OutOfBlocks):
+        pool.alloc_many(2)           # only 1 free: must not half-allocate
+    assert pool.blocks_in_use == 2   # the failed alloc_many took nothing
+    pool.alloc()
+    with pytest.raises(OutOfBlocks):
+        pool.alloc()
+    pool.free(held)
+    assert pool.can_fit(2)
+
+
+def test_block_pool_free_validation():
+    pool = BlockPool(2, block_size=4)
+    a = pool.alloc()
+    pool.free([a])
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a])
+    with pytest.raises(ValueError, match="null block"):
+        pool.free([NULL_BLOCK])
+    with pytest.raises(ValueError, match="not allocated"):
+        pool.free([2])
+
+
+def test_padded_table():
+    assert padded_table([3, 1], 4) == [3, 1, NULL_BLOCK, NULL_BLOCK]
+    with pytest.raises(ValueError):
+        padded_table([1, 2, 3], 2)
+
+
+# ---------------------------------------------------------------------------
+# Scheduler (pure bookkeeping)
+
+
+def _req(rid, prompt_len, max_new):
+    return Request(rid=rid, prompt=np.zeros((prompt_len,), np.int32),
+                   max_new_tokens=max_new)
+
+
+def test_scheduler_admission_and_rejects():
+    sched = Scheduler(BlockPool(8, 4), max_batch=2, queue_depth=2,
+                      max_seq_len=16)
+    with pytest.raises(RejectedError, match="max_seq_len"):
+        sched.check_admissible(10, 10)           # window overflow
+    with pytest.raises(ValueError):
+        sched.check_admissible(0, 4)             # malformed
+    big = Scheduler(BlockPool(2, 4), max_batch=2, queue_depth=2,
+                    max_seq_len=64)
+    with pytest.raises(RejectedError, match="KV blocks"):
+        big.check_admissible(8, 16)              # can never fit the pool
+    sched.enqueue(_req(0, 4, 4))
+    sched.enqueue(_req(1, 4, 4))
+    with pytest.raises(RejectedError, match="queue is full"):
+        sched.check_admissible(4, 4)
+    assert sched.rejected == 2                   # never-fit + queue-full
+    admitted = sched.admit()
+    assert [r.rid for r in admitted] == [0, 1]   # FIFO
+    assert sorted(r.slot for r in admitted) == [0, 1]
+    assert all(len(r.blocks) == 1 for r in admitted)
+
+
+def test_scheduler_preempts_youngest_and_requeues_front():
+    pool = BlockPool(4, 4)
+    sched = Scheduler(pool, max_batch=2, queue_depth=4, max_seq_len=16)
+    r0, r1 = _req(0, 6, 8), _req(1, 6, 8)
+    sched.enqueue(r0)
+    sched.enqueue(r1)
+    assert len(sched.admit()) == 2               # 2 blocks each: pool full
+    r0.tokens.extend([5, 5, 5])                  # r0 grows to 9 positions
+    preempted = sched.ensure_decode_capacity()
+    assert preempted == [r1]                     # youngest loses its blocks
+    assert r1.state == "waiting" and r1.blocks == [] and r1.slot is None
+    assert sched.waiting[0] is r1                # front of the queue
+    assert sched.preempted == 1 and r1.preemptions == 1
+    assert len(r0.blocks) == 3                   # the freed block moved over
+    # r1 readmits once r0 retires.
+    sched.retire(r0, "finished")
+    assert [r.rid for r in sched.admit()] == [1]
+
+
+# ---------------------------------------------------------------------------
+# Paged decode attention (ops)
+
+
+def _reference(q, k_win, v_win, lens, hkv):
+    b, s, h, d = q.shape
+    L = k_win.shape[1]
+    k4 = k_win.reshape(b, L, hkv, d)
+    v4 = v_win.reshape(b, L, hkv, d)
+    qg = q.reshape(b, s, hkv, h // hkv, d)
+    logits = jnp.einsum("bshgd,blhd->bshgl", qg, k4).astype(
+        jnp.float32) / np.sqrt(d)
+    mask = jnp.arange(L)[None, :] <= jnp.asarray(lens)[:, None]
+    logits = jnp.where(mask[:, None, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bshgl,blhd->bshgd", probs, v4).reshape(b, s, h, d)
+
+
+def _paged_fixture(seed, b, hkv, h, d, bs, nb_per_seq, lens, scramble=True):
+    """Build (q, pools, tables, windows): logically contiguous per-seq
+    windows scattered into a (optionally scrambled) physical pool."""
+    rng = np.random.RandomState(seed)
+    f = hkv * d
+    window = nb_per_seq * bs
+    q = jnp.asarray(rng.randn(b, 1, h, d).astype(np.float32)) * 0.4
+    k_win = rng.randn(b, window, f).astype(np.float32) * 0.4
+    v_win = rng.randn(b, window, f).astype(np.float32) * 0.4
+    n_phys = b * nb_per_seq
+    order = (rng.permutation(n_phys) if scramble
+             else np.arange(n_phys)) + 1
+    tables = order.reshape(b, nb_per_seq).astype(np.int32)
+    k_pool = np.zeros((n_phys + 1, bs, f), np.float32)
+    v_pool = np.zeros((n_phys + 1, bs, f), np.float32)
+    for i in range(b):
+        for t in range(nb_per_seq):
+            k_pool[tables[i, t]] = k_win[i, t * bs:(t + 1) * bs]
+            v_pool[tables[i, t]] = v_win[i, t * bs:(t + 1) * bs]
+    return (q, jnp.asarray(k_pool), jnp.asarray(v_pool),
+            jnp.asarray(tables), jnp.asarray(k_win), jnp.asarray(v_win))
+
+
+@pytest.mark.parametrize("hkv,h", [(2, 4), (1, 8), (4, 16)])
+def test_paged_matches_reference(hkv, h):
+    b, d, bs, nb = 3, 16, 8, 4
+    lens = jnp.asarray([5, 17, 30], jnp.int32)
+    q, kp, vp, tables, k_win, v_win = _paged_fixture(0, b, hkv, h, d, bs,
+                                                     nb, lens)
+    out = paged_decode_attention(q, kp, vp, tables, lens, hkv)
+    ref = _reference(q, k_win, v_win, lens, hkv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_paged_block_table_indirection_bit_identical():
+    """Block-table correctness: the SAME logical windows through a
+    scrambled pool and through an identity-layout pool produce
+    bit-identical output — the indirection changes where bytes live,
+    never what the kernel computes."""
+    b, hkv, h, d, bs, nb = 3, 2, 4, 16, 8, 4
+    lens = jnp.asarray([7, 12, 31], jnp.int32)
+    q, kp_s, vp_s, tbl_s, _, _ = _paged_fixture(1, b, hkv, h, d, bs, nb,
+                                                lens, scramble=True)
+    q2, kp_i, vp_i, tbl_i, _, _ = _paged_fixture(1, b, hkv, h, d, bs, nb,
+                                                 lens, scramble=False)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q2))
+    out_s = paged_decode_attention(q, kp_s, vp_s, tbl_s, lens, hkv)
+    out_i = paged_decode_attention(q, kp_i, vp_i, tbl_i, lens, hkv)
+    assert bool(jnp.all(out_s == out_i))
+
+
+def test_paged_single_block_bitwise_matches_contiguous_kernel():
+    """With one block spanning the whole window, the paged kernel and
+    the contiguous decode kernel run the same single-tile accumulation —
+    outputs must agree to the bit, per sequence at its own position."""
+    b, hkv, h, d, bs = 2, 2, 4, 16, 32
+    lens_val = [9, 25]
+    q, kp, vp, tables, k_win, v_win = _paged_fixture(2, b, hkv, h, d, bs,
+                                                     1, lens_val)
+    lens = jnp.asarray(lens_val, jnp.int32)
+    out_paged = paged_decode_attention(q, kp, vp, tables, lens, hkv)
+    for i in range(b):
+        out_contig = decode_attention(q[i:i + 1], k_win[i:i + 1],
+                                      v_win[i:i + 1], lens_val[i], hkv)
+        assert bool(jnp.all(out_paged[i] == out_contig[0])), f"seq {i}"
+
+
+def test_paged_gather_fallback_matches_kernel():
+    b, hkv, h, d, bs, nb = 2, 2, 8, 16, 8, 3
+    lens = jnp.asarray([3, 20], jnp.int32)
+    q, kp, vp, tables, _, _ = _paged_fixture(3, b, hkv, h, d, bs, nb, lens)
+    out_k = paged_decode_attention(q, kp, vp, tables, lens, hkv)
+    out_g = paged_gather_attention(q, kp, vp, tables, lens, hkv)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_g),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_paged_cache_write_lands_in_the_right_page():
+    b, hkv, d, bs, nb = 2, 2, 4, 4, 3
+    f = hkv * d
+    kp = jnp.zeros((b * nb + 1, bs, f), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    tables = jnp.asarray(np.arange(b * nb).reshape(b, nb) + 1, jnp.int32)
+    lens = jnp.asarray([5, 8], jnp.int32)    # page 1 offset 1 / page 2 off 0
+    k_new = jnp.asarray(np.random.RandomState(0).randn(b, 1, hkv, d),
+                        jnp.float32)
+    v_new = -k_new
+    kp2, vp2 = paged_cache_write(kp, vp, k_new, v_new, tables, lens)
+    for i, pos in enumerate([5, 8]):
+        blk = int(tables[i, pos // bs])
+        row = np.asarray(kp2)[blk, pos % bs]
+        np.testing.assert_array_equal(row,
+                                      np.asarray(k_new)[i].reshape(f))
+    # Exactly two rows written per pool.
+    assert int(jnp.sum(jnp.any(kp2 != 0, axis=-1))) == 2
+
+
+# ---------------------------------------------------------------------------
+# Engine parity (tier-1 siblings; the 32-request acceptance is @slow)
+
+
+def test_engine_parity_single_device(tiny_variables):
+    engine = ServingEngine(MODEL, tiny_variables, config=SCFG)
+    assert engine.decode_path.path == "kernel"
+    rng = np.random.RandomState(0)
+    prompts, news = _mixed_workload(rng, 6, [5, 9, 16, 3], [6, 4, 8])
+    handles = [engine.submit(p, n) for p, n in zip(prompts, news)]
+    engine.run_until_idle()
+    _assert_parity(engine, tiny_variables, prompts, news, handles)
+    stats = engine.stats()
+    assert stats["requests_finished"] == 6
+    assert stats["tokens_generated"] == sum(news)
+    # 6 requests through 4 slots: continuous batching actually cycled.
+    assert stats["steps"] < sum(news)
+
+
+def test_engine_parity_tp_light(tp_setup):
+    mesh, sharded = tp_setup
+    engine = ServingEngine(MODEL, sharded, config=SCFG)
+    assert engine.decode_path.path == "kernel_tp", engine.decode_path
+    rng = np.random.RandomState(1)
+    prompts, news = _mixed_workload(rng, 4, [5, 12], [5, 7])
+    handles = [engine.submit(p, n) for p, n in zip(prompts, news)]
+    engine.run_until_idle()
+    _assert_parity(engine, sharded, prompts, news, handles, mesh=mesh)
+
+
+@pytest.mark.slow
+def test_engine_acceptance_mixed_length_tp(tp_setup):
+    """The round-9 acceptance bar: >=32 mixed-length requests (prompt
+    span 4x) through the continuous batcher on the TP-sharded decode
+    path, bit-identical per-request tokens vs bare generate(), with the
+    paged pool's peak block usage strictly below per-slot contiguous
+    max-length allocation."""
+    mesh, sharded = tp_setup
+    engine = ServingEngine(MODEL, sharded, config=SCFG)
+    assert engine.decode_path.path == "kernel_tp"
+    rng = np.random.RandomState(9)
+    prompts, news = _mixed_workload(rng, 32, [8, 12, 16, 32],
+                                    [4, 8, 12, 16])
+    assert max(len(p) for p in prompts) / min(len(p) for p in prompts) >= 4
+    handles = [engine.submit(p, n) for p, n in zip(prompts, news)]
+    engine.run_until_idle()
+    _assert_parity(engine, sharded, prompts, news, handles, mesh=mesh)
+    stats = engine.stats()
+    assert stats["requests_finished"] == 32
+    contiguous = SCFG.max_batch * (
+        (SCFG.max_seq_len + SCFG.block_size - 1) // SCFG.block_size)
+    assert stats["blocks_peak"] < contiguous, (
+        f"paged peak {stats['blocks_peak']} did not beat contiguous "
+        f"per-slot allocation {contiguous}")
+
+
+def test_engine_preemption_recompute_parity(tiny_variables):
+    """Capacity exhaustion: an undersized pool forces preemption; the
+    preempted sequence recomputes and still finishes with exactly the
+    bare-generate tokens."""
+    scfg = ServingConfig(max_batch=3, block_size=4, num_blocks=7,
+                         queue_depth=32, max_seq_len=28)
+    engine = ServingEngine(MODEL, tiny_variables, config=scfg)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, CFG.vocab_size, (8,)).astype(np.int32)
+               for _ in range(3)]
+    news = [12, 12, 12]
+    handles = [engine.submit(p, n) for p, n in zip(prompts, news)]
+    engine.run_until_idle()
+    stats = engine.stats()
+    assert stats["preemptions"] > 0, "pool sizing did not force preemption"
+    _assert_parity(engine, tiny_variables, prompts, news, handles)
+    assert stats["blocks_peak"] <= 7
+    assert engine.stats()["blocks_in_use"] == 0   # everything freed
+
+
+def test_engine_reject_when_queue_full(tiny_variables):
+    scfg = dataclasses.replace(SCFG, queue_depth=2)
+    engine = ServingEngine(MODEL, tiny_variables, config=scfg)
+    prompt = np.zeros((4,), np.int32)
+    engine.submit(prompt, 4)
+    engine.submit(prompt, 4)
+    with pytest.raises(RejectedError, match="queue is full"):
+        engine.submit(prompt, 4)
+    assert engine.stats()["requests_rejected"] == 1
+    engine.run_until_idle()   # the two admitted requests still finish
+    assert engine.stats()["requests_finished"] == 2
+
+
+def test_engine_cancel_waiting_and_running(tiny_variables):
+    scfg = dataclasses.replace(SCFG, max_batch=1)
+    engine = ServingEngine(MODEL, tiny_variables, config=scfg)
+    prompt = np.arange(4, dtype=np.int32)
+    run = engine.submit(prompt, 8)
+    parked = engine.submit(prompt, 8)   # max_batch=1: stays WAITING
+    engine.step()                       # admits + prefills `run`
+    parked.cancel()                     # cancel before admission
+    run.cancel()                        # cancel mid-flight
+    engine.run_until_idle()
+    for handle in (run, parked):
+        with pytest.raises(CancelledError):
+            handle.result(timeout=0)
+    stats = engine.stats()
+    assert stats["requests_cancelled"] == 2
+    assert stats["blocks_in_use"] == 0 and stats["active_sequences"] == 0
+
+
+def test_engine_stream_threaded(tiny_variables):
+    engine = ServingEngine(MODEL, tiny_variables, config=SCFG).start()
+    try:
+        prompt = np.arange(6, dtype=np.int32)
+        handle = engine.submit(prompt, 5)
+        streamed = list(handle.stream(timeout=60))
+        assert streamed == handle.result(timeout=60)
+        assert len(streamed) == 5
+    finally:
+        engine.shutdown()
+    # Shutdown leaves no engine thread behind.
+    assert not any(t.name == "hvd-serving-engine"
+                   for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# Zero-state stats, metrics, doctor
+
+
+def test_serving_stats_zero_state_before_any_engine():
+    """hvd.serving.stats() is a well-formed all-zeros dict before the
+    first request/engine — the controller_health() convention, pinned."""
+    import horovod_tpu.serving as serving
+
+    prev = serving._default_engine
+    serving._default_engine = None
+    try:
+        stats = serving.stats()
+        assert stats == zero_stats()
+        assert all(isinstance(stats[k], (int, float))
+                   for k in sorted(stats))
+        # The catalog is pinned: renaming a key must touch this test.
+        assert set(stats) == {
+            "queue_depth", "queue_limit", "active_sequences",
+            "blocks_total", "blocks_in_use", "blocks_peak",
+            "block_utilization", "requests_submitted",
+            "requests_finished", "requests_rejected",
+            "requests_cancelled", "preemptions", "tokens_generated",
+            "steps", "ttft_p50_seconds", "ttft_p99_seconds",
+            "tpot_p50_seconds", "tpot_p99_seconds",
+        }
+    finally:
+        serving._default_engine = prev
+
+
+def test_engine_emits_serving_metrics(tiny_variables):
+    metrics.reset_for_tests()
+    metrics.enable()
+    try:
+        engine = ServingEngine(MODEL, tiny_variables, config=SCFG)
+        prompts = [np.arange(5, dtype=np.int32)] * 2
+        handles = [engine.submit(p, 4) for p in prompts]
+        engine.run_until_idle()
+        for handle in handles:
+            handle.result(timeout=0)
+        snap = metrics.snapshot()
+        assert snap["hvd_serving_tokens_generated_total"][
+            "values"][0][1] == 8.0
+        assert snap["hvd_serving_steps_total"]["values"][0][1] >= 3
+        finished = {tuple(k): v for k, v in
+                    snap["hvd_serving_requests_total"]["values"]}
+        assert finished[("finished",)] == 2.0
+        assert snap["hvd_serving_blocks_total"]["values"][0][1] == 32.0
+        assert snap["hvd_serving_ttft_seconds"]["values"][0][1][
+            "count"] == 2
+    finally:
+        metrics.reset_for_tests()
+
+
+def test_doctor_serving_rules_synthetic():
+    from horovod_tpu.doctor import Evidence, diagnose
+
+    def gauge(v):
+        return {"type": "gauge", "values": [[[], v]]}
+
+    snap = {
+        "hvd_serving_queue_depth": gauge(15),
+        "hvd_serving_queue_limit": gauge(16),
+        "hvd_serving_requests_total": {
+            "type": "counter", "values": [[["finished"], 40.0],
+                                          [["rejected"], 12.0]]},
+        "hvd_serving_preemptions_total": {
+            "type": "counter", "values": [[[], 4.0]]},
+        "hvd_serving_blocks_total": gauge(64),
+    }
+    findings = {d.rule: d for d in diagnose(Evidence(snapshots={0: snap}))}
+    sat = findings["serving_queue_saturation"]
+    assert sat.severity == "critical"          # >= 10 rejects
+    assert "shedding load" in sat.hint
+    assert sat.evidence["rejected"] == 12
+    exh = findings["serving_block_exhaustion"]
+    assert exh.severity == "warning"
+    assert "HOROVOD_SERVING_NUM_BLOCKS" in exh.hint
+    # Healthy snapshot: neither rule fires.
+    healthy = {"hvd_serving_queue_depth": gauge(1),
+               "hvd_serving_queue_limit": gauge(16)}
+    assert not [d for d in diagnose(Evidence(snapshots={0: healthy}))
+                if d.rule.startswith("serving_")]
+
+
+def test_doctor_names_queue_saturation_past_admission(tiny_variables):
+    """The acceptance bullet: drive the engine past admission capacity
+    with the load generator and the LIVE doctor names queue
+    saturation."""
+    from horovod_tpu import doctor as hvd_doctor
+
+    loadgen = _load_example("serving_loadgen")
+    metrics.reset_for_tests()
+    metrics.enable()
+    try:
+        scfg = ServingConfig(max_batch=2, block_size=8, num_blocks=0,
+                             queue_depth=2, max_seq_len=64)
+        engine = ServingEngine(MODEL, tiny_variables, config=scfg).start()
+        trace = loadgen.build_trace(seed=9, requests=12, rate=0.0,
+                                    min_prompt=8, max_prompt=32,
+                                    min_new=8, max_new=16,
+                                    vocab_size=CFG.vocab_size)
+        _, rejected, _ = loadgen.run_workload(engine, trace,
+                                              timeout_s=300.0)
+        engine.shutdown()
+        assert rejected > 0, "workload did not exceed admission capacity"
+        report = hvd_doctor.report()
+        rules = {f["rule"] for f in report["findings"]}
+        assert "serving_queue_saturation" in rules, report
+    finally:
+        metrics.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# Load generator + serving trace file
+
+
+def _load_example(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "examples", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_loadgen_trace_is_seed_deterministic():
+    loadgen = _load_example("serving_loadgen")
+    kw = dict(requests=8, rate=4.0, min_prompt=8, max_prompt=32,
+              min_new=4, max_new=8, vocab_size=512)
+    a = loadgen.build_trace(seed=9, **kw)
+    b = loadgen.build_trace(seed=9, **kw)
+    c = loadgen.build_trace(seed=10, **kw)
+    assert len(a) == 8
+    for (ta, pa, na), (tb, pb, nb) in zip(a, b):
+        assert ta == tb and na == nb
+        np.testing.assert_array_equal(pa, pb)
+    assert any(not np.array_equal(pa, pc) or ta != tc
+               for (ta, pa, _), (tc, pc, _) in zip(a, c))
+    # Prompt lengths genuinely mixed (the heterogeneity paging is for).
+    lens = {len(p) for _, p, _ in a}
+    assert len(lens) > 1
+
+
+def test_engine_writes_serving_trace(tiny_variables, tmp_path,
+                                     monkeypatch):
+    from horovod_tpu.trace import SERVING_PHASES, rank_trace_files
+
+    monkeypatch.setenv("HOROVOD_TRACE_DIR", str(tmp_path))
+    engine = ServingEngine(MODEL, tiny_variables, config=SCFG)
+    handle = engine.submit(np.arange(5, dtype=np.int32), 4)
+    engine.run_until_idle()
+    handle.result(timeout=0)
+    engine.shutdown()
+    path = tmp_path / "trace.serving.rank0.json"
+    assert path.exists()
+    events = json.loads(path.read_text())
+    phases = {e["name"] for e in events if e.get("ph") == "X"}
+    assert phases == set(SERVING_PHASES)
+    # The serving trace must NOT be picked up as a collective rank trace
+    # (it would pollute the merge's straggler attribution).
+    assert rank_trace_files(str(tmp_path)) == {}
+
+
+def test_serving_env_knobs_parse(monkeypatch):
+    from horovod_tpu.common import config as hvd_config
+
+    monkeypatch.setenv("HOROVOD_SERVING_MAX_BATCH", "32")
+    monkeypatch.setenv("HOROVOD_SERVING_BLOCK_SIZE", "garbage")
+    monkeypatch.setenv("HOROVOD_SERVING_NUM_BLOCKS", "-3")
+    monkeypatch.setenv("HOROVOD_SERVING_QUEUE_DEPTH", "0")
+    monkeypatch.setenv("HOROVOD_SERVING_MAX_SEQ_LEN", "4096")
+    cfg = ServingConfig.from_env()
+    assert cfg.max_batch == 32
+    assert cfg.block_size == 16          # garbage -> default
+    assert cfg.num_blocks == 0           # negative clamps to derived
+    assert cfg.queue_depth == 128        # non-positive -> default
+    assert cfg.max_seq_len == 4096
+    assert hvd_config.serving_max_batch() == 32

@@ -20,6 +20,7 @@ from mp_harness import run_ranks as _run_ranks
 from horovod_tpu import metrics
 from horovod_tpu import trace as hvd_trace
 from horovod_tpu.trace import (
+    ALL_PHASES,
     PHASES,
     ClockSync,
     TraceWriter,
@@ -139,7 +140,9 @@ def test_trace_writer_spans_anchor_and_fixed_vocabulary(tmp_path):
     assert tids["negotiate"] != tids["execute"]
     thread_names = {e["args"]["name"] for e in events
                     if e.get("name") == "thread_name"}
-    assert thread_names == set(PHASES)
+    # Thread metadata covers the FULL vocabulary (collective + serving
+    # phases); the controller's spans only ever use the collective five.
+    assert thread_names == set(ALL_PHASES)
     assert events[-1]["name"] == "trace_end"
     assert events[-1]["args"] == {"dropped_events": 0, "events": 2}
     # Idempotent close; bytes match the file (the shutdown wire push).
