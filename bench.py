@@ -264,6 +264,14 @@ FULL_ROWS = {
         "args": ["--model", "tiny", "--tp", "2", "--force-host-devices",
                  "8", "--f32"],
         "json": True},
+    # Wire-compression bandwidth row (round 10): none vs bf16 vs int8-EF
+    # across transfer-chunk sizes on a real 2-rank loopback-TCP ring —
+    # CPU-only, refreshes artifacts/allreduce_bandwidth_r10.json beside
+    # the r3/r4 rows (substrate recorded honestly inside).
+    "allreduce_bandwidth_wire_2rank": {
+        "script": "examples/wire_bandwidth_probe.py",
+        "args": ["--out", "artifacts/allreduce_bandwidth_r10.json"],
+        "json": True},
     "resnet50_b128": None,  # runs child_bench (median of 5 windows)
     "vit_s16_224_b64_adamw_spc8": {
         "script": "examples/jax_vit_training.py",

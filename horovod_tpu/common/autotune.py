@@ -135,8 +135,16 @@ class BayesianOptimizer:
 # hierarchical allreduce, hierarchical allgather, response-cache enable.
 CATEGORICAL_KNOBS = ("hierarchical_allreduce", "hierarchical_allgather",
                      "cache_enabled")
-# Continuous knobs, for ``fixed=`` spelling.
-CONTINUOUS_KNOBS = ("fusion_threshold", "cycle_time")
+# Continuous knobs, for ``fixed=`` spelling. ``ring_chunk`` (round 10) is
+# the native ring's transfer-chunk size — per-rank pipelining granularity
+# for the reduce-while-receive sink and the compress-ahead cursor
+# (docs/wire-compression.md); it only joins the search when the caller
+# provides an initial value (a job without the native ring has no chunk
+# to tune).
+CONTINUOUS_KNOBS = ("fusion_threshold", "cycle_time", "ring_chunk")
+# log2-bytes box for the ring chunk: 64 KiB .. 2 MiB, bracketing the
+# per-link-class defaults (config.RING_CHUNK_BYTES_BY_LINK).
+RING_CHUNK_LOG2_BOUNDS = (16.0, 21.0)
 
 
 class ParameterManager:
@@ -175,7 +183,8 @@ class ParameterManager:
                  fixed=frozenset(),
                  tune_hierarchical: bool = False,
                  hierarchical: bool = False,
-                 straggler_weight: float = 0.0):
+                 straggler_weight: float = 0.0,
+                 ring_chunk_bytes: Optional[int] = None):
         # Legacy spelling (round-3 callers/tests): hierarchical allreduce
         # only, tuned iff tune_hierarchical.
         if categoricals is None:
@@ -183,14 +192,25 @@ class ParameterManager:
             if not tune_hierarchical:
                 fixed = set(fixed) | {"hierarchical_allreduce"}
         self.fixed = frozenset(fixed)
-        # (log2 fusion bytes, cycle ms)
-        self._bo = BayesianOptimizer([(20.0, 28.0), (1.0, 25.0)], seed=seed)
+        # Ring transfer chunk joins the BO box as a third dimension only
+        # when the caller supplies an initial value AND the knob isn't
+        # pinned — jobs without the native ring keep the original 2-D
+        # search (and its exact behavior) bit for bit.
+        self._tune_chunk = (ring_chunk_bytes is not None
+                            and "ring_chunk" not in self.fixed)
+        bounds = [(20.0, 28.0), (1.0, 25.0)]  # (log2 fusion bytes, cycle ms)
+        if self._tune_chunk:
+            bounds.append(RING_CHUNK_LOG2_BOUNDS)  # log2 chunk bytes
+        self._bo = BayesianOptimizer(bounds, seed=seed)
         # Exact pinned values for fixed knobs: a log2/2** round trip would
         # drift a non-power-of-two user threshold.
         self._initial_threshold = int(fusion_threshold)
         self._initial_cycle_ms = float(cycle_time_ms)
         self.fusion_threshold = int(fusion_threshold)
         self.cycle_time_ms = float(cycle_time_ms)
+        self.ring_chunk_bytes = (int(ring_chunk_bytes)
+                                 if ring_chunk_bytes is not None else None)
+        self.best_ring_chunk_bytes = self.ring_chunk_bytes
         self.categoricals = {k: bool(v) for k, v in categoricals.items()}
         self._warmup_left = self.WARMUP_SAMPLES
         self._scores: List[float] = []
@@ -233,8 +253,9 @@ class ParameterManager:
         if self._completed:
             return False
         cats_active = bool(self._cat_order) and not self._cats_converged
-        return cats_active or not (
+        continuous_active = self._tune_chunk or not (
             {"fusion_threshold", "cycle_time"} <= self.fixed)
+        return cats_active or continuous_active
 
     @property
     def hierarchical(self) -> bool:  # legacy accessor
@@ -318,23 +339,32 @@ class ParameterManager:
             "recv_wait_penalty": w * wait_frac,
             "score": score,
         }
-        params = (np.log2(self.fusion_threshold), self.cycle_time_ms)
-        self._bo.add_sample(params, score)
+        params = [np.log2(self.fusion_threshold), self.cycle_time_ms]
+        if self._tune_chunk:
+            params.append(np.log2(self.ring_chunk_bytes))
+        self._bo.add_sample(tuple(params), score)
         if score > self._best_score:
             self._best_score = score
             self.best_fusion_threshold = self.fusion_threshold
             self.best_cycle_time_ms = self.cycle_time_ms
+            self.best_ring_chunk_bytes = self.ring_chunk_bytes
             self.best_categoricals = dict(self.categoricals)
             self.best_objective = dict(self.last_objective)
         if self._log_path:
             cat_items = sorted(self.categoricals.items())
+            chunk_col = f",{self.ring_chunk_bytes}" if self._tune_chunk \
+                else ""
             with open(self._log_path, "a") as f:
                 if self._log_header_due:
                     # Self-describing: the column set varies with the
-                    # categorical knobs, so name them — but only at the
-                    # top of a fresh file (restarts append data rows).
+                    # categorical knobs (and the ring-chunk knob), so
+                    # name them — but only at the top of a fresh file
+                    # (restarts append data rows).
                     if f.tell() == 0:
-                        f.write("time,fusion_threshold,cycle_time_ms,"
+                        chunk_hdr = (",ring_chunk_bytes"
+                                     if self._tune_chunk else "")
+                        f.write("time,fusion_threshold,cycle_time_ms"
+                                + chunk_hdr + ","
                                 + ",".join(k for k, _ in cat_items)
                                 + ",throughput_bytes_per_sec,"
                                 "slack_penalty,recv_wait_penalty,"
@@ -344,7 +374,7 @@ class ParameterManager:
                 # Log-row wall stamp, read next to other logs — not
                 # duration math. hvdlint: disable=HVD004
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
-                        f"{self.cycle_time_ms:.3f},{cats},"
+                        f"{self.cycle_time_ms:.3f}{chunk_col},{cats},"
                         f"{throughput:.1f},{w * slack_frac:.6f},"
                         f"{w * wait_frac:.6f},{score:.1f}\n")
 
@@ -359,6 +389,7 @@ class ParameterManager:
             self._completed = True
             self.fusion_threshold = self.best_fusion_threshold
             self.cycle_time_ms = self.best_cycle_time_ms
+            self.ring_chunk_bytes = self.best_ring_chunk_bytes
             self.categoricals = dict(self.best_categoricals)
             if self._log_path:
                 with open(self._log_path, "a") as f:
@@ -378,6 +409,8 @@ class ParameterManager:
         self.cycle_time_ms = (
             self._initial_cycle_ms if "cycle_time" in self.fixed
             else float(nxt[1]))
+        if self._tune_chunk:
+            self.ring_chunk_bytes = int(2 ** nxt[2])
         self._scores = []
         self._slack_fracs = []
         self._wait_fracs = []
@@ -402,6 +435,12 @@ class ParameterManager:
             "cycle_time_ms": float(self.cycle_time_ms),
             "best_fusion_threshold": int(self.best_fusion_threshold),
             "best_cycle_time_ms": float(self.best_cycle_time_ms),
+            "ring_chunk_bytes": (int(self.ring_chunk_bytes)
+                                 if self.ring_chunk_bytes is not None
+                                 else None),
+            "best_ring_chunk_bytes": (int(self.best_ring_chunk_bytes)
+                                      if self.best_ring_chunk_bytes
+                                      is not None else None),
             "straggler_weight": self.straggler_weight,
             "last_objective": self.last_objective,
             "best_objective": self.best_objective,

@@ -156,6 +156,72 @@ def cross_ring_addrs() -> Optional[str]:
     return os.environ.get("HOROVOD_CROSS_RING_ADDRS") or None
 
 
+# Wire dtypes the native ring can put f32 allreduce payloads on the wire
+# as (docs/wire-compression.md); must match core.bindings.WIRE_DTYPE_CODES.
+RING_WIRE_DTYPES = ("none", "bf16", "fp16", "int8")
+
+# Default transfer-chunk bytes per link class (docs/wire-compression.md):
+# loopback wants big chunks (syscall overhead dominates, no real wire to
+# overlap with), plain TCP keeps the round-3 256 KiB sweet spot, DCN-class
+# NICs amortize better at 512 KiB, ICI-class links are long-BDP pipes.
+RING_CHUNK_BYTES_BY_LINK = {
+    "local": 1 << 20,
+    "tcp": 256 << 10,
+    "dcn": 512 << 10,
+    "ici": 2 << 20,
+}
+
+
+def ring_wire_dtype() -> str:
+    """``HOROVOD_RING_WIRE_DTYPE``: on-the-wire representation of f32
+    payloads in the native ring's allreduce data phases — ``bf16``/``fp16``
+    halve every hop's bytes (accumulation stays f32), ``int8`` quarters
+    them with per-block scales + error feedback (convergence contract in
+    docs/wire-compression.md). Unset/garbage -> ``none``, which keeps the
+    byte stream identical to the pre-round-10 ring. Must be identical on
+    every rank (launcher-exported, like the other ring knobs)."""
+    val = (os.environ.get("HOROVOD_RING_WIRE_DTYPE") or "").strip().lower()
+    return val if val in RING_WIRE_DTYPES else "none"
+
+
+def ring_chunk_bytes() -> int:
+    """``HOROVOD_RING_CHUNK_BYTES``: transfer-chunk size for the ring's
+    reduce-while-receive sink and compress-ahead cursor (per-rank
+    pipelining granularity only — the int8 wire format is anchored on
+    fixed quant blocks, so ranks need not agree). 0 (default, and for
+    garbage) means auto: the per-link-class table keyed by
+    :func:`ring_link_class`, and the knob joins the GP autotuner's search
+    when ``HOROVOD_AUTOTUNE`` is on. Explicit values pin the knob
+    (excluded from the search, like every other fixed= override)."""
+    return max(0, _env_int("HOROVOD_RING_CHUNK_BYTES", 0))
+
+
+def ring_link_class() -> str:
+    """``HOROVOD_RING_LINK_CLASS``: the flat ring's link class
+    (local/tcp/dcn/ici), keying the default chunk table. Unset -> inferred
+    from the launcher-exported ring addresses (``run.nic_discovery
+    .infer_link_class``): loopback-only -> ``local``, anything spanning
+    hosts -> ``tcp``; operators on known DCN/ICI fabrics export the class
+    explicitly (or the launcher does, where NIC discovery identified
+    one)."""
+    val = (os.environ.get("HOROVOD_RING_LINK_CLASS") or "").strip().lower()
+    if val in RING_CHUNK_BYTES_BY_LINK:
+        return val
+    from ..run.nic_discovery import infer_link_class
+
+    return infer_link_class(ring_addrs())
+
+
+def resolved_ring_chunk_bytes() -> int:
+    """The chunk size the ring should start at: the explicit env value, or
+    the link-class default. One resolver so the controller, the autotuner
+    seeding, and the metrics gauge agree."""
+    explicit = ring_chunk_bytes()
+    if explicit:
+        return explicit
+    return RING_CHUNK_BYTES_BY_LINK[ring_link_class()]
+
+
 def cpu_ops() -> str:
     """``HOROVOD_CPU_OPS``: "star" forces the pure-Python star data
     plane; anything else (default "ring") allows the native rings. Part

@@ -9,6 +9,15 @@ TPU note: on the SPMD tier compression is just a dtype cast that XLA fuses
 into the collective, and ``bfloat16`` is the hardware-native half type — so we
 add a ``bfloat16`` compressor (fp16 is kept for wire-format parity; both halve
 bytes on ICI/DCN).
+
+This module is the USER-FACING cast layer: the tensor really changes dtype
+before it is enqueued, like the reference's ``torch/compression.py``. Since
+round 10 the native ring also compresses **on the wire** underneath —
+``HOROVOD_RING_WIRE_DTYPE=bf16|fp16|int8`` casts each chunk at send time
+while accumulation (and the user-visible dtype) stays f32, with int8 error
+feedback managed by the native controller. See ``docs/wire-compression.md``
+for how the two layers compose (they are independent; the wire layer is a
+no-op on tensors this module already cast to a half type).
 """
 
 from __future__ import annotations

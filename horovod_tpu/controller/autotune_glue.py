@@ -24,6 +24,7 @@ from ..common.config import Config, autotune_straggler_weight
 _FIXING_ENV = {
     "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
     "cycle_time": "HOROVOD_CYCLE_TIME",
+    "ring_chunk": "HOROVOD_RING_CHUNK_BYTES",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
     "cache_enabled": "HOROVOD_CACHE_CAPACITY",
@@ -32,7 +33,8 @@ _FIXING_ENV = {
 
 def make_parameter_manager(config: Config,
                            tune_hierarchical: bool = False,
-                           tune_cache: bool = False) -> ParameterManager:
+                           tune_cache: bool = False,
+                           tune_ring_chunk: bool = False) -> ParameterManager:
     fixed = {knob for knob, env in sorted(_FIXING_ENV.items())
              if env in os.environ}
     if not tune_hierarchical:
@@ -45,6 +47,20 @@ def make_parameter_manager(config: Config,
         # runtime toggle — exploring a knob the engine ignores would only
         # pollute the scores.
         fixed |= {"cache_enabled"}
+    ring_chunk = None
+    if tune_ring_chunk:
+        # Only a job with the native ring data plane has a transfer chunk
+        # to tune; seed the knob at the resolved (env or link-class
+        # default) value so search starts from today's behavior.
+        from ..common.config import resolved_ring_chunk_bytes, \
+            ring_chunk_bytes
+
+        ring_chunk = resolved_ring_chunk_bytes()
+        if ring_chunk_bytes() == 0:
+            # The env var may be PRESENT but say "auto" (0/empty/garbage
+            # all parse to 0, the documented join-the-search sentinel) —
+            # only an explicit positive value pins the knob.
+            fixed.discard("ring_chunk")
     return ParameterManager(
         fusion_threshold=config.fusion_threshold_bytes,
         cycle_time_ms=config.cycle_time_ms,
@@ -56,6 +72,7 @@ def make_parameter_manager(config: Config,
         },
         fixed=fixed,
         straggler_weight=autotune_straggler_weight(),
+        ring_chunk_bytes=ring_chunk,
     )
 
 

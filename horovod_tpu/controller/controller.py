@@ -245,6 +245,26 @@ class Controller:
 
             self._ring = RingBackend(topology.rank, topology.size,
                                      ring_addrs, job_secret())
+        # Wire compression for the flat ring's data phases
+        # (docs/wire-compression.md). bf16/fp16 are stateless casts the
+        # Python engine can apply as-is; int8 needs the per-tensor
+        # error-feedback residual store that lives in the NATIVE
+        # controller — here it downgrades loudly to the uncompressed
+        # stream rather than silently changing the convergence contract.
+        from ..common.config import ring_wire_dtype
+        from ..core.bindings import WIRE_DTYPE_CODES
+
+        wire = ring_wire_dtype()
+        if wire == "int8":
+            if self._ring is not None:
+                logging.warning(
+                    "HOROVOD_RING_WIRE_DTYPE=int8 requires the native "
+                    "engine (error-feedback residuals live in "
+                    "controller/native.py); the Python engine keeps the "
+                    "uncompressed wire — set HOROVOD_ENGINE=native, or "
+                    "use bf16/fp16 here")
+            wire = "none"
+        self._wire_code = WIRE_DTYPE_CODES[wire]
 
         # Two-level (hierarchical) data plane: a ring inside each node plus a
         # ring of local roots across nodes — the analogue of the reference's
@@ -286,6 +306,18 @@ class Controller:
                     self._cross_ring = RingBackend(
                         topology.cross_rank, topology.cross_size, cross_addrs,
                         job_secret())
+        if (self._ring is not None or self._local_ring is not None
+                or self._cross_ring is not None):
+            # Transfer-chunk size (explicit env or link-class default) —
+            # the same resolution the native engine applies. Process-wide
+            # in the native core, so the flat AND hierarchical rings all
+            # pipeline on it; pushed after every ring exists so
+            # hierarchical-only layouts (no flat HOROVOD_RING_ADDRS) get
+            # it too.
+            from ..common.config import resolved_ring_chunk_bytes
+            from ..core import bindings
+
+            bindings.set_chunk_bytes(resolved_ring_chunk_bytes())
         # Coordinator-side straggler observations for the cycle just
         # coordinated: worst rank's tick lateness and the summed excess
         # wait (seconds). Written by _coordinate, read by _cycle on the
@@ -1438,7 +1470,8 @@ class Controller:
         elif self._use_ring(dtype):
             # Native C++ ring (bandwidth-optimal; reduce-scatter + allgather).
             result = np.array(buf, copy=True)
-            self._ring.allreduce_(result, average=False)
+            self._ring.allreduce_(result, average=False,
+                                  wire_dtype=self._wire_code)
         elif self.topo.rank == 0:
             acc = buf.astype(buf.dtype, copy=True)
             for rank in range(1, self.topo.size):

@@ -165,14 +165,19 @@ class NativeController:
         self._lock = threading.Lock()
         self._autoname_counter: Dict[str, int] = {}
         # Buffers the C++ engine holds raw pointers into, keyed by engine
-        # handle id. The NativeHandle also references its buffer, but a
-        # caller may drop the handle without waiting — pinning here keeps
-        # the memory alive for the background thread regardless (the
-        # reference's _handle_map contract, torch/mpi_ops.py:54). Entries
-        # for never-waited handles stay pinned for the controller's life.
-        self._pinned: Dict[int, np.ndarray] = {}
+        # handle id: (data array, residual-or-None, tensor name). The
+        # NativeHandle also references its buffer, but a caller may drop
+        # the handle without waiting — pinning here keeps the memory alive
+        # for the background thread regardless (the reference's
+        # _handle_map contract, torch/mpi_ops.py:54). Entries for
+        # never-waited handles stay pinned for the controller's life. The
+        # names mirror the engine's pending-name table so the EF layer
+        # can see a doomed duplicate BEFORE touching any buffer.
+        self._pinned: Dict[int, tuple] = {}
+        self._inflight_names: set = set()
         self._shut = False
 
+        from ..common.config import resolved_ring_chunk_bytes, ring_wire_dtype
         from ..common.config import ring_addrs as _ring_addrs
 
         ring_addrs = _ring_addrs() or ""
@@ -183,16 +188,30 @@ class NativeController:
         secret = job_secret()
         key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
         timeline = (config.timeline_filename or "") if topology.rank == 0 else ""
+        # Wire compression for the ring's allreduce data phases
+        # (docs/wire-compression.md). The dtype code rides init; the
+        # int8 error-feedback residuals live HERE, per tensor name
+        # (self._residuals) — the engine only transports the error.
+        self._wire_dtype = ring_wire_dtype()
+        self._wire_code = bindings.WIRE_DTYPE_CODES[self._wire_dtype]
+        self._residuals: Dict[str, np.ndarray] = {}
+        self._warned_unnamed_int8 = False
         rc = lib.hvd_eng_init(
             topology.rank, topology.size, ring_addrs.encode(), key,
             len(secret), config.cycle_time_ms, config.fusion_threshold_bytes,
             config.cache_capacity, 1 if config.stall_check_disable else 0,
             config.stall_check_seconds, config.stall_shutdown_seconds,
-            timeline.encode(), 1 if config.timeline_mark_cycles else 0)
+            timeline.encode(), 1 if config.timeline_mark_cycles else 0,
+            self._wire_code)
         if rc != 0:
             raise RuntimeError(
                 "native engine init failed: "
                 + lib.hvd_eng_last_error().decode(errors="replace"))
+        # Transfer-chunk size: explicit env value, else the link-class
+        # default (loopback/tcp/dcn/ici table). Per-rank pipelining
+        # granularity only, so each rank may set — and later retune — its
+        # own without cross-rank agreement.
+        bindings.set_chunk_bytes(resolved_ring_chunk_bytes())
 
         # Coordinator-side autotuner: sample engine throughput, retune with
         # the GP, push parameters into the engine (reference ParameterManager
@@ -203,7 +222,11 @@ class NativeController:
         if config.autotune and topology.rank == 0:
             from .autotune_glue import make_parameter_manager
 
-            self._param_manager = make_parameter_manager(config)
+            # The native engine always rides the ring data plane, so the
+            # ring transfer chunk joins the search (unless the env pinned
+            # it); tuned values are pushed in _tune_loop.
+            self._param_manager = make_parameter_manager(
+                config, tune_ring_chunk=topology.size > 1)
             self._tuner = threading.Thread(
                 target=self._tune_loop, name="hvd-native-autotune",
                 daemon=True)
@@ -213,7 +236,33 @@ class NativeController:
 
     def _unpin(self, handle_id: int) -> None:
         with self._lock:
-            self._pinned.pop(handle_id, None)
+            entry = self._pinned.pop(handle_id, None)
+            if entry is not None:
+                self._inflight_names.discard(entry[2])
+
+    def _name_still_pending(self, name: str) -> bool:
+        """Whether a same-name op is STILL pending engine-side. The
+        mirror set alone would diverge for handles dropped without
+        wait() — _unpin only runs on wait, while the engine frees the
+        name at completion — so a mirrored name is re-checked against the
+        engine and self-healed (buffers unpinned, mirror cleared) once
+        the op has finished; EF for that tensor then resumes instead of
+        being silently disabled forever."""
+        with self._lock:
+            if name not in self._inflight_names:
+                return False
+            h = next((h for h, e in sorted(self._pinned.items())
+                      if e[2] == name), None)
+            if h is None:
+                self._inflight_names.discard(name)
+                return False
+            if self._lib.hvd_eng_poll(h) == 0:
+                return True  # genuinely pending
+            # Completed (or released): engine no longer touches the
+            # buffers and has freed the name.
+            self._pinned.pop(h, None)
+            self._inflight_names.discard(name)
+            return False
 
     def _autoname(self, kind: str, name: Optional[str]) -> str:
         if name is not None:
@@ -226,7 +275,8 @@ class NativeController:
     def _enqueue(self, kind: str, name: Optional[str], array,
                  root_rank: int = -1,
                  postprocess: Optional[Callable] = None,
-                 inplace: bool = False) -> NativeHandle:
+                 inplace: bool = False,
+                 residual: Optional[np.ndarray] = None) -> NativeHandle:
         """Zero-copy enqueue: the engine reads — and for allreduce /
         broadcast WRITES the result — directly in ``array``'s memory; the
         handle pins the array until completion.
@@ -256,10 +306,12 @@ class NativeController:
                 "uint16/bool/float16/"
                 "bfloat16); set HOROVOD_ENGINE=python for arbitrary dtypes"))
         shape = (ctypes.c_longlong * max(array.ndim, 1))(*array.shape)
+        res_ptr = (residual.ctypes.data_as(ctypes.c_void_p)
+                   if residual is not None else None)
         h = self._lib.hvd_eng_enqueue(
             _OP_CODES[kind], name.encode(),
             array.ctypes.data_as(ctypes.c_void_p), shape, array.ndim, code,
-            root_rank)
+            root_rank, res_ptr)
         if h == -2:
             return NativeHandle.failed(RuntimeError(
                 f"Duplicate tensor name {name!r}: a collective with this "
@@ -270,7 +322,10 @@ class NativeController:
 
             return NativeHandle.failed(ShutdownError(_SHUTDOWN_MSG))
         with self._lock:
-            self._pinned[h] = array
+            # Residual pinned alongside the data: the ring writes the
+            # quantization error into it until the handle resolves.
+            self._pinned[h] = (array, residual, name)
+            self._inflight_names.add(name)
         return NativeHandle(self, h, postprocess, buffer=array)
 
     def allreduce_async(self, tensor, average: bool = True,
@@ -298,6 +353,46 @@ class NativeController:
             array = orig
             enqueue_inplace = inplace
         size = self.topo.size
+
+        # int8 wire error feedback (docs/wire-compression.md): carry the
+        # previous round's quantization error of THIS tensor into this
+        # round's contribution, and hand the ring a buffer to record this
+        # round's error into. Keyed by tensor name, so it needs an
+        # explicit (step-stable) one — autonames increment per call and
+        # would leak one dead residual per step.
+        residual = None
+        if self._wire_code == bindings.WIRE_DTYPE_CODES["int8"] \
+                and array.dtype == np.float32:
+            doomed_duplicate = name is not None and \
+                self._name_still_pending(name)
+            if name is None:
+                if not self._warned_unnamed_int8:
+                    self._warned_unnamed_int8 = True
+                    logging.warning(
+                        "int8 wire compression without a tensor name: no "
+                        "error feedback is applied (residuals are keyed by "
+                        "name); pass name= to allreduce for the documented "
+                        "convergence contract")
+            elif not doomed_duplicate:
+                # A same-name op in flight means the engine will reject
+                # this enqueue — touch NO buffer for it: no compensation
+                # of the caller's in-place tensor, no re-keying of a
+                # residual the live op's ring thread is still writing.
+                residual = self._residuals.get(name)
+                if residual is None or residual.size != array.size:
+                    # Committed to self._residuals only after the enqueue
+                    # succeeds (below): the dict must keep the OLD buffer
+                    # alive while any chance remains that an in-flight op
+                    # still owns it.
+                    residual = np.zeros(array.size, np.float32)
+                if not enqueue_inplace:
+                    # Take the defensive copy HERE (instead of inside
+                    # _enqueue) so the compensation below mutates our
+                    # private copy, never the caller's array.
+                    array = np.array(array, order="C", copy=True)
+                    enqueue_inplace = True
+                flat = array.reshape(-1)
+                np.add(flat, residual, out=flat)
 
         def post(out, _ctx=ctx, _compression=compression):
             if _compression is not None:
@@ -327,8 +422,22 @@ class NativeController:
                 out = orig
             return wrap(out) if wrap is not None else out
 
-        return self._enqueue("allreduce", name, array, postprocess=post,
-                             inplace=enqueue_inplace)
+        handle = self._enqueue("allreduce", name, array, postprocess=post,
+                               inplace=enqueue_inplace, residual=residual)
+        if residual is not None:
+            if handle._error is None:
+                # Enqueue accepted: this buffer (fresh or reused) is now
+                # THE residual the ring is filling for this tensor.
+                self._residuals[name] = residual
+            elif inplace:
+                # Enqueue rejected after we compensated the caller's own
+                # tensor (rare: race with a duplicate, or shutdown):
+                # restore it so a retry doesn't double-compensate. f32
+                # subtract may differ from the original by an ulp — a
+                # rounding crumb, vs a whole residual of bias.
+                flat = array.reshape(-1)
+                np.subtract(flat, residual, out=flat)
+        return handle
 
     def allgather_async(self, tensor, name: Optional[str] = None,
                         wrap: Optional[Callable] = None) -> NativeHandle:
@@ -396,8 +505,15 @@ class NativeController:
             if tuned is not None:
                 threshold, cycle_ms = tuned[:2]
                 self._lib.hvd_eng_set_params(int(threshold), float(cycle_ms))
-                logging.debug("native autotune: threshold=%d cycle=%.2fms",
-                              int(threshold), float(cycle_ms))
+                chunk = self._param_manager.ring_chunk_bytes
+                if chunk:
+                    # Per-rank pipelining granularity — safe to retune
+                    # live, no cross-rank agreement needed (the int8 wire
+                    # format is anchored on fixed quant blocks).
+                    bindings.set_chunk_bytes(int(chunk))
+                logging.debug(
+                    "native autotune: threshold=%d cycle=%.2fms chunk=%s",
+                    int(threshold), float(cycle_ms), chunk)
 
     @property
     def hierarchical_active(self) -> bool:

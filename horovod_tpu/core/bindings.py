@@ -26,6 +26,16 @@ _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed: Optional[str] = None
 
+# Must match enum WireDType in ring.cc: the on-the-wire representation of
+# f32 allreduce payloads (HOROVOD_RING_WIRE_DTYPE via common/config.py).
+WIRE_DTYPE_CODES = {
+    "none": 0,
+    "bf16": 1,
+    "fp16": 2,
+    "int8": 3,
+}
+WIRE_DTYPE_NAMES = {v: k for k, v in WIRE_DTYPE_CODES.items()}
+
 # Must match enum DType in ring.cc.
 _DTYPE_CODES = {
     "float32": 0,
@@ -123,6 +133,46 @@ def build() -> str:
     return lib_path
 
 
+def loaded() -> Optional[ctypes.CDLL]:
+    """The already-loaded library, or None — WITHOUT triggering a build.
+    For observability paths (metrics mirroring) that must never pay a
+    compile just to report zeros."""
+    return _lib
+
+
+def wire_stats() -> dict:
+    """Ring wire-traffic counters (hvd_ring_get_wire_stats): actual and
+    f32-equivalent bytes per wire dtype plus cumulative compress seconds.
+    All-zeros when the native core was never loaded."""
+    lib = loaded()
+    out = {
+        "tx_bytes": {name: 0 for name in WIRE_DTYPE_CODES},
+        "logical_bytes": {name: 0 for name in WIRE_DTYPE_CODES},
+        "compress_seconds": 0.0,
+        "chunk_bytes": 0,
+    }
+    if lib is None:
+        return out
+    tx = (ctypes.c_longlong * 4)()
+    logical = (ctypes.c_longlong * 4)()
+    comp = ctypes.c_double()
+    lib.hvd_ring_get_wire_stats(tx, logical, ctypes.byref(comp))
+    for name, code in WIRE_DTYPE_CODES.items():
+        out["tx_bytes"][name] = int(tx[code])
+        out["logical_bytes"][name] = int(logical[code])
+    out["compress_seconds"] = float(comp.value)
+    out["chunk_bytes"] = int(lib.hvd_ring_get_chunk_bytes())
+    return out
+
+
+def set_chunk_bytes(nbytes: int) -> None:
+    """Push the ring transfer-chunk size (per-rank pipelining granularity;
+    clamped/rounded by the C side). No-op when the core isn't loaded."""
+    lib = loaded()
+    if lib is not None:
+        lib.hvd_ring_set_chunk_bytes(int(nbytes))
+
+
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed); returns None if the toolchain is absent,
     letting callers fall back to the pure-Python star data plane."""
@@ -148,6 +198,22 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_ring_allreduce.argtypes = [
             ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_int]
         lib.hvd_ring_allreduce.restype = ctypes.c_int
+        # Round 10: wire-compressed allreduce (trailing wire-dtype code +
+        # int8 error-feedback residual out-buffer) and the chunk/stat
+        # surface for the autotuner and metrics mirroring.
+        lib.hvd_ring_allreduce_wire.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p]
+        lib.hvd_ring_allreduce_wire.restype = ctypes.c_int
+        lib.hvd_ring_set_chunk_bytes.argtypes = [ctypes.c_long]
+        lib.hvd_ring_set_chunk_bytes.restype = None
+        lib.hvd_ring_get_chunk_bytes.argtypes = []
+        lib.hvd_ring_get_chunk_bytes.restype = ctypes.c_long
+        lib.hvd_ring_get_wire_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_double)]
+        lib.hvd_ring_get_wire_stats.restype = None
         lib.hvd_ring_allgather.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_long), ctypes.c_void_p,
             ctypes.c_int]
@@ -167,6 +233,10 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
             ctypes.c_int]
         lib.hvd_ringh_allreduce.restype = ctypes.c_int
+        lib.hvd_ringh_allreduce_wire.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+        lib.hvd_ringh_allreduce_wire.restype = ctypes.c_int
         lib.hvd_ringh_allgather.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.POINTER(ctypes.c_long),
             ctypes.c_void_p, ctypes.c_int]
@@ -183,12 +253,12 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_double,
             ctypes.c_longlong, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-            ctypes.c_double, ctypes.c_char_p, ctypes.c_int]
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
         lib.hvd_eng_init.restype = ctypes.c_int
         lib.hvd_eng_enqueue.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int]
+            ctypes.c_int, ctypes.c_void_p]
         lib.hvd_eng_enqueue.restype = ctypes.c_longlong
         lib.hvd_eng_poll.argtypes = [ctypes.c_longlong]
         lib.hvd_eng_poll.restype = ctypes.c_int
@@ -258,14 +328,25 @@ class RingBackend:
     def dtype_code(dtype) -> Optional[int]:
         return _DTYPE_CODES.get(str(dtype))
 
-    def allreduce_(self, array: np.ndarray, average: bool) -> np.ndarray:
-        """In-place sum (or mean) across ranks."""
+    def allreduce_(self, array: np.ndarray, average: bool,
+                   wire_dtype: int = 0,
+                   residual: Optional[np.ndarray] = None) -> np.ndarray:
+        """In-place sum (or mean) across ranks. ``wire_dtype`` is a
+        WIRE_DTYPE_CODES code compressing f32 payloads on the wire (0
+        keeps the stream byte-identical to the pre-round-10 ring);
+        ``residual`` (f32, same element count, C-contiguous) receives the
+        int8 error-feedback residual."""
         code = self.dtype_code(array.dtype)
         assert code is not None, f"unsupported dtype {array.dtype}"
         assert array.flags.c_contiguous
-        rc = self._lib.hvd_ringh_allreduce(
+        res_ptr = None
+        if residual is not None:
+            assert residual.dtype == np.float32 and \
+                residual.size == array.size and residual.flags.c_contiguous
+            res_ptr = residual.ctypes.data_as(ctypes.c_void_p)
+        rc = self._lib.hvd_ringh_allreduce_wire(
             self._handle, array.ctypes.data_as(ctypes.c_void_p), array.size,
-            code, 1 if average else 0)
+            code, 1 if average else 0, int(wire_dtype), res_ptr)
         if rc != 0:
             raise RuntimeError(f"ring allreduce failed: {self._last_error()}")
         return array

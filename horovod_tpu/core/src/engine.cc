@@ -59,6 +59,8 @@ void hvd_ring_set_progress_sink(void* addr);
 int hvd_ring_init(int rank, int size, const char* addrs, const uint8_t* secret,
                   int secret_len);
 int hvd_ring_allreduce(void* buf, long count, int dtype, int average);
+int hvd_ring_allreduce_wire(void* buf, long count, int dtype, int average,
+                            int wire_dtype, void* residual);
 int hvd_ring_allgather(const void* in, const long* counts, void* out,
                        int dtype);
 int hvd_ring_broadcast(void* buf, long count, int dtype, int root);
@@ -159,6 +161,11 @@ struct Entry {
   uint8_t* user = nullptr;
   size_t nbytes = 0;
   long long handle = -1;
+  // int8 wire error-feedback out-buffer (f32 x element count, caller-owned
+  // and pinned like `user`; nullable). The ring writes the quantization
+  // error of this tensor's bytes here; controller/native.py carries it
+  // into the next allreduce.
+  float* residual = nullptr;
 };
 
 struct Tick {
@@ -240,7 +247,7 @@ class Engine {
   Engine(int rank, int size, double cycle_ms, long long fusion_threshold,
          int cache_capacity, bool stall_disable, double stall_warn_s,
          double stall_shutdown_s, const std::string& timeline_path,
-         bool timeline_mark_cycles)
+         bool timeline_mark_cycles, int wire_dtype)
       : rank_(rank),
         size_(size),
         cycle_ms_(cycle_ms),
@@ -248,6 +255,7 @@ class Engine {
         stall_disable_(stall_disable),
         stall_warn_s_(stall_warn_s),
         stall_shutdown_s_(stall_shutdown_s),
+        wire_dtype_(wire_dtype),
         cache_(cache_capacity),
         hier_(g_hier) {
     if (!timeline_path.empty() && rank == 0)
@@ -267,11 +275,12 @@ class Engine {
   // Returns handle >= 0; -2 duplicate name; -3 shut down.
   long long enqueue(uint8_t op, const std::string& name, void* data,
                     const int64_t* shape, int ndim, uint8_t dtype,
-                    int32_t root_rank) {
+                    int32_t root_rank, void* residual) {
     std::lock_guard<std::mutex> g(mu_);
     if (closed_ || shutdown_requested_) return -3;
     if (table_.count(name)) return -2;  // reference IncrementTensorCount dup
     Entry e;
+    e.residual = (float*)residual;
     e.request.request_rank = rank_;
     e.request.request_type = op;
     e.request.dtype = dtype;
@@ -847,11 +856,17 @@ class Engine {
       if (size_ > 1) {
         if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
           hier_ring_allreduce(e->user, (long)(total_bytes / esz), dtype);
-        } else if (hvd_ring_allreduce(e->user, (long)(total_bytes / esz),
-                                      dtype, 0) != 0) {
+          // Hierarchical plane is uncompressed: no error this round.
+          if (e->residual)
+            std::memset(e->residual, 0, (total_bytes / esz) * sizeof(float));
+        } else if (hvd_ring_allreduce_wire(e->user, (long)(total_bytes / esz),
+                                           dtype, 0, wire_dtype_,
+                                           e->residual) != 0) {
           throw EngineError(std::string("ring allreduce failed: ") +
                             hvd_ring_last_error());
         }
+      } else if (e->residual) {
+        std::memset(e->residual, 0, (total_bytes / esz) * sizeof(float));
       }
       if (timeline_) timeline_->activity_end(tname);
       complete_in_place(e);
@@ -879,13 +894,25 @@ class Engine {
       timeline_->activity_end(tname);
       timeline_->activity_start(tname, allreduce_activity());
     }
+    // Fused error feedback: the ring records quantization errors for the
+    // WHOLE fused buffer into a scratch; each entry's slice is copied out
+    // to its own residual after the reduce (entries without one simply
+    // drop their slice — uncompensated, like a residual-less caller).
+    bool any_residual = false;
+    for (Entry* e : entries) any_residual = any_residual || e->residual;
+    float* fused_residual = nullptr;
+    if (any_residual && dtype == 0 /* DT_F32 */) {
+      residual_scratch_.resize(total_bytes / esz);
+      fused_residual = residual_scratch_.data();
+    }
     if (size_ > 1) {
       if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
         hier_ring_allreduce(fusion_buffer_.data(),
                             (long)(total_bytes / esz), dtype);
-      } else if (hvd_ring_allreduce(fusion_buffer_.data(),
-                                    (long)(total_bytes / esz), dtype,
-                                    0) != 0) {
+      } else if (hvd_ring_allreduce_wire(fusion_buffer_.data(),
+                                         (long)(total_bytes / esz), dtype,
+                                         0, wire_dtype_,
+                                         fused_residual) != 0) {
         throw EngineError(std::string("ring allreduce failed: ") +
                           hvd_ring_last_error());
       }
@@ -899,6 +926,14 @@ class Engine {
     off = 0;
     for (Entry* e : entries) {
       std::memcpy(e->user, fusion_buffer_.data() + off, e->nbytes);
+      if (e->residual) {
+        if (fused_residual && size_ > 1 &&
+            !(hier_.allreduce && (hier_.local_ring || hier_.shm)))
+          std::memcpy(e->residual, fused_residual + off / esz,
+                      (e->nbytes / esz) * sizeof(float));
+        else
+          std::memset(e->residual, 0, (e->nbytes / esz) * sizeof(float));
+      }
       off += e->nbytes;
       complete_in_place(e);
     }
@@ -1036,6 +1071,12 @@ class Engine {
   std::atomic<long long> fusion_threshold_;
   bool stall_disable_;
   double stall_warn_s_, stall_shutdown_s_;
+  // Wire compression for the flat ring's allreduce data phases (WireDType
+  // code from HOROVOD_RING_WIRE_DTYPE via common/config.py; ring.cc only
+  // applies it to f32 payloads). The hierarchical local/cross planes stay
+  // uncompressed this round.
+  int wire_dtype_ = 0;
+  std::vector<float> residual_scratch_;  // fused-buffer EF staging
 
   std::mutex mu_;  // guards table_/queue_/handles_/bit_pending_/cache_/closed_
   std::condition_variable handle_cv_;
@@ -1091,7 +1132,7 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
                  long long fusion_threshold, int cache_capacity,
                  int stall_disable, double stall_warn_s,
                  double stall_shutdown_s, const char* timeline_path,
-                 int timeline_mark_cycles) {
+                 int timeline_mark_cycles, int wire_dtype) {
   std::lock_guard<std::mutex> g(hvd::g_engine_mu);
   if (hvd::g_engine && !hvd::g_engine->finished()) {
     hvd::g_last_error = "engine already initialized";
@@ -1209,20 +1250,21 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
   hvd::g_engine = new hvd::Engine(
       rank, size, cycle_ms, fusion_threshold, cache_capacity,
       stall_disable != 0, stall_warn_s, stall_shutdown_s,
-      timeline_path ? timeline_path : "", timeline_mark_cycles != 0);
+      timeline_path ? timeline_path : "", timeline_mark_cycles != 0,
+      wire_dtype);
   return 0;
 }
 
 long long hvd_eng_enqueue(int op, const char* name, void* data,
                           const long long* shape, int ndim, int dtype,
-                          int root_rank) {
+                          int root_rank, void* residual) {
   if (!hvd::g_engine) {
     hvd::g_last_error = "engine not initialized";
     return -1;
   }
   return hvd::g_engine->enqueue((uint8_t)op, name, data,
                                 (const int64_t*)shape, ndim, (uint8_t)dtype,
-                                root_rank);
+                                root_rank, residual);
 }
 
 int hvd_eng_poll(long long h) {

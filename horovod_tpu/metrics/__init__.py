@@ -150,8 +150,56 @@ def histogram(name: str, help: str = "", labelnames=(),
 
 
 def snapshot() -> Dict[str, dict]:
-    """This rank's registry as a plain dict (JSON/pickle-clean)."""
+    """This rank's registry as a plain dict (JSON/pickle-clean). Mirrors
+    the native ring's wire-traffic counters first, so scrapes and
+    piggybacked pushes always carry the current hvd_ring_* series."""
+    refresh_ring_wire_metrics()
     return _registry.snapshot()
+
+
+# Last-mirrored native ring wire counters (under _lock): the C side keeps
+# cumulative totals, the registry wants monotone increments.
+_ring_wire_seen: Dict[str, float] = {}
+
+
+def refresh_ring_wire_metrics() -> None:
+    """Mirror the native ring's wire-compression counters
+    (``hvd_ring_get_wire_stats``) into the registry:
+    ``hvd_ring_wire_bytes_total{dtype}`` (actual bytes the allreduce data
+    phases put on the wire, by wire dtype), ``hvd_ring_compress_seconds``
+    (cumulative compress/decompress kernel time) and
+    ``hvd_ring_chunk_bytes`` (the live transfer-chunk size). Never
+    triggers a native build: a process that hasn't loaded the core
+    observes nothing (and registers nothing)."""
+    if not on():
+        return
+    from ..core import bindings
+
+    if bindings.loaded() is None:
+        return
+    stats = bindings.wire_stats()
+    with _lock:
+        wire_c = counter(
+            "hvd_ring_wire_bytes_total",
+            "Bytes the native ring's allreduce data phases put on the "
+            "wire, by wire dtype", labelnames=("dtype",))
+        comp_c = counter(
+            "hvd_ring_compress_seconds",
+            "Cumulative time in the ring's wire compress/decompress "
+            "kernels")
+        for name, val in stats["tx_bytes"].items():
+            prev = _ring_wire_seen.get("tx." + name, 0.0)
+            if val > prev:
+                wire_c.labels(dtype=name).inc(val - prev)
+                _ring_wire_seen["tx." + name] = float(val)
+        comp = stats["compress_seconds"]
+        prev = _ring_wire_seen.get("compress_s", 0.0)
+        if comp > prev:
+            comp_c.inc(comp - prev)
+            _ring_wire_seen["compress_s"] = comp
+        gauge("hvd_ring_chunk_bytes",
+              "Live ring transfer-chunk size (pipelining granularity)"
+              ).set(stats["chunk_bytes"])
 
 
 def _local_rank() -> Optional[int]:
@@ -277,10 +325,30 @@ def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
     cycle = snap.get("hvd_controller_cycle_seconds")
     p50 = quantile(cycle, 0.5) or 0.0
     p99 = quantile(cycle, 0.99) or 0.0
+    # Wire-compression savings straight from the native ring's counters
+    # (zeros when the core isn't loaded or the ring never moved bytes):
+    # logical = the f32-equivalent bytes the compressed dtypes carried,
+    # savings = the fraction of those bytes compression kept off the wire.
+    try:
+        from ..core import bindings
+
+        wire = bindings.wire_stats()
+    except ImportError:  # stripped install; health must stay well-formed
+        wire = {"tx_bytes": {}, "logical_bytes": {},
+                "compress_seconds": 0.0, "chunk_bytes": 0}
+    tx = wire["tx_bytes"]
+    logical = wire["logical_bytes"]
+    comp_logical = sum(v for k, v in logical.items() if k != "none")
+    comp_tx = sum(v for k, v in tx.items() if k != "none")
+    savings = (round(1.0 - comp_tx / comp_logical, 4)
+               if comp_logical else 0.0)
     return {
         "cycle_seconds_p50": round(p50, 6),
         "cycle_seconds_p99": round(p99, 6),
         "fused_bytes_total": _counter_total(
             snap, "hvd_controller_fused_bytes_total") or 0,
         "cache_hit_rate": hit_rate,
+        "wire_bytes_total": sum(tx.values()),
+        "wire_savings_frac": savings,
+        "wire_compress_seconds": round(wire["compress_seconds"], 6),
     }

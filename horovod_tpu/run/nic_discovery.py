@@ -36,6 +36,38 @@ from .task_fn import (  # noqa: F401  (re-exported shared implementation)
 )
 
 
+def infer_link_class(ring_addrs) -> str:
+    """Classify the flat ring's link from its launcher-exported addresses
+    (``HOROVOD_RING_ADDRS``: comma-separated host:port per rank): every
+    host loopback -> ``local`` (same-box job, no real NIC on the path);
+    anything else -> ``tcp``. DCN/ICI fabrics cannot be told apart from
+    plain ethernet by address alone — operators (or a launcher that
+    learned it from the probe report) export HOROVOD_RING_LINK_CLASS
+    explicitly. Keys the per-link-class chunk table in
+    ``common.config.RING_CHUNK_BYTES_BY_LINK``."""
+    if not ring_addrs:
+        return "local"
+    hosts = set()
+    for addr in str(ring_addrs).split(","):
+        host = addr.rsplit(":", 1)[0].strip().lower()
+        if host:
+            hosts.add(host)
+    local_names = {"127.0.0.1", "localhost", "::1", "0.0.0.0"}
+    if hosts <= local_names:
+        return "local"
+    # One distinct non-loopback host still means every hop is same-box.
+    if len(hosts - local_names) == 1:
+        try:
+            own = set()
+            for _, ip in list_interfaces():
+                own.add(ip.lower())
+            if hosts - local_names <= own:
+                return "local"
+        except OSError:
+            pass
+    return "tcp"
+
+
 class NICDriverService:
     """Rendezvous for the probe tasks. One instance per launch; threads
     serve each task connection."""

@@ -53,8 +53,13 @@ using ::tensorflow::errors::Unknown;
 // Core-engine C ABI, resolved at runtime (see module docstring).
 
 struct CoreApi {
+  // Trailing void* is the round-10 int8 error-feedback residual slot;
+  // the TF tier never compensates (no per-tensor residual store here),
+  // so it always passes nullptr — but the POINTER TYPE must match the
+  // core's 8-arg ABI or the callee reads a garbage residual off the
+  // stack.
   long long (*enqueue)(int, const char*, void*, const long long*, int, int,
-                       int) = nullptr;
+                       int, void*) = nullptr;
   int (*wait)(long long) = nullptr;
   int (*result_ndim)(long long) = nullptr;
   void (*result_shape)(long long, long long*) = nullptr;
@@ -218,8 +223,8 @@ long long EnqueueOrFail(OpKernelContext* ctx,
   int ndim = shaped_like.dims();
   std::vector<long long> dims(std::max(ndim, 1), 0);
   for (int i = 0; i < ndim; i++) dims[i] = shaped_like.dim_size(i);
-  long long h =
-      api->enqueue(op, name.c_str(), data, dims.data(), ndim, code, root_rank);
+  long long h = api->enqueue(op, name.c_str(), data, dims.data(), ndim, code,
+                             root_rank, nullptr);
   if (h == -2) {
     ctx->SetStatus(InvalidArgument(
         "Duplicate tensor name '", name,

@@ -138,6 +138,16 @@ class SyncPlacement:
     payload_bytes: int
     compute_after: int      # compute ops scheduled after this collective
     compute_after_frac: float
+    # The op_name from the instruction's metadata (empty when absent):
+    # jax named_scopes survive here, so hvd's own collectives carry the
+    # "hvd.allreduce.<name>/psum" marker — the ground truth for "is this
+    # gradient traffic" that no byte-size heuristic can give (a 128-byte
+    # bias gradient and a 128-byte loss counter are indistinguishable by
+    # size alone; see scaling_model.groups_from_overlap_report).
+    op_name: str = ""
+
+
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
 
 
 def sync_collective_placement(sched: List[ScheduledOp]) -> List[SyncPlacement]:
@@ -148,10 +158,12 @@ def sync_collective_placement(sched: List[ScheduledOp]) -> List[SyncPlacement]:
         if o.opcode not in COLLECTIVE_OPCODES:
             continue
         after = sum(1 for c in compute_idx if c > o.index)
+        name_m = _OP_NAME_RE.search(o.line)
         out.append(SyncPlacement(o.opcode, o.index,
                                  o.index / max(1, len(sched)),
                                  _payload_bytes(o), after,
-                                 after / n_compute))
+                                 after / n_compute,
+                                 name_m.group(1) if name_m else ""))
     return out
 
 

@@ -114,14 +114,28 @@ def multislice_efficiency(step_time_s: float, groups: Sequence[GradGroup],
     return step_time_s / (t_ici + t_dcn)
 
 
+# The named_scope marker hvd's collective wrappers plant
+# (ops/collective_ops.py); it survives compilation as HLO op_name
+# metadata, so a compiled schedule says which all-reduces are OURS.
+GRADIENT_MARKER = "hvd.allreduce"
+
+
 def groups_from_overlap_report(report: dict,
                                min_bytes: int = 1 << 16) -> List[GradGroup]:
     """The sync-collective placements of a compiled DP step, as model
-    inputs. Small control collectives (loss psum, counters) are dropped:
-    they are not gradient traffic."""
+    inputs. An all-reduce whose op_name carries hvd's own scope marker is
+    gradient traffic by construction, whatever its size — jax versions
+    that emit one all-reduce per PARAMETER would otherwise lose every
+    small leaf (a 128-byte bias) to the size filter. Unmarked collectives
+    (older artifacts predate the op_name field; synthetic schedules have
+    no metadata) fall back to the size heuristic: small control
+    collectives (loss psum, counters) are not gradient traffic."""
     out = []
     for s in report["sync_collectives"]:
-        if s["opcode"] != "all-reduce" or s["payload_bytes"] < min_bytes:
+        if s["opcode"] != "all-reduce":
+            continue
+        marked = GRADIENT_MARKER in s.get("op_name", "")
+        if not marked and s["payload_bytes"] < min_bytes:
             continue
         out.append(GradGroup(s["payload_bytes"], s["compute_after_frac"]))
     return out

@@ -1263,6 +1263,22 @@ def scenario_inplace(rank, size):
     np.testing.assert_allclose(tnc.numpy(), want_nc, rtol=1e-6)
 
 
+def scenario_wire_exact(rank, size):
+    # Wire-compression plumbing proof, engine-agnostic: constant inputs
+    # whose every partial sum is exactly representable in bf16/fp16, so a
+    # compressed wire (HOROVOD_RING_WIRE_DTYPE from the parent) must
+    # produce EXACT results — any quantization slip shows as inequality.
+    # 300k elements spans several transfer chunks.
+    x = np.full(300_000, float(rank + 1), np.float32)
+    tot = np.asarray(hvd.allreduce(x, average=False, name="wire.exact"))
+    want = float(sum(range(1, size + 1)))
+    np.testing.assert_array_equal(tot, np.full(300_000, want, np.float32))
+    # Second round reuses the same name: pending-name uniqueness was
+    # released, and wire scratch buffers are steady-state.
+    tot2 = np.asarray(hvd.allreduce(x, average=False, name="wire.exact"))
+    np.testing.assert_array_equal(tot2, tot)
+
+
 def scenario_copybench(rank, size):
     # Micro-bench: unfused large-buffer allreduce, value path (1 defensive
     # copy) vs in-place path (0 copies). Prints bytes/sec for the parent
@@ -1351,6 +1367,7 @@ SCENARIOS = {
     "shmgather": scenario_shmgather,
     "objects": scenario_objects,
     "reducescatter_alltoall": scenario_reducescatter_alltoall,
+    "wire_exact": scenario_wire_exact,
     "copybench": scenario_copybench,
     "shmbench": scenario_shmbench,
     "hierarchical": scenario_hierarchical,

@@ -356,3 +356,88 @@ def test_tuning_completes_and_pins_best():
     for _ in range(100):
         assert pm.record(nbytes=1 << 20, seconds=0.005) is None
     assert pm.fusion_threshold == last[0]
+
+
+def test_ring_chunk_knob_joins_search_and_stays_in_bounds():
+    """Round 10: with an initial chunk the BO box grows a third dimension
+    (log2 chunk bytes in [16, 21]); every proposed chunk stays in
+    [64 KiB, 2 MiB] and the search actually moves the knob."""
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          ring_chunk_bytes=256 << 10, seed=1)
+    assert pm.ring_chunk_bytes == 256 << 10
+    seen = set()
+    for _ in range(400):
+        out = pm.record(nbytes=1 << 20, seconds=0.005)
+        if out is not None:
+            assert (64 << 10) <= pm.ring_chunk_bytes <= (2 << 20) + 1
+            seen.add(pm.ring_chunk_bytes)
+        if not pm.tunable:
+            break
+    assert len(seen) > 1, "chunk knob never moved"
+    # Completion pins the best-seen chunk alongside the other knobs.
+    assert not pm.tunable
+    assert pm.ring_chunk_bytes == pm.best_ring_chunk_bytes
+    st = pm.state()
+    assert st["ring_chunk_bytes"] == pm.ring_chunk_bytes
+    assert st["best_ring_chunk_bytes"] == pm.best_ring_chunk_bytes
+
+
+def test_ring_chunk_absent_keeps_legacy_2d_search():
+    """No initial chunk (jobs without the native ring) -> the original
+    2-D search, chunk fields None, bit-compatible with round-11 state."""
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          seed=0)
+    assert pm.ring_chunk_bytes is None
+    for _ in range(60):
+        pm.record(nbytes=1 << 20, seconds=0.005)
+    assert pm.ring_chunk_bytes is None
+    st = pm.state()
+    assert st["ring_chunk_bytes"] is None
+    assert st["best_ring_chunk_bytes"] is None
+
+
+def test_ring_chunk_env_pins_knob(monkeypatch):
+    """HOROVOD_RING_CHUNK_BYTES fixes the knob exactly like every other
+    env-provided value (reference fixed= semantics); without the env the
+    native controller's tune_ring_chunk=True seeds it from the resolved
+    link-class default."""
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.controller.autotune_glue import make_parameter_manager
+
+    monkeypatch.setenv("HOROVOD_RING_CHUNK_BYTES", str(512 << 10))
+    pm = make_parameter_manager(Config.from_env(), tune_ring_chunk=True)
+    assert "ring_chunk" in pm.fixed
+    assert pm.ring_chunk_bytes == 512 << 10
+    for _ in range(200):
+        pm.record(nbytes=1 << 20, seconds=0.005)
+    assert pm.ring_chunk_bytes == 512 << 10  # pinned, never retuned
+
+    monkeypatch.delenv("HOROVOD_RING_CHUNK_BYTES")
+    pm2 = make_parameter_manager(Config.from_env(), tune_ring_chunk=True)
+    assert "ring_chunk" not in pm2.fixed
+    assert pm2.ring_chunk_bytes > 0
+
+    # PRESENT-but-auto (0/empty = the documented join-the-search
+    # sentinel) must NOT pin: fixing keys on the parsed value, not on
+    # env-var membership.
+    monkeypatch.setenv("HOROVOD_RING_CHUNK_BYTES", "0")
+    pm3 = make_parameter_manager(Config.from_env(), tune_ring_chunk=True)
+    assert "ring_chunk" not in pm3.fixed
+    assert pm3.ring_chunk_bytes > 0  # seeded from the link-class default
+
+
+def test_ring_chunk_csv_column(tmp_path):
+    """The per-step CSV grows a ring_chunk_bytes column exactly when the
+    knob is live, named in the self-describing header."""
+    log = tmp_path / "tune.csv"
+    pm = ParameterManager(fusion_threshold=64 << 20, cycle_time_ms=5.0,
+                          ring_chunk_bytes=256 << 10, log_path=str(log),
+                          seed=2)
+    for _ in range(40):
+        pm.record(nbytes=1 << 20, seconds=0.005)
+    lines = log.read_text().strip().splitlines()
+    header = lines[0].split(",")
+    assert "ring_chunk_bytes" in header
+    idx = header.index("ring_chunk_bytes")
+    for row in lines[1:3]:
+        assert int(row.split(",")[idx]) >= 64 << 10

@@ -91,6 +91,9 @@ def test_known_series_present():
         "hvd_membership_transitions_total",
         "hvd_membership_rank_departures_total",
         "hvd_elastic_reshape_seconds",
+        "hvd_ring_wire_bytes_total",
+        "hvd_ring_compress_seconds",
+        "hvd_ring_chunk_bytes",
         "hvd_autotune_active",
         "hvd_autotune_steps_completed",
         "hvd_autotune_steps_remaining",

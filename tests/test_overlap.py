@@ -102,6 +102,36 @@ def test_parser_live_cpu_compile():
     assert report["n_compute_ops"] > 0
 
 
+def test_gradient_marker_overrides_size_filter():
+    """An all-reduce whose op_name metadata carries hvd's own scope
+    marker is gradient traffic whatever its size (per-parameter psums on
+    newer jax emit a tiny all-reduce per bias); unmarked small
+    collectives still drop to the size filter."""
+    text = """\
+HloModule m, is_scheduled=true
+
+ENTRY %main (p0: f32[32,32]) -> f32[] {
+  %param.0 = f32[32,32]{1,0} parameter(0)
+  %fusion.1 = f32[32,32]{1,0} fusion(%param.0), kind=kLoop
+  %all-reduce.1 = f32[32,32]{1,0} all-reduce(%fusion.1), channel_id=1, replica_groups={{0}}, to_apply=%sum, metadata={op_name="jit(step)/hvd.allreduce.DistributedOptimizer.1/psum" source_file="x"}
+  %all-reduce.2 = f32[32]{0} all-reduce(%fusion.1), channel_id=2, replica_groups={{0}}, to_apply=%sum, metadata={op_name="jit(step)/hvd.allreduce.DistributedOptimizer.0/psum" source_file="x"}
+  ROOT %all-reduce.3 = f32[]{} all-reduce(%fusion.1), channel_id=3, replica_groups={{0}}, to_apply=%sum, metadata={op_name="jit(step)/loss/psum" source_file="x"}
+}
+"""
+    report = ov.overlap_report(text)
+    names = [s["op_name"] for s in report["sync_collectives"]]
+    assert sum("hvd.allreduce" in n for n in names) == 2
+    groups = sm.groups_from_overlap_report(report, min_bytes=1024)
+    # Marked 32x32 and 32-element gradients survive; the unmarked scalar
+    # loss psum drops to the size filter.
+    assert sorted(g.payload_bytes for g in groups) == [32 * 4, 32 * 32 * 4]
+    # Artifacts written before the op_name field behave as before.
+    for s in report["sync_collectives"]:
+        del s["op_name"]
+    legacy = sm.groups_from_overlap_report(report, min_bytes=1024)
+    assert [g.payload_bytes for g in legacy] == [32 * 32 * 4]
+
+
 def test_event_model_hand_cases():
     t = 0.1
     g_end = [sm.GradGroup(100_000_000, 0.0)]   # ready at end of compute

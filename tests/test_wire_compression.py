@@ -1,0 +1,488 @@
+"""Wire-level compression on the native ring (round 10, ROADMAP item 4).
+
+Four contracts, each against a REAL multi-process TCP ring:
+
+* bf16/fp16 wire paths equal a numpy-simulated cast-reduce-cast reference
+  BITWISE on 2- and 3-rank rings (both converters are RNE, the schedule
+  is deterministic, so exact equality is the right assertion) — and every
+  rank ends with identical bytes (the owner ships exactly what it keeps).
+* int8-EF: the residual returned by the ring is the exact quantization
+  error this rank introduced, and carrying it into the next allreduce
+  makes the time-average of a repeated constant-gradient allreduce
+  converge to the exact mean (the error-feedback telescoping contract,
+  docs/wire-compression.md) — asserted both at the RingBackend level and
+  end-to-end through the native engine + controller residual plumbing.
+* default path byte-identity: wire dtype 0 through the new entry point,
+  the legacy hvd_ringh_allreduce entry point, and a numpy transcript of
+  the pristine ring's deterministic reduction order all agree bitwise.
+* ABI freshness: rebuild the native core from current sources and assert
+  the new wire functions exist with C signatures whose arg counts match
+  the ctypes declarations in bindings.py.
+"""
+
+import hashlib
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import ml_dtypes
+import numpy as np
+import pytest
+
+from horovod_tpu.core import bindings
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+QUANT_BLOCK = 4096  # must match kQuantBlock in ring.cc
+
+pytestmark = pytest.mark.skipif(
+    bindings.load() is None, reason="native core unavailable (no toolchain)")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_ring_job(scenario, size, extra_env=None, timeout=180.0):
+    """Spawn ``size`` ranks of this file's __main__ scenarios over a real
+    TCP ring; returns each rank's RESULT json."""
+    addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(size))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), scenario, str(rank),
+         str(size), addrs],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for rank in range(size)]
+    outs = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise AssertionError(f"{scenario}: rank {rank} hung")
+        outs.append(out)
+    for rank, (proc, out) in enumerate(zip(procs, outs)):
+        assert proc.returncode == 0, (
+            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
+            f"{out}")
+    results = []
+    for out in outs:
+        payload = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                payload = json.loads(line[len("RESULT "):])
+        assert payload is not None, f"{scenario}: no RESULT in:\n{out}"
+        results.append(payload)
+    return results
+
+
+# --------------------------------------------------------------- reference
+
+def _rank_input(rank, count):
+    return np.random.RandomState(1000 + rank).randn(count).astype(np.float32)
+
+
+def _int8_roundtrip(a):
+    """quantize+dequantize exactly like ring.cc wire_compress WIRE_I8:
+    per 4096-element block anchored at the segment start, f32 scale
+    amax/127, RNE quantize with clamp, f32 dequant."""
+    out = np.empty_like(a)
+    for b in range(0, a.size, QUANT_BLOCK):
+        blk = a[b:b + QUANT_BLOCK]
+        amax = np.float32(np.max(np.abs(blk))) if blk.size else np.float32(0)
+        scale = np.float32(amax / np.float32(127.0))
+        if scale == 0:
+            out[b:b + QUANT_BLOCK] = 0
+            continue
+        inv = np.float32(np.float32(1.0) / scale)
+        v = np.clip(blk * inv, np.float32(-127.0), np.float32(127.0))
+        q = np.rint(v).astype(np.int8)
+        out[b:b + QUANT_BLOCK] = q.astype(np.float32) * scale
+    return out
+
+
+def _wire_roundtrip(a, wire):
+    if wire == "bf16":
+        return a.astype(ml_dtypes.bfloat16).astype(np.float32)
+    if wire == "fp16":
+        return a.astype(np.float16).astype(np.float32)
+    if wire == "int8":
+        return _int8_roundtrip(a)
+    return a
+
+
+def _simulate_ring(xs, wire):
+    """Numpy transcript of ring.cc's schedule: segment s starts at rank s
+    (step-0 sender), each hop adds the receiver's contribution to the
+    wire-roundtripped partial in f32, and the final owner quantizes once
+    more before the (verbatim-relay) allgather."""
+    size = len(xs)
+    count = xs[0].size
+    base_len, rem = divmod(count, size)
+
+    def seg(s):
+        off = s * base_len + min(s, rem)
+        return slice(off, off + base_len + (1 if s < rem else 0))
+
+    out = np.empty(count, np.float32)
+    for s in range(size):
+        v = xs[s][seg(s)].copy()
+        for t in range(1, size):
+            v = xs[(s + t) % size][seg(s)] + _wire_roundtrip(v, wire)
+        out[seg(s)] = _wire_roundtrip(v, wire)
+    return out
+
+
+# ------------------------------------------------------------------- tests
+
+@pytest.mark.parametrize("size", [2, 3])
+def test_wire_paths_match_reference_bitwise(size):
+    # 50021 elements: uneven segments AND a partial int8 quant block.
+    count = 50021
+    results = _run_ring_job("wire_result", size,
+                            extra_env={"HVD_TEST_COUNT": str(count)})
+    xs = [_rank_input(r, count) for r in range(size)]
+    for wire in ("none", "bf16", "fp16", "int8"):
+        expect = _simulate_ring(xs, wire)
+        want = hashlib.sha256(expect.tobytes()).hexdigest()
+        for rank, res in enumerate(results):
+            assert res[wire] == want, (
+                f"{wire} rank {rank}: ring result != numpy-simulated "
+                f"cast-reduce-cast reference")
+    # All ranks bit-identical is implied by matching one reference hash.
+
+
+def test_default_path_byte_identity_two_entry_points():
+    """Wire dtype 0 through hvd_ringh_allreduce_wire, the legacy
+    hvd_ringh_allreduce, and the pristine-ring numpy transcript agree
+    bitwise — HOROVOD_RING_WIRE_DTYPE unset is today's ring exactly."""
+    count = 50021
+    results = _run_ring_job("wire_result", 2,
+                            extra_env={"HVD_TEST_COUNT": str(count)})
+    xs = [_rank_input(r, count) for r in range(2)]
+    pristine = hashlib.sha256(
+        _simulate_ring(xs, "none").tobytes()).hexdigest()
+    for res in results:
+        assert res["none"] == pristine
+        assert res["legacy_entry"] == pristine
+
+
+def test_int8_error_feedback_converges_to_exact_mean():
+    results = _run_ring_job("wire_ef", 2)
+    for res in results:
+        # The carried residual makes the T-step average of a repeated
+        # constant-gradient allreduce telescope to the exact mean:
+        # error after T steps ~ initial quantization error / T.
+        assert res["ef_rel_err"] < 3.0 * res["single_rel_err"] / res["T"], (
+            res)
+        # Without feedback the quantization bias is constant: no decay.
+        assert res["noef_rel_err"] > 10 * res["ef_rel_err"], res
+        # The residual really is x - dequant(quant(x)) of the bytes sent:
+        # it is bounded by half a quant step of the largest block.
+        assert res["residual_max"] <= res["quant_step_bound"], res
+
+
+def test_native_engine_ef_end_to_end():
+    """int8 EF through the full stack: HOROVOD_RING_WIRE_DTYPE=int8 ->
+    NativeController -> engine enqueue residual plumbing -> ring. Also
+    proves the wire savings surface in hvd.metrics.controller_health()."""
+    results = _run_ring_job(
+        "native_ef", 2,
+        extra_env={"HOROVOD_RING_WIRE_DTYPE": "int8",
+                   "HOROVOD_CYCLE_TIME": "1"})
+    for res in results:
+        assert res["avg_rel_err"] < 0.3 * res["single_rel_err"], res
+        # int8 wire quarters the f32 bytes (+ ~0.1% scale headers).
+        assert res["wire_savings_frac"] > 0.7, res
+        assert res["wire_bytes_total"] > 0, res
+        assert res["dup_rejected"], res
+        assert res["dup_untouched"], res
+        assert res["drop_completed"], res
+        assert res["drop_ef_resumed"], res
+
+
+def test_residual_zeroed_when_no_quantization():
+    """A residual buffer handed to a non-quantizing call (bf16 wire, or
+    wire none) must come back zeroed — stale error must never leak into
+    the next round."""
+    results = _run_ring_job("wire_residual_zero", 2)
+    for res in results:
+        assert res["bf16_residual_max"] == 0.0
+        assert res["none_residual_max"] == 0.0
+
+
+def test_single_rank_ring_zeroes_residual():
+    ring = bindings.RingBackend(0, 1, f"127.0.0.1:{_free_port()}", b"solo")
+    try:
+        x = np.ones(QUANT_BLOCK + 5, np.float32)
+        res = np.full(x.size, 9.0, np.float32)
+        ring.allreduce_(x, False, wire_dtype=3, residual=res)
+        assert np.all(res == 0.0)
+        np.testing.assert_array_equal(x, np.ones(x.size, np.float32))
+    finally:
+        ring.shutdown()
+
+
+def test_chunk_bytes_setter_clamps_and_rounds():
+    lib = bindings.load()
+    lib.hvd_ring_set_chunk_bytes(1)
+    assert lib.hvd_ring_get_chunk_bytes() == 16 * 1024  # floor
+    lib.hvd_ring_set_chunk_bytes(300 * 1024 + 3)
+    assert lib.hvd_ring_get_chunk_bytes() % 8 == 0  # element-aligned
+    lib.hvd_ring_set_chunk_bytes(1 << 40)
+    assert lib.hvd_ring_get_chunk_bytes() == 64 * 1024 * 1024  # ceil
+    lib.hvd_ring_set_chunk_bytes(256 * 1024)  # restore default
+    assert bindings.wire_stats()["chunk_bytes"] == 256 * 1024
+
+
+def _run_engine_job(scenario, size, extra_env, timeout=120.0):
+    """Full-stack job (mp_worker scenarios) with the ring data plane:
+    rendezvous star + HOROVOD_RING_ADDRS, engine picked by extra_env."""
+    addr = f"127.0.0.1:{_free_port()}"
+    ring_addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(size))
+    procs = []
+    for rank in range(size):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": str(size),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(size),
+            "HOROVOD_CONTROLLER_ADDR": addr,
+            "HOROVOD_RING_ADDRS": ring_addrs,
+        })
+        env.update(extra_env)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "mp_worker.py"), scenario],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise AssertionError(f"{scenario}: rank {rank} hung")
+        assert proc.returncode == 0, (
+            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
+            f"{out}")
+
+
+@pytest.mark.parametrize("engine,wire", [
+    ("native", "bf16"), ("native", "fp16"), ("python", "bf16")])
+def test_wire_exact_through_full_stack(engine, wire):
+    """HOROVOD_RING_WIRE_DTYPE through hvd.init + controller + engine on
+    exactly-representable values: compressed wire, exact results."""
+    _run_engine_job("wire_exact", 2, {
+        "HOROVOD_ENGINE": engine,
+        "HOROVOD_RING_WIRE_DTYPE": wire,
+    })
+
+
+def test_python_engine_int8_downgrades_loudly():
+    """int8 under the Python engine keeps the uncompressed wire (EF lives
+    in the native controller) and says so once; results stay exact."""
+    _run_engine_job("wire_exact", 2, {
+        "HOROVOD_ENGINE": "python",
+        "HOROVOD_RING_WIRE_DTYPE": "int8",
+    })
+
+
+# ----------------------------------------------------------- ABI freshness
+
+def _c_arg_count(source, func):
+    m = re.search(re.escape(func) + r"\s*\(([^)]*)\)", source, re.DOTALL)
+    assert m, f"{func} not found in native sources"
+    args = m.group(1).strip()
+    return 0 if not args else args.count(",") + 1
+
+
+def test_build_freshness_and_abi_matches_bindings():
+    """Recompile the native core from the CURRENT sources (build() is
+    mtime-cached: stale .so -> real g++ run) and assert the wire ABI —
+    the new wire-dtype/residual args included — matches what bindings.py
+    declares, by symbol presence and by C-source arg count vs ctypes
+    argtypes length. Catches the classic drift: editing ring.cc/engine.cc
+    without updating the ctypes layer (or vice versa)."""
+    path = bindings.build()  # recompiles iff any .cc/.h is newer
+    assert os.path.exists(path)
+    lib = bindings.load()
+    src = ""
+    src_dir = os.path.join(REPO, "horovod_tpu", "core", "src")
+    for fname in sorted(os.listdir(src_dir)):
+        if fname.endswith((".cc", ".h")):
+            with open(os.path.join(src_dir, fname)) as f:
+                src += f.read()
+    for func in ("hvd_ring_allreduce_wire", "hvd_ringh_allreduce_wire",
+                 "hvd_eng_init", "hvd_eng_enqueue",
+                 "hvd_ring_get_wire_stats"):
+        assert hasattr(lib, func)
+        declared = len(getattr(lib, func).argtypes)
+        in_source = _c_arg_count(src, func)
+        assert declared == in_source, (
+            f"{func}: bindings.py declares {declared} args, native source "
+            f"defines {in_source} — the ctypes ABI drifted")
+    # The wire-dtype arg specifically: hvd_eng_init grew to 14 args and
+    # enqueue to 8 in round 10.
+    assert len(lib.hvd_eng_init.argtypes) == 14
+    assert len(lib.hvd_eng_enqueue.argtypes) == 8
+
+
+# ------------------------------------------------------------ child ranks
+
+def _child_wire_result(rank, size, addrs):
+    count = int(os.environ.get("HVD_TEST_COUNT", "50021"))
+    ring = bindings.RingBackend(rank, size, addrs, b"wire-test")
+    lib = bindings.load()
+    bindings.set_chunk_bytes(64 * 1024)  # several chunks per segment
+    x = _rank_input(rank, count)
+    out = {}
+    for wire, code in sorted(bindings.WIRE_DTYPE_CODES.items()):
+        buf = x.copy()
+        residual = np.zeros(count, np.float32) if wire == "int8" else None
+        ring.allreduce_(buf, False, wire_dtype=code, residual=residual)
+        out[wire] = hashlib.sha256(buf.tobytes()).hexdigest()
+    # Legacy entry point (no wire args at all).
+    buf = x.copy()
+    import ctypes
+
+    rc = lib.hvd_ringh_allreduce(
+        ring._handle, buf.ctypes.data_as(ctypes.c_void_p), buf.size, 0, 0)
+    assert rc == 0
+    out["legacy_entry"] = hashlib.sha256(buf.tobytes()).hexdigest()
+    print("RESULT " + json.dumps(out), flush=True)
+    ring.shutdown()
+
+
+def _child_wire_ef(rank, size, addrs):
+    ring = bindings.RingBackend(rank, size, addrs, b"wire-test")
+    count = 3 * QUANT_BLOCK + 117
+    g = np.random.RandomState(42).randn(count).astype(np.float32)
+    T = 48
+
+    def run(feedback):
+        residual = np.zeros(count, np.float32)
+        acc = np.zeros(count, np.float64)
+        first = None
+        for _ in range(T):
+            x = g + residual if feedback else g.copy()
+            ring.allreduce_(x, False, wire_dtype=3, residual=residual)
+            y = x / size
+            if first is None:
+                first = float(np.abs(y - g).max() / np.abs(g).max())
+            acc += y
+        avg = acc / T
+        return float(np.abs(avg - g).max() / np.abs(g).max()), first, residual
+
+    ef_err, single_err, residual = run(True)
+    noef_err, _, _ = run(False)
+    # Bound on |residual|: half a quant step of the worst block this rank
+    # quantized; compensated inputs stay within ~2x of g's range.
+    step = 2.0 * float(np.abs(g).max()) / 127.0
+    print("RESULT " + json.dumps({
+        "T": T, "ef_rel_err": ef_err, "noef_rel_err": noef_err,
+        "single_rel_err": single_err,
+        "residual_max": float(np.abs(residual).max()),
+        "quant_step_bound": step,
+    }), flush=True)
+    ring.shutdown()
+
+
+def _child_native_ef(rank, size, addrs):
+    os.environ["HOROVOD_RING_ADDRS"] = addrs
+    from horovod_tpu import metrics
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.controller.native import NativeController
+
+    metrics.enable()
+    topo = Topology(rank=rank, size=size, local_rank=rank, local_size=size,
+                    cross_rank=0, cross_size=1)
+    ctl = NativeController(Config.from_env(), topo)
+    count = 2 * QUANT_BLOCK + 33
+    g = np.random.RandomState(7).randn(count).astype(np.float32)
+    T = 40
+    acc = np.zeros(count, np.float64)
+    single = None
+    for _ in range(T):
+        y = np.asarray(ctl.allreduce(g, average=True, name="ef.grad"))
+        if single is None:
+            single = float(np.abs(y - g).max() / np.abs(g).max())
+        acc += y
+    avg = acc / T
+    health = metrics.controller_health()
+    # Duplicate-name EF safety: while an op is in flight, a same-name
+    # in-place enqueue must be rejected WITHOUT compensating the caller's
+    # tensor or re-keying the residual the live op's ring thread writes.
+    big = np.random.RandomState(9).randn(2_000_000).astype(np.float32)
+    x2 = np.random.RandomState(11).randn(big.size).astype(np.float32)
+    x2_orig = x2.copy()
+    h1 = ctl.allreduce_async(big, average=True, name="ef.dup")
+    h2 = ctl.allreduce_async(x2, average=True, name="ef.dup", inplace=True)
+    dup_rejected = False
+    try:
+        h2.wait()
+    except RuntimeError as exc:
+        dup_rejected = "Duplicate" in str(exc)
+    h1.wait()
+    # Dropped-without-wait handle must not disable EF for its name
+    # forever: the engine frees the name at completion; the controller's
+    # in-flight mirror self-heals on the next same-name enqueue.
+    import time
+
+    h3 = ctl.allreduce_async(g, average=True, name="ef.drop")
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not h3.done():
+        time.sleep(0.01)
+    drop_completed = h3.done()
+    del h3  # never waited
+    ctl.allreduce(g, average=True, name="ef.drop")  # must not be rejected
+    print("RESULT " + json.dumps({
+        "drop_completed": drop_completed,
+        "drop_ef_resumed": "ef.drop" in ctl._residuals,
+        "avg_rel_err": float(np.abs(avg - g).max() / np.abs(g).max()),
+        "single_rel_err": single,
+        "wire_savings_frac": health["wire_savings_frac"],
+        "wire_bytes_total": health["wire_bytes_total"],
+        "dup_rejected": dup_rejected,
+        "dup_untouched": bool(np.array_equal(x2, x2_orig)),
+    }), flush=True)
+    ctl.shutdown()
+
+
+def _child_wire_residual_zero(rank, size, addrs):
+    ring = bindings.RingBackend(rank, size, addrs, b"wire-test")
+    x = np.random.RandomState(rank).randn(QUANT_BLOCK + 11).astype(
+        np.float32)
+    out = {}
+    for wire in ("bf16", "none"):
+        residual = np.full(x.size, 5.0, np.float32)
+        ring.allreduce_(x.copy(), False,
+                        wire_dtype=bindings.WIRE_DTYPE_CODES[wire],
+                        residual=residual)
+        out[f"{wire}_residual_max"] = float(np.abs(residual).max())
+    print("RESULT " + json.dumps(out), flush=True)
+    ring.shutdown()
+
+
+_CHILDREN = {
+    "wire_result": _child_wire_result,
+    "wire_ef": _child_wire_ef,
+    "native_ef": _child_native_ef,
+    "wire_residual_zero": _child_wire_residual_zero,
+}
+
+if __name__ == "__main__":
+    _scenario, _rank, _size, _addrs = sys.argv[1:5]
+    _CHILDREN[_scenario](int(_rank), int(_size), _addrs)
