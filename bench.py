@@ -308,6 +308,24 @@ FULL_ROWS = {
                  "--max-seq-len", "256",
                  "--out", "artifacts/serving_r9.json"],
         "json": True},
+    # Fleet + prefix-caching row (round 11): 10x the r9 request count in
+    # the shared-system-prompt shape (8 prefixes x unique tails) over a
+    # 3-replica router, arrivals under fleet capacity so TTFT measures
+    # prefill cost rather than queueing. The record's acceptance fields:
+    # warm TTFT p50 below cold, and blocks_live_peak below the in-record
+    # no-sharing baseline. The kill/join chaos proof lives in the @slow
+    # fleet tests (and `--chaos-kill` reproduces it by hand). Full
+    # record: artifacts/serving_r11.json.
+    "llama_serving_fleet_prefix_loadgen": {
+        "script": "examples/serving_loadgen.py",
+        "args": ["--model", "tiny", "--requests", "320", "--seed", "11",
+                 "--rate", "30", "--prefix-share", "8",
+                 "--prefix-len", "192", "--min-prompt", "200",
+                 "--max-prompt", "224", "--min-new", "16",
+                 "--max-new", "32", "--max-seq-len", "256",
+                 "--replicas", "3",
+                 "--out", "artifacts/serving_r11.json"],
+        "json": True},
 }
 
 

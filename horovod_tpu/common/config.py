@@ -372,6 +372,49 @@ def serving_max_seq_len() -> int:
     return max(0, _env_int("HOROVOD_SERVING_MAX_SEQ_LEN", 0))
 
 
+def serving_prefix_cache() -> bool:
+    """``HOROVOD_SERVING_PREFIX_CACHE``: copy-on-write prefix sharing on
+    the paged KV pool (docs/serving.md). Default ON — per-request tokens
+    are bit-identical with it on or off (the pinned parity contract), so
+    the knob exists for A/B measurement and paranoia, not correctness."""
+    return _env_bool("HOROVOD_SERVING_PREFIX_CACHE", True)
+
+
+def serving_prefix_capacity() -> int:
+    """``HOROVOD_SERVING_PREFIX_CAPACITY``: most blocks the prefix index
+    may hold references to (its LRU bound). 0 (default) = no dedicated
+    bound — cold entries are released only under pool pressure, which is
+    the right default because cached pages are free until somebody
+    needs the blocks."""
+    return max(0, _env_int("HOROVOD_SERVING_PREFIX_CAPACITY", 0))
+
+
+def router_replicas() -> int:
+    """``HOROVOD_ROUTER_REPLICAS``: engine replicas ``hvd.serving.fleet``
+    spins up when the caller does not pass an explicit count. Default 2
+    — the smallest fleet where replica death is a reshape instead of an
+    outage."""
+    val = _env_int("HOROVOD_ROUTER_REPLICAS", 2)
+    return val if val > 0 else 2
+
+
+def router_affinity() -> bool:
+    """``HOROVOD_ROUTER_AFFINITY``: prefix-affinity placement — requests
+    whose first whole page matches a prefix recently routed somewhere
+    follow it there (that replica's prefix cache is warm for them).
+    Default ON; off = pure least-loaded."""
+    return _env_bool("HOROVOD_ROUTER_AFFINITY", True)
+
+
+def router_retries() -> int:
+    """``HOROVOD_ROUTER_RETRIES``: times the router replays one request
+    on another replica after its serving replica died mid-flight (the
+    recompute path: greedy decoding is deterministic, so the replay's
+    tokens are identical and already-streamed ones are skipped). Beyond
+    it the failure surfaces to the caller."""
+    return max(0, _env_int("HOROVOD_ROUTER_RETRIES", 2))
+
+
 def fault_plan_raw() -> Optional[str]:
     """``HOROVOD_FAULT_PLAN``: inline JSON or ``@file`` reference for the
     deterministic fault-injection plan; None/blank disables."""

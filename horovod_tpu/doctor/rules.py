@@ -57,6 +57,11 @@ SERVING_QUEUE_SATURATION_SHARE = 0.9   # waiting depth vs admission bound
 SERVING_CRITICAL_REJECTS = 10          # shed requests before "critical"
 SERVING_MIN_PREEMPTIONS = 3            # pool-dry recomputes before warning
 SERVING_CRITICAL_PREEMPTIONS = 20
+# -- serving fleet -----------------------------------------------------------
+ROUTER_FLAPPING_MIN = 2                # replica departures before warning
+ROUTER_FLAPPING_CRITICAL = 5
+PREFIX_CACHE_MIN_TRAFFIC = 200         # whole pages judged before verdict
+PREFIX_CACHE_COLLAPSE_RATE = 0.2
 
 
 @dataclasses.dataclass
@@ -358,6 +363,53 @@ def check_cache_hit_collapse(ev: Evidence) -> Iterator[Diagnosis]:
                          f"{membership}" if membership else "")),
                 evidence={"hit_rate": round(rate, 4), "hits": hits,
                           "misses": misses, **membership})
+    # Serving prefix cache: same rule slug, its own hint branches — a
+    # warm-prefix rate this low under real page traffic means the fleet
+    # is re-prefilling prompts it should be admitting near-free.
+    for rank in sorted(ev.snapshots):
+        snap = ev.snapshots[rank]
+        entry_h = snap.get("hvd_serving_prefix_hits_total")
+        entry_m = snap.get("hvd_serving_prefix_misses_total")
+        if entry_h is None and entry_m is None:
+            continue
+        hits = sum(v for _, v in (entry_h or {}).get("values", []))
+        misses = sum(v for _, v in (entry_m or {}).get("values", []))
+        total = hits + misses
+        if total < PREFIX_CACHE_MIN_TRAFFIC:
+            continue
+        rate = hits / total
+        if rate >= PREFIX_CACHE_COLLAPSE_RATE:
+            continue
+        restarts = int(ev.restart_epoch) or int(max(_series_totals(
+            ev.snapshots, "hvd_launcher_restarts_total").values(),
+            default=0))
+        if restarts:
+            # Post-restart re-warm: the index died with the old
+            # process's pools — distinct from a cold cache that never
+            # warmed, which points at the traffic, not the lifecycle.
+            hint = (f"post-restart re-warm (restart epoch {restarts}): "
+                    "the prefix index lives in the engine's pools and "
+                    "died with the previous process; the hit rate "
+                    "recovers as shared prompts repopulate it — no "
+                    "action here unless the restarts themselves recur "
+                    "(see restart_churn)")
+        else:
+            hint = ("prefix-cache cold start, or traffic that shares no "
+                    "page-aligned prefixes: if the rate stays this low "
+                    "under steady load, check that system prompts are "
+                    "byte-identical across requests (one drifted token "
+                    "unshares every page after it) and that prompts "
+                    "span at least one whole HOROVOD_SERVING_BLOCK_SIZE "
+                    "page; raise HOROVOD_SERVING_PREFIX_CAPACITY if "
+                    "evictions churn the index")
+        yield Diagnosis(
+            rule="cache_hit_collapse", severity="warning", rank=rank,
+            summary=(f"serving prefix-cache hit rate {rate:.0%} over "
+                     f"{int(total)} whole pages"),
+            hint=hint,
+            evidence={"prefix_hit_rate": round(rate, 4), "hits": hits,
+                      "misses": misses, "restart_epoch": restarts,
+                      "source": "serving_prefix"})
 
 
 def check_restart_churn(ev: Evidence) -> Iterator[Diagnosis]:
@@ -543,6 +595,46 @@ def check_serving_pressure(ev: Evidence) -> Iterator[Diagnosis]:
                                            if blocks is not None else None)})
 
 
+def check_router_replica_flapping(ev: Evidence) -> Iterator[Diagnosis]:
+    """Serving replicas keep leaving the fleet: every departure is a
+    reshape (requests re-route, in-flight work replays, and the dead
+    replica's whole prefix cache is lost), so a flapping replica taxes
+    the survivors far beyond its own capacity — the serving twin of
+    ``membership_churn``. Counters are cumulative; take each replica
+    label's max across snapshots."""
+    departures: Dict[str, float] = {}
+    for rank in sorted(ev.snapshots):
+        for label, value in _counter_by_first_label(
+                ev.snapshots[rank],
+                "hvd_router_replica_departures_total").items():
+            departures[label] = max(departures.get(label, 0.0), value)
+    total = int(sum(departures.values()))
+    if total < ROUTER_FLAPPING_MIN:
+        return
+    flapper = max(sorted(departures), key=lambda label: departures[label])
+    sev = ("critical" if total >= ROUTER_FLAPPING_CRITICAL else "warning")
+    replicas = _gauge(ev.snapshots, "hvd_router_replicas")
+    epoch = _gauge(ev.snapshots, "hvd_router_epoch")
+    yield Diagnosis(
+        rule="router_replica_flapping", severity=sev,
+        summary=(f"{total} serving replica departure(s) this fleet"
+                 + (f", {int(replicas)} replica(s) still live"
+                    if replicas is not None else "")),
+        hint=(f"replica {flapper} left the fleet "
+              f"{int(departures[flapper])} time(s); every departure "
+              "re-routes its queue, replays its in-flight requests on "
+              "the survivors, and cold-starts its prefix cache on "
+              "rejoin — suspect that replica's host (OOM kills, "
+              "preemption, device resets) before adding capacity"),
+        evidence={"departures_total": total,
+                  "departures_by_replica": {k: int(v) for k, v in
+                                            sorted(departures.items())},
+                  "live_replicas": (int(replicas)
+                                    if replicas is not None else None),
+                  "router_epoch": (int(epoch)
+                                   if epoch is not None else None)})
+
+
 ALL_RULES = (
     check_persistent_straggler,
     check_clock_sync,
@@ -553,6 +645,7 @@ ALL_RULES = (
     check_membership_churn,
     check_autotune_search,
     check_serving_pressure,
+    check_router_replica_flapping,
 )
 
 # Every rule slug the catalog can emit — the hvd_doctor_findings gauge
@@ -569,6 +662,7 @@ RULE_SLUGS = (
     "autotune_wandering",
     "serving_queue_saturation",
     "serving_block_exhaustion",
+    "router_replica_flapping",
 )
 
 

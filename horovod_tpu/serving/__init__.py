@@ -20,6 +20,17 @@ Quick start::
         ...
     hvd.serving.stats()     # well-formed zeros before the first request
 
+Fleet (round 11 — docs/serving.md "Fleet architecture")::
+
+    router = hvd.serving.fleet(model, variables, replicas=3)
+    handle = router.submit(prompt_ids, max_new_tokens=128)
+    handle.result()         # survives a replica dying mid-request
+    router.health()         # per-replica liveness + load
+
+Warm prompts (shared system prefixes) admit copy-free through the
+per-replica prefix cache (``prefix_cache.PrefixCache``) and the router
+sends them where their pages are already warm (prefix affinity).
+
 The engine module (jax, flax) loads lazily — importing ``horovod_tpu``
 stays light, and ``stats()`` answers without ever touching jax when no
 engine exists.
@@ -30,6 +41,8 @@ from __future__ import annotations
 from typing import Optional
 
 from .kv_blocks import NULL_BLOCK, BlockPool, OutOfBlocks  # noqa: F401
+from .prefix_cache import PrefixCache, page_hashes  # noqa: F401
+from .router import FleetHandle, Router, RouterConfig  # noqa: F401
 from .scheduler import (  # noqa: F401
     CancelledError,
     RejectedError,
@@ -40,12 +53,15 @@ from .scheduler import (  # noqa: F401
 )
 
 __all__ = [
-    "BlockPool", "OutOfBlocks", "NULL_BLOCK", "Request", "Scheduler",
-    "ServingConfig", "RejectedError", "CancelledError", "ServingEngine",
-    "RequestHandle", "serve", "default_engine", "stats", "zero_stats",
+    "BlockPool", "OutOfBlocks", "NULL_BLOCK", "PrefixCache",
+    "page_hashes", "Request", "Scheduler", "ServingConfig",
+    "RejectedError", "CancelledError", "ServingEngine", "RequestHandle",
+    "Router", "RouterConfig", "FleetHandle", "serve", "fleet",
+    "default_engine", "default_router", "stats", "zero_stats",
 ]
 
 _default_engine = None
+_default_router = None
 
 
 def __getattr__(name):
@@ -73,15 +89,51 @@ def serve(model, variables, config: Optional[ServingConfig] = None,
     return engine
 
 
+def fleet(model, variables, replicas: Optional[int] = None,
+          config: Optional[ServingConfig] = None,
+          router_config: Optional[RouterConfig] = None,
+          seed: int = 0, start: bool = True) -> Router:
+    """Create ``replicas`` :class:`ServingEngine` data-parallel replicas
+    (default ``HOROVOD_ROUTER_REPLICAS``) behind one :class:`Router`,
+    register it as the module default (``stats()`` aggregates it), and
+    start every replica loop. All replicas share ``seed``: greedy
+    decoding is then bit-identical on every replica, which is what makes
+    death-replay lossless (docs/serving.md, parity contract)."""
+    global _default_router
+    from .engine import ServingEngine
+
+    rcfg = (router_config if router_config is not None
+            else RouterConfig.from_env())
+    n = replicas if replicas is not None else rcfg.replicas
+    if n < 1:
+        raise ValueError(f"a fleet needs at least one replica ({n})")
+    engines = [ServingEngine(model, variables, config=config, seed=seed)
+               for _ in range(n)]
+    router = Router(engines, rcfg)
+    _default_router = router
+    if start:
+        for engine in engines:
+            engine.start()
+    return router
+
+
 def default_engine():
     """The engine ``serve()`` registered, or None."""
     return _default_engine
 
 
+def default_router():
+    """The router ``fleet()`` registered, or None."""
+    return _default_router
+
+
 def stats() -> dict:
-    """The default engine's stats — or, before any engine exists, the
-    same dict with every key present and zero (the
-    ``controller_health()`` zero-state convention, pinned by test)."""
+    """The default fleet's aggregate stats (when ``fleet()`` ran), else
+    the default engine's — or, before either exists, the same dict with
+    every key present and zero (the ``controller_health()`` zero-state
+    convention, pinned by test)."""
+    if _default_router is not None:
+        return _default_router.stats()
     if _default_engine is None:
         return zero_stats()
     return _default_engine.stats()

@@ -44,6 +44,7 @@ import itertools
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, Iterator, List, Optional
 
@@ -55,6 +56,7 @@ from ..analysis.lockorder import make_lock
 from ..common import config as hvd_config
 from ..common import hvd_logging as logging
 from .kv_blocks import BlockPool, padded_table
+from .prefix_cache import PrefixCache
 from .scheduler import (
     CANCELLED,
     FAILED,
@@ -72,6 +74,13 @@ from .scheduler import (
 )
 
 _m = None
+
+# Every live engine in this process (a fleet runs several): the
+# unlabeled hvd_serving_* gauges describe the PROCESS, so each sweep
+# publishes the sum over live engines' latest per-engine snapshots —
+# a lone engine's sweep would otherwise clobber the fleet view with
+# just its own pool.
+_LIVE_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def _serving_metrics():
@@ -123,6 +132,27 @@ def _serving_metrics():
                 "hvd_serving_tpot_seconds",
                 "Inter-token latency per generated token (decode steps "
                 "plus any scheduling/preemption stall between them)."),
+            prefix_hits=metrics.counter(
+                "hvd_serving_prefix_hits_total",
+                "Whole KV pages admitted warm (mapped copy-free onto "
+                "blocks the prefix index already held)."),
+            prefix_misses=metrics.counter(
+                "hvd_serving_prefix_misses_total",
+                "Whole KV pages that had to prefill cold."),
+            prefix_cached=metrics.gauge(
+                "hvd_serving_prefix_cached_blocks",
+                "Blocks currently referenced by the prefix index."),
+            prefix_evictions=metrics.counter(
+                "hvd_serving_prefix_evictions_total",
+                "Prefix-index entries dropped (pool pressure or "
+                "capacity LRU)."),
+            blocks_shared=metrics.gauge(
+                "hvd_serving_blocks_shared",
+                "Blocks with more than one live reference right now."),
+            cow=metrics.counter(
+                "hvd_serving_cow_copies_total",
+                "Copy-on-write page copies (a sequence about to write "
+                "into a shared page got a private copy first)."),
         )
     return _m
 
@@ -198,6 +228,84 @@ def _paged_prefill(model, pools, variables, prompt, plen, table_row, rng,
 
 @functools.partial(
     jax.jit,
+    static_argnames=("model", "warm_pages", "total_pages", "greedy",
+                     "path", "mesh", "head_axis", "batch_axis"),
+    donate_argnums=(1,))
+def _paged_warm_prefill(model, pools, variables, tail, plen, warm_table,
+                        cold_table, rng, temperature, warm_pages=1,
+                        total_pages=2, greedy=True, path="kernel",
+                        mesh=None, head_axis=None, batch_axis=None):
+    """Prefill of a request whose first ``warm_pages`` whole pages are
+    already in the pool (prefix-cache hit): gather the warm KV pages
+    into the scratch cache's leading rows, run the model over ONLY the
+    cold tail tokens at ``cache_index = warm_len`` (the general
+    chunked-append attention path — each tail query attends the warm
+    history plus the fresh rows under the positional mask), scatter the
+    cold pages into ``cold_table``, and sample the first token from the
+    logits at global position ``plen - 1``.
+
+    Parity with the cold :func:`_paged_prefill` is bitwise in f32: the
+    scratch window is the SAME ``total_pages * block_size`` rows either
+    way (softmax/matmul reduction extents match), warm rows hold the
+    byte-identical KV an earlier prefill wrote, and rows past the valid
+    window are zeros whose masked logits contribute exact zeros. The jit
+    cache is keyed per (warm, total) page-count pair."""
+    cfg = model.config
+    head_dim = cfg.dim // cfg.num_heads
+    f = cfg.num_kv_heads * head_dim
+    layers = sorted(pools)
+    dtype = pools[layers[0]]["k"].dtype
+    block_size = pools[layers[0]]["k"].shape[1]
+    window = total_pages * block_size
+    warm_len = warm_pages * block_size
+    scratch = {}
+    for layer in layers:
+        warm_k = pools[layer]["k"][warm_table].reshape(1, warm_len, f)
+        warm_v = pools[layer]["v"][warm_table].reshape(1, warm_len, f)
+        zeros = jnp.zeros((1, window, f), dtype)
+        scratch[layer] = {
+            "k": zeros.at[:, :warm_len].set(warm_k),
+            "v": zeros.at[:, :warm_len].set(warm_v),
+        }
+    with _decode_path_ctx(path, mesh, head_axis, batch_axis):
+        logits, scratch = model.apply(variables, tail, cache=scratch,
+                                      cache_index=warm_len)
+    nb_cold = total_pages - warm_pages
+    new_pools = {}
+    for layer in layers:
+        pages_k = scratch[layer]["k"][0, warm_len:].reshape(
+            nb_cold, block_size, f)
+        pages_v = scratch[layer]["v"][0, warm_len:].reshape(
+            nb_cold, block_size, f)
+        new_pools[layer] = {
+            "k": pools[layer]["k"].at[cold_table].set(pages_k),
+            "v": pools[layer]["v"].at[cold_table].set(pages_v),
+        }
+    last = logits[0, plen - 1 - warm_len].astype(jnp.float32)
+    if greedy:
+        token = jnp.argmax(last, axis=-1)
+    else:
+        token = jax.random.categorical(rng, last / temperature)
+    return token, new_pools
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_blocks(pools, src, dst):
+    """Copy-on-write: duplicate whole pages ``src[i] -> dst[i]`` in every
+    layer's pools (one fused gather+scatter per layer; keyed per copy
+    count, and COW is rare by construction — see
+    ``Scheduler.ensure_decode_capacity``)."""
+    out = {}
+    for layer in sorted(pools):
+        k = pools[layer]["k"]
+        v = pools[layer]["v"]
+        out[layer] = {"k": k.at[dst].set(k[src]),
+                      "v": v.at[dst].set(v[src])}
+    return out
+
+
+@functools.partial(
+    jax.jit,
     static_argnames=("model", "all_greedy", "path", "mesh", "head_axis",
                      "batch_axis"),
     donate_argnums=(1,))
@@ -247,6 +355,22 @@ class RequestHandle:
     def state(self) -> str:
         with self._engine._cond:
             return self._req.state
+
+    @property
+    def warm_pages(self) -> int:
+        """Whole pages this request's last admission mapped warm from
+        the prefix cache (0 = fully cold) — the loadgen's warm/cold
+        TTFT split reads this."""
+        with self._engine._cond:
+            return self._req.warm_pages
+
+    def ttft_seconds(self) -> Optional[float]:
+        """Submit-to-first-token latency, or None before the first
+        token."""
+        with self._engine._cond:
+            if self._req.first_token_t is None:
+                return None
+            return self._req.first_token_t - self._req.submit_t
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Generated token ids (prompt excluded). Raises
@@ -336,12 +460,15 @@ class ServingEngine:
         self._config = cfg = ServingConfig(
             max_batch=cfg.max_batch, block_size=cfg.block_size,
             num_blocks=cfg.num_blocks, queue_depth=cfg.queue_depth,
-            max_seq_len=max_seq)
+            max_seq_len=max_seq, prefix_cache=cfg.prefix_cache,
+            prefix_capacity=cfg.prefix_capacity)
         self._table_slots = (max_seq + cfg.block_size - 1) // cfg.block_size
         num_blocks = cfg.num_blocks or cfg.max_batch * self._table_slots
         pool = BlockPool(num_blocks, cfg.block_size)
+        self._prefix = (PrefixCache(pool, cfg.prefix_capacity)
+                        if cfg.prefix_cache else None)
         self._sched = Scheduler(pool, cfg.max_batch, cfg.queue_depth,
-                                max_seq)
+                                max_seq, prefix_cache=self._prefix)
 
         # Decode-path classification, exactly generate()'s: the dummy
         # prompt is host-resident (replicated), so the verdict follows
@@ -398,6 +525,13 @@ class ServingEngine:
         # the full-lifetime distribution.
         self._ttfts: deque = deque(maxlen=4096)
         self._tpots: deque = deque(maxlen=4096)
+        self._prefix_published: Dict[str, int] = {}
+        self._live_peak = 0
+        # Latest per-engine gauge numbers (whole dict swapped atomically
+        # under the GIL; peers read it WITHOUT this engine's lock when
+        # summing the process-wide gauges — see _update_gauges).
+        self._gauge_snapshot: Dict[str, float] = {}
+        _LIVE_ENGINES.add(self)
         self._tracer = None
         self._trace_checked = False
 
@@ -412,6 +546,13 @@ class ServingEngine:
         """The :class:`~horovod_tpu.models.llama.DecodePath` verdict the
         engine's compiled programs ride (proof-of-path for harnesses)."""
         return self._path
+
+    @property
+    def closed(self) -> bool:
+        """True once the engine can no longer serve (shutdown, or its
+        loop died) — the router's liveness probe."""
+        with self._cond:
+            return self._closed
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0) -> RequestHandle:
@@ -433,11 +574,15 @@ class ServingEngine:
                     # Publish the queue gauges here too: an engine whose
                     # every submission is rejected would otherwise never
                     # set them, and the doctor's saturation rule gates
-                    # on the limit gauge being present. (Metric locks
-                    # only — _update_gauges would re-take the engine
-                    # lock we hold.)
-                    m.queue_depth.set(self._sched.queue_depth_now())
-                    m.queue_limit.set(self._sched.queue_depth)
+                    # on the limit gauge being present. (We hold the
+                    # engine lock, so refresh our own snapshot directly
+                    # and publish the lock-free fleet sum —
+                    # _update_gauges would re-take the lock.)
+                    snap = dict(self._gauge_snapshot)
+                    snap["queue_depth"] = self._sched.queue_depth_now()
+                    snap["queue_limit"] = self._sched.queue_depth
+                    self._gauge_snapshot = snap
+                    _publish_gauge_totals(m)
                 raise
             req = Request(rid=next(self._rid), prompt=prompt,
                           max_new_tokens=int(max_new_tokens),
@@ -477,6 +622,7 @@ class ServingEngine:
                     _serving_metrics().requests.labels(CANCELLED).inc()
                 self._cond.notify_all()
             admitted = self._sched.admit()
+            self._note_live_blocks()
         tracer = self._maybe_tracer()
         if tracer is not None:
             tracer.span("schedule", t_sched, time.monotonic(),
@@ -488,8 +634,11 @@ class ServingEngine:
 
         with self._cond:
             preempted = self._sched.ensure_decode_capacity()
+            copies = self._sched.pending_copies
+            self._sched.pending_copies = []
             if preempted and _metrics_on():
                 _serving_metrics().preemptions.inc(len(preempted))
+            self._note_live_blocks()
             batch = self._sched.active()
             arrays = self._build_batch(batch) if batch else None
         if preempted:
@@ -497,6 +646,17 @@ class ServingEngine:
                 "serving: block pool exhausted — preempted %d sequence(s) "
                 "for recompute (%s)", len(preempted),
                 ", ".join(f"rid {r.rid}" for r in preempted))
+        if copies:
+            # Copy-on-write: duplicate the shared pages into the fresh
+            # private blocks BEFORE the decode step writes into them.
+            # Only this (single-driver) thread mutates block ownership,
+            # so the source pages cannot be re-written before the copy.
+            self._pools = _copy_blocks(
+                self._pools,
+                jnp.asarray([s for s, _ in copies], jnp.int32),
+                jnp.asarray([d for _, d in copies], jnp.int32))
+            if _metrics_on():
+                _serving_metrics().cow.inc(len(copies))
 
         if arrays is not None:
             t_dec = time.monotonic()
@@ -567,6 +727,8 @@ class ServingEngine:
                     if _metrics_on():
                         _serving_metrics().requests.labels(FAILED).inc()
             self._sched.waiting.clear()
+            if self._prefix is not None:
+                self._prefix.clear()   # release cache-held block refs
             self._cond.notify_all()
         if self._tracer is not None:
             self._tracer.close()
@@ -596,7 +758,13 @@ class ServingEngine:
                 "ttft_p99_seconds": _quantile(self._ttfts, 0.99),
                 "tpot_p50_seconds": _quantile(self._tpots, 0.5),
                 "tpot_p99_seconds": _quantile(self._tpots, 0.99),
+                "blocks_shared": pool.blocks_shared,
+                "cow_copies": self._sched.cow_copies,
+                "blocks_live": self._live_blocks(),
+                "blocks_live_peak": self._live_peak,
             })
+            if self._prefix is not None:
+                s.update(self._prefix.stats())
             return s
 
     # -- internals ----------------------------------------------------------
@@ -628,6 +796,8 @@ class ServingEngine:
                                 _serving_metrics().requests.labels(
                                     FAILED).inc()
                     self._sched.waiting.clear()
+                    if self._prefix is not None:
+                        self._prefix.clear()
                     self._cond.notify_all()
                 return
 
@@ -652,29 +822,58 @@ class ServingEngine:
         plen = int(prompt.shape[0])
         nb = self._sched.pool.blocks_for(plen)
         window = nb * self._config.block_size
+        warm = min(req.warm_pages, max(0, nb - 1))
         # Pad to the page boundary so prefill compiles per block count,
         # not per length (see _paged_prefill).
         padded = np.zeros((1, window), np.int32)
         padded[0, :plen] = prompt
-        table_row = jnp.asarray(req.blocks[:nb], jnp.int32)
         rng = self._next_rng()
         greedy = req.temperature <= 0.0
-        token, self._pools = _paged_prefill(
-            self._model, self._pools, self._variables,
-            jnp.asarray(padded), jnp.int32(plen), table_row, rng,
-            jnp.float32(max(req.temperature, 1e-6)),
-            greedy=greedy, path=self._path.path, mesh=self._path.mesh,
-            head_axis=self._path.head_axis,
-            batch_axis=self._path.batch_axis)
+        if warm:
+            # Prefix-cache hit: the warm pages' KV already sits in the
+            # pool — only the cold tail runs through the model.
+            warm_len = warm * self._config.block_size
+            token, self._pools = _paged_warm_prefill(
+                self._model, self._pools, self._variables,
+                jnp.asarray(padded[:, warm_len:]), jnp.int32(plen),
+                jnp.asarray(req.blocks[:warm], jnp.int32),
+                jnp.asarray(req.blocks[warm:nb], jnp.int32), rng,
+                jnp.float32(max(req.temperature, 1e-6)),
+                warm_pages=warm, total_pages=nb, greedy=greedy,
+                path=self._path.path, mesh=self._path.mesh,
+                head_axis=self._path.head_axis,
+                batch_axis=self._path.batch_axis)
+        else:
+            table_row = jnp.asarray(req.blocks[:nb], jnp.int32)
+            token, self._pools = _paged_prefill(
+                self._model, self._pools, self._variables,
+                jnp.asarray(padded), jnp.int32(plen), table_row, rng,
+                jnp.float32(max(req.temperature, 1e-6)),
+                greedy=greedy, path=self._path.path, mesh=self._path.mesh,
+                head_axis=self._path.head_axis,
+                batch_axis=self._path.batch_axis)
         token = int(np.asarray(token))
         with self._cond:
             if req.state == RUNNING:       # not cancelled mid-prefill
+                self._register_prefix(req, plen)
                 self._append_token(req, token)
         tracer = self._maybe_tracer()
         if tracer is not None:
             tracer.span("prefill", t0, time.monotonic(), rid=req.rid,
-                        len=int(prompt.shape[0]),
+                        len=int(prompt.shape[0]), warm_pages=warm,
                         recompute=req.preemptions)
+
+    def _register_prefix(self, req: Request, plen: int) -> None:
+        """Caller holds the lock, right after a successful prefill:
+        every whole page of the (re-)prefilled prompt enters the prefix
+        index keyed by its chained digest (warm pages merely refresh
+        their LRU position). The index takes one pool reference per new
+        entry, so these pages outlive the request."""
+        if self._prefix is None:
+            return
+        for i in range(min(plen // self._config.block_size,
+                           len(req.page_hashes))):
+            self._prefix.insert(req.page_hashes[i], req.blocks[i])
 
     def _append_token(self, req: Request, token: int) -> None:
         """Caller holds the lock."""
@@ -717,6 +916,22 @@ class ServingEngine:
             temps[slot] = req.temperature
         return tokens, lens, tables, temps
 
+    def _live_blocks(self) -> int:
+        """Caller holds the lock. Blocks live sequences actually pin:
+        in-use minus pages only the prefix index holds (those are
+        reclaimable on demand — warm spare capacity, not footprint)."""
+        cache_only = (self._prefix.cache_only_blocks()
+                      if self._prefix is not None else 0)
+        return self._sched.pool.blocks_in_use - cache_only
+
+    def _note_live_blocks(self) -> None:
+        """Caller holds the lock; called right after each allocation
+        site (admission, per-step top-up) so ``blocks_live_peak`` is the
+        true high-water mark of live footprint."""
+        live = self._live_blocks()
+        if live > self._live_peak:
+            self._live_peak = live
+
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
         return sub
@@ -727,12 +942,28 @@ class ServingEngine:
         m = _serving_metrics()
         with self._cond:
             pool = self._sched.pool
-            m.queue_depth.set(self._sched.queue_depth_now())
-            m.queue_limit.set(self._sched.queue_depth)
-            m.active.set(len(self._sched.running))
-            m.blocks_in_use.set(pool.blocks_in_use)
-            m.blocks_total.set(pool.num_blocks)
-            m.block_util.set(pool.utilization())
+            self._gauge_snapshot = {
+                "queue_depth": self._sched.queue_depth_now(),
+                "queue_limit": self._sched.queue_depth,
+                "active": len(self._sched.running),
+                "blocks_in_use": pool.blocks_in_use,
+                "blocks_total": pool.num_blocks,
+                "blocks_shared": pool.blocks_shared,
+                "prefix_cached": (self._prefix.cached_blocks
+                                  if self._prefix is not None else 0),
+            }
+            if self._prefix is not None:
+                # The cache keeps cumulative ints; counters publish the
+                # delta since the last sweep (counters only ever inc).
+                for attr, counter in (("hits", m.prefix_hits),
+                                      ("misses", m.prefix_misses),
+                                      ("evictions", m.prefix_evictions)):
+                    total = getattr(self._prefix, attr)
+                    seen = self._prefix_published.get(attr, 0)
+                    if total > seen:
+                        counter.inc(total - seen)
+                        self._prefix_published[attr] = total
+        _publish_gauge_totals(m)
 
     # -- tracing ------------------------------------------------------------
 
@@ -756,6 +987,32 @@ def _metrics_on() -> bool:
     from .. import metrics
 
     return metrics.on()
+
+
+def _publish_gauge_totals(m) -> None:
+    """Process-wide gauges = sum over LIVE engines' latest per-engine
+    snapshots (read lock-free: each snapshot dict is swapped whole
+    under the GIL, and a slightly stale peer value is fine for a
+    gauge). A fleet runs several engines in one process — any single
+    engine publishing only its own numbers would clobber the fleet
+    view. Closed engines drop out of the sum, so a replica kill is
+    visible in the gauges."""
+    totals: Dict[str, float] = {}
+    for engine in list(_LIVE_ENGINES):
+        if engine._closed:
+            continue
+        for key, value in engine._gauge_snapshot.items():
+            totals[key] = totals.get(key, 0) + value
+    m.queue_depth.set(totals.get("queue_depth", 0))
+    m.queue_limit.set(totals.get("queue_limit", 0))
+    m.active.set(totals.get("active", 0))
+    m.blocks_in_use.set(totals.get("blocks_in_use", 0))
+    m.blocks_total.set(totals.get("blocks_total", 0))
+    m.block_util.set(
+        totals.get("blocks_in_use", 0) / totals["blocks_total"]
+        if totals.get("blocks_total") else 0.0)
+    m.blocks_shared.set(totals.get("blocks_shared", 0))
+    m.prefix_cached.set(totals.get("prefix_cached", 0))
 
 
 def _quantile(values, q: float) -> float:

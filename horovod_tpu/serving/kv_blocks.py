@@ -24,6 +24,16 @@ writes land there instead of corrupting live pages. Its CONTENT is
 therefore garbage by design — every read of it sits above some
 sequence's causal bound and is masked to an exact zero contribution.
 
+Blocks are **ref-counted** (round 11): prefix caching maps a warm
+prompt's pages onto blocks another sequence (or the prefix index
+itself) already holds, so one physical page can back several logical
+sequences. ``alloc`` hands a block out at refcount 1, ``share`` takes
+one more reference, and ``free`` *releases one reference* — the block
+returns to the free list only when the count hits zero. Over-release
+(freeing a block nobody holds) and under-release (the loud invariants
+below) stay bookkeeping bugs: a silently double-freed block would be
+handed to two sequences and corrupt both.
+
 The pool is NOT thread-safe by itself: the engine serializes access
 under its scheduler lock (one mutator — the engine loop — plus
 submit-time capacity checks).
@@ -64,10 +74,11 @@ class BlockPool:
         # pool, and freed blocks are reused most-recently-freed first
         # (their tiles are the likeliest still warm in HBM caches).
         self._free: List[int] = list(range(self.num_blocks, 0, -1))
-        self._held: set = set()
+        self._refs: Dict[int, int] = {}
         self._peak = 0
         self._allocs = 0
         self._frees = 0
+        self._shares = 0
 
     # -- capacity arithmetic ------------------------------------------------
 
@@ -85,10 +96,10 @@ class BlockPool:
             raise OutOfBlocks(
                 f"all {self.num_blocks} KV blocks are in use")
         block = self._free.pop()
-        self._held.add(block)
+        self._refs[block] = 1
         self._allocs += 1
-        if len(self._held) > self._peak:
-            self._peak = len(self._held)
+        if len(self._refs) > self._peak:
+            self._peak = len(self._refs)
         return block
 
     def alloc_many(self, n: int) -> List[int]:
@@ -100,20 +111,55 @@ class BlockPool:
                 f"of {self.num_blocks}")
         return [self.alloc() for _ in range(n)]
 
+    # -- sharing ------------------------------------------------------------
+
+    def share(self, block: int) -> int:
+        """Take one more reference on an already-held block (prefix-cache
+        warm mapping: a new sequence's page lands on an existing block
+        copy-free). Sharing the null block or a block nobody holds is a
+        bookkeeping bug — warm mappings must come from live index
+        entries, never stale ids."""
+        block = int(block)
+        if block == NULL_BLOCK:
+            raise ValueError("the null block is never allocated")
+        if block not in self._refs:
+            raise ValueError(
+                f"block {block} is not allocated — cannot share a block "
+                "nobody holds (stale prefix-index entry?)")
+        self._refs[block] += 1
+        self._shares += 1
+        return block
+
+    def refcount(self, block: int) -> int:
+        """Live reference count for ``block`` (0 = free)."""
+        return self._refs.get(int(block), 0)
+
+    def is_shared(self, block: int) -> bool:
+        """More than one holder: a write into this block needs
+        copy-on-write first (the sharing parity contract)."""
+        return self._refs.get(int(block), 0) > 1
+
     def free(self, blocks: Sequence[int]) -> None:
-        """Return blocks to the pool. Freeing the null block, an
-        unallocated id, or the same block twice is a bookkeeping bug —
-        loud, because a silently double-freed block would be handed to
-        two sequences and corrupt both."""
+        """Release one reference per listed block; a block returns to
+        the pool only when its count hits zero (a donor freeing a shared
+        page leaves the data live for the other holders). Freeing the
+        null block, an unallocated id, or more times than it was
+        alloc'd/shared is a bookkeeping bug — loud, because a silently
+        over-released block would be handed to two sequences and corrupt
+        both."""
         for block in blocks:
             block = int(block)
             if block == NULL_BLOCK:
                 raise ValueError("the null block is never allocated")
-            if block not in self._held:
+            refs = self._refs.get(block, 0)
+            if refs <= 0:
                 raise ValueError(
                     f"block {block} is not allocated (double free?)")
-            self._held.discard(block)
-            self._free.append(block)
+            if refs == 1:
+                del self._refs[block]
+                self._free.append(block)
+            else:
+                self._refs[block] = refs - 1
             self._frees += 1
 
     # -- views --------------------------------------------------------------
@@ -124,14 +170,19 @@ class BlockPool:
 
     @property
     def blocks_in_use(self) -> int:
-        return len(self._held)
+        return len(self._refs)
+
+    @property
+    def blocks_shared(self) -> int:
+        """Blocks with more than one live reference right now."""
+        return sum(1 for refs in self._refs.values() if refs > 1)
 
     @property
     def peak_in_use(self) -> int:
         return self._peak
 
     def utilization(self) -> float:
-        return len(self._held) / self.num_blocks if self.num_blocks else 0.0
+        return len(self._refs) / self.num_blocks if self.num_blocks else 0.0
 
     def stats(self) -> Dict[str, float]:
         """Accounting snapshot (JSON-clean) for ``engine.stats()`` and
@@ -142,8 +193,10 @@ class BlockPool:
             "blocks_free": self.free_blocks,
             "blocks_peak": self.peak_in_use,
             "block_utilization": round(self.utilization(), 4),
+            "blocks_shared": self.blocks_shared,
             "block_allocs": self._allocs,
             "block_frees": self._frees,
+            "block_shares": self._shares,
         }
 
 

@@ -24,12 +24,13 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..common import config as hvd_config
 from .kv_blocks import BlockPool, OutOfBlocks
+from .prefix_cache import PrefixCache
 
 # Request lifecycle. WAITING -> RUNNING -> FINISHED is the happy path;
 # RUNNING -> WAITING is preemption-by-recompute; CANCELLED/FAILED are
@@ -65,6 +66,8 @@ class ServingConfig:
     num_blocks: int = 0         # pool capacity; 0 = fully provisioned
     queue_depth: int = 128      # admission bound on WAITING requests
     max_seq_len: int = 0        # position budget; 0 = model's max
+    prefix_cache: bool = True   # warm-prefix sharing (docs/serving.md)
+    prefix_capacity: int = 0    # cache-held block bound; 0 = pressure-only
 
     @staticmethod
     def from_env() -> "ServingConfig":
@@ -74,6 +77,8 @@ class ServingConfig:
             num_blocks=hvd_config.serving_num_blocks(),
             queue_depth=hvd_config.serving_queue_depth(),
             max_seq_len=hvd_config.serving_max_seq_len(),
+            prefix_cache=hvd_config.serving_prefix_cache(),
+            prefix_capacity=hvd_config.serving_prefix_capacity(),
         )
 
 
@@ -89,6 +94,12 @@ class Request:
     tokens: List[int] = dataclasses.field(default_factory=list)
     blocks: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
+    # Prefix sharing (set by admit()): how many leading whole pages of
+    # current_prompt() were mapped onto existing blocks copy-free, and
+    # the chained digests of ALL its whole pages (the engine's insert
+    # keys once the prefill writes the cold ones).
+    warm_pages: int = 0
+    page_hashes: List[bytes] = dataclasses.field(default_factory=list)
     preemptions: int = 0
     cancel_requested: bool = False
     error: Optional[str] = None
@@ -154,6 +165,28 @@ def zero_stats() -> Dict[str, float]:
         "ttft_p99_seconds": 0.0,
         "tpot_p50_seconds": 0.0,
         "tpot_p99_seconds": 0.0,
+        # Prefix sharing (round 11; zeros when the cache is disabled).
+        # blocks_live excludes pages only the prefix index holds —
+        # reclaimable on demand, so they are warm spare capacity, not
+        # footprint; blocks_live_peak is ITS high-water mark (sampled at
+        # step boundaries, where all allocation happens).
+        "blocks_live": 0,
+        "blocks_live_peak": 0,
+        "blocks_shared": 0,
+        "cow_copies": 0,
+        "prefix_hits": 0,
+        "prefix_misses": 0,
+        "prefix_hit_rate": 0.0,
+        "prefix_cached_blocks": 0,
+        "prefix_inserts": 0,
+        "prefix_evictions": 0,
+        # Fleet router (round 11; zeros for a routerless engine — the
+        # default router's live numbers overlay these in
+        # ``hvd.serving.stats()``).
+        "router_replicas": 0,
+        "router_requests": 0,
+        "router_reroutes": 0,
+        "router_replica_departures": 0,
     }
 
 
@@ -171,16 +204,23 @@ class Scheduler:
     """
 
     def __init__(self, pool: BlockPool, max_batch: int, queue_depth: int,
-                 max_seq_len: int):
+                 max_seq_len: int,
+                 prefix_cache: Optional[PrefixCache] = None):
         self.pool = pool
         self.max_batch = int(max_batch)
         self.queue_depth = int(queue_depth)
         self.max_seq_len = int(max_seq_len)
+        self.prefix_cache = prefix_cache
         self.waiting: Deque[Request] = deque()
         self.running: Dict[int, Request] = {}      # slot -> Request
         self._free_slots: List[int] = list(range(self.max_batch - 1, -1, -1))
         self.rejected = 0
         self.preempted = 0
+        self.cow_copies = 0
+        # (src, dst) block copies the engine must perform on-device
+        # BEFORE the next decode step (copy-on-write: a sequence about to
+        # write into a shared page got a private block instead).
+        self.pending_copies: List[Tuple[int, int]] = []
 
     # -- admission ----------------------------------------------------------
 
@@ -229,27 +269,75 @@ class Scheduler:
         can hold their (re-)prefill blocks. FIFO — the head blocks the
         tail, which keeps TTFT honest (no starvation of long prompts).
         Admitted requests come back with blocks + slot assigned, ready
-        for the engine's prefill."""
+        for the engine's prefill.
+
+        With a prefix cache, a request's leading whole pages that the
+        index already holds are mapped onto the existing blocks
+        **copy-free** (one shared reference each) — only the cold tail
+        pages allocate, so a warm prompt admits at a fraction of its
+        cold block cost and its prefill recomputes only the tail."""
         admitted: List[Request] = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             need = self.pool.blocks_for(req.total_len())
-            if not self.pool.can_fit(need):
+            warm: List[int] = []
+            hashes: List[bytes] = []
+            if self.prefix_cache is not None:
+                warm, hashes = self.prefix_cache.lookup(
+                    req.current_prompt())
+                for block in warm:
+                    self.pool.share(block)
+            if not self._ensure_free(need - len(warm)):
+                if warm:
+                    self.pool.free(warm)    # un-map; retry next step
                 break
             self.waiting.popleft()
-            req.blocks = self.pool.alloc_many(need)
+            req.blocks = list(warm) + self.pool.alloc_many(
+                need - len(warm))
+            req.warm_pages = len(warm)
+            req.page_hashes = hashes
+            if self.prefix_cache is not None:
+                # Hit accounting on ADMISSION only — a request parked by
+                # a full pool re-probes the index every step and would
+                # otherwise inflate both counters.
+                self.prefix_cache.hits += len(warm)
+                self.prefix_cache.misses += len(hashes) - len(warm)
             req.slot = self._free_slots.pop()
             req.state = RUNNING
             self.running[req.slot] = req
             admitted.append(req)
         return admitted
 
+    def _ensure_free(self, blocks: int) -> bool:
+        """True once ``blocks`` are allocatable, releasing cold prefix-
+        cache entries (cache-only references, LRU-first) to get there —
+        warm pages nobody is using are the cheapest capacity on the
+        machine, and evicting them beats preempting live work."""
+        if self.pool.can_fit(blocks):
+            return True
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(blocks - self.pool.free_blocks)
+        return self.pool.can_fit(blocks)
+
     # -- retirement ---------------------------------------------------------
+
+    def _drop_pending_copies(self, req: Request) -> None:
+        """A request leaving the batch must take its queued COW copies
+        with it: its destination blocks return to the pool and could be
+        re-handed out before the engine drains the copy list."""
+        if self.pending_copies:
+            mine = set(req.blocks)
+            self.pending_copies = [
+                (src, dst) for src, dst in self.pending_copies
+                if dst not in mine]
 
     def retire(self, req: Request, state: str,
                error: Optional[str] = None) -> None:
-        """Terminal transition: free blocks and slot, record state."""
+        """Terminal transition: free blocks and slot, record state.
+        ``free`` releases one reference per block — pages the prefix
+        index (or another sequence) still holds stay live."""
         if req.blocks:
+            self._drop_pending_copies(req)
             self.pool.free(req.blocks)
             req.blocks = []
         if req.slot is not None:
@@ -274,7 +362,10 @@ class Scheduler:
     def preempt(self, req: Request) -> None:
         """Preemption-by-recompute: drop the sequence's blocks and park
         it at the queue front; its generated tokens ride along and are
-        replayed by the readmission prefill."""
+        replayed by the readmission prefill. Shared pages survive the
+        free (the prefix index / other holders keep them), so a
+        preempted warm request usually readmits warm again."""
+        self._drop_pending_copies(req)
         self.pool.free(req.blocks)
         req.blocks = []
         if req.slot is not None:
@@ -285,14 +376,39 @@ class Scheduler:
         self.preempted += 1
         self.requeue_front(req)
 
+    def _grow_block(self, req: Request,
+                    preempted: List[Request]) -> Optional[int]:
+        """One block for ``req``, by whatever it takes: allocate,
+        release cold prefix-cache entries, then preempt the YOUNGEST
+        running sequence (most recently admitted — least sunk work to
+        replay) and retry. Returns None when ``req`` itself became the
+        victim."""
+        while True:
+            if not self.pool.free_blocks and self.prefix_cache is not None:
+                self.prefix_cache.release(1)
+            try:
+                return self.pool.alloc()
+            except OutOfBlocks:
+                victim = max(self.running.values(), key=lambda r: r.rid)
+                self.preempt(victim)
+                preempted.append(victim)
+                if victim is req:
+                    return None
+
     def ensure_decode_capacity(self) -> List[Request]:
         """Before a decode step: every running sequence needs the block
-        holding its next write position. Allocate missing blocks oldest
-        sequence first; on exhaustion preempt the YOUNGEST running
-        sequence (most recently admitted — it has the least sunk work
-        to replay) and retry. Returns the preempted requests (already
-        requeued). A lone running sequence can always grow: admission
-        rejected anything whose full window exceeds the pool."""
+        holding its next write position — and needs it PRIVATE. Allocate
+        missing blocks oldest sequence first (cache relief before
+        preemption, see :meth:`_grow_block`); then, if the write-target
+        block is shared (another sequence or the prefix index holds it),
+        schedule a **copy-on-write**: a fresh private block replaces it
+        in this sequence's table, the page contents are queued on
+        ``pending_copies`` for the engine to copy on-device before the
+        step, and this sequence's reference on the shared original is
+        released. Returns the preempted requests (already requeued). A
+        lone running sequence can always grow: admission rejected
+        anything whose full window exceeds the pool, and cache-only
+        references always yield to a live sequence."""
         preempted: List[Request] = []
         survivors = sorted(self.running.values(), key=lambda r: r.rid)
         for req in survivors:
@@ -301,16 +417,22 @@ class Scheduler:
             # The step writes the incoming token's KV row at position
             # total_len() - 1; the table must cover it.
             need = self.pool.blocks_for(req.total_len())
-            while len(req.blocks) < need:
-                try:
-                    req.blocks.append(self.pool.alloc())
-                except OutOfBlocks:
-                    victim = max(self.running.values(),
-                                 key=lambda r: r.rid)
-                    self.preempt(victim)
-                    preempted.append(victim)
-                    if victim is req:
-                        break
+            while req.slot is not None and len(req.blocks) < need:
+                block = self._grow_block(req, preempted)
+                if block is not None:
+                    req.blocks.append(block)
+            if req.slot is None:
+                continue
+            widx = (req.total_len() - 1) // self.pool.block_size
+            if self.pool.is_shared(req.blocks[widx]):
+                fresh = self._grow_block(req, preempted)
+                if fresh is None:
+                    continue
+                src = req.blocks[widx]
+                req.blocks[widx] = fresh
+                self.pending_copies.append((src, fresh))
+                self.pool.free([src])          # our reference only
+                self.cow_copies += 1
         return preempted
 
     # -- views --------------------------------------------------------------

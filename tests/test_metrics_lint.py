@@ -115,6 +115,19 @@ def test_known_series_present():
         "hvd_serving_steps_total",
         "hvd_serving_ttft_seconds",
         "hvd_serving_tpot_seconds",
+        "hvd_serving_prefix_hits_total",
+        "hvd_serving_prefix_misses_total",
+        "hvd_serving_prefix_cached_blocks",
+        "hvd_serving_prefix_evictions_total",
+        "hvd_serving_blocks_shared",
+        "hvd_serving_cow_copies_total",
+        "hvd_router_replicas",
+        "hvd_router_epoch",
+        "hvd_router_requests_total",
+        "hvd_router_reroutes_total",
+        "hvd_router_replica_departures_total",
+        "hvd_router_replica_joins_total",
+        "hvd_router_affinity_hits_total",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
 

@@ -403,7 +403,11 @@ def test_engine_preemption_recompute_parity(tiny_variables):
     assert stats["preemptions"] > 0, "pool sizing did not force preemption"
     _assert_parity(engine, tiny_variables, prompts, news, handles)
     assert stats["blocks_peak"] <= 7
-    assert engine.stats()["blocks_in_use"] == 0   # everything freed
+    # Everything LIVE freed; pages may stay in the prefix index (one
+    # cache reference each — warm spare capacity, released on demand).
+    stats = engine.stats()
+    assert stats["blocks_live"] == 0
+    assert stats["blocks_in_use"] == stats["prefix_cached_blocks"]
 
 
 def test_engine_reject_when_queue_full(tiny_variables):
@@ -477,6 +481,14 @@ def test_serving_stats_zero_state_before_any_engine():
             "requests_cancelled", "preemptions", "tokens_generated",
             "steps", "ttft_p50_seconds", "ttft_p99_seconds",
             "tpot_p50_seconds", "tpot_p99_seconds",
+            # Prefix sharing (round 11).
+            "blocks_live", "blocks_live_peak", "blocks_shared",
+            "cow_copies", "prefix_hits", "prefix_misses",
+            "prefix_hit_rate", "prefix_cached_blocks", "prefix_inserts",
+            "prefix_evictions",
+            # Fleet router (round 11).
+            "router_replicas", "router_requests", "router_reroutes",
+            "router_replica_departures",
         }
     finally:
         serving._default_engine = prev
@@ -554,8 +566,8 @@ def test_doctor_names_queue_saturation_past_admission(tiny_variables):
                                     min_prompt=8, max_prompt=32,
                                     min_new=8, max_new=16,
                                     vocab_size=CFG.vocab_size)
-        _, rejected, _ = loadgen.run_workload(engine, trace,
-                                              timeout_s=300.0)
+        _, rejected, _, _ = loadgen.run_workload(engine, trace,
+                                                 timeout_s=300.0)
         engine.shutdown()
         assert rejected > 0, "workload did not exceed admission capacity"
         report = hvd_doctor.report()
@@ -630,3 +642,378 @@ def test_serving_env_knobs_parse(monkeypatch):
     assert cfg.queue_depth == 128        # non-positive -> default
     assert cfg.max_seq_len == 4096
     assert hvd_config.serving_max_batch() == 32
+
+
+def test_prefix_env_knobs_parse(monkeypatch):
+    from horovod_tpu.common import config as hvd_config
+
+    monkeypatch.setenv("HOROVOD_SERVING_PREFIX_CACHE", "0")
+    monkeypatch.setenv("HOROVOD_SERVING_PREFIX_CAPACITY", "-5")
+    cfg = ServingConfig.from_env()
+    assert cfg.prefix_cache is False
+    assert cfg.prefix_capacity == 0      # negative clamps
+    monkeypatch.setenv("HOROVOD_SERVING_PREFIX_CACHE", "1")
+    monkeypatch.setenv("HOROVOD_SERVING_PREFIX_CAPACITY", "16")
+    cfg = ServingConfig.from_env()
+    assert cfg.prefix_cache is True and cfg.prefix_capacity == 16
+    assert hvd_config.serving_prefix_cache() is True
+
+
+# ---------------------------------------------------------------------------
+# Ref-counted block pool (round 11) — the sharing edge cases, loud.
+
+
+def test_block_pool_share_and_release_semantics():
+    pool = BlockPool(4, block_size=8)
+    a = pool.alloc()
+    assert pool.refcount(a) == 1 and not pool.is_shared(a)
+    pool.share(a)
+    assert pool.refcount(a) == 2 and pool.is_shared(a)
+    assert pool.blocks_shared == 1
+    # Free-while-shared: the donor's release does NOT return the block
+    # (the other holder keeps the data); accounting stays exact.
+    pool.free([a])
+    assert pool.refcount(a) == 1 and pool.blocks_in_use == 1
+    assert a not in [pool.alloc() for _ in range(pool.free_blocks)], (
+        "a still-referenced block was handed out again")
+    # Eviction of the LAST reference returns the block to the pool.
+    pool.free([a])
+    assert pool.refcount(a) == 0
+    b = pool.alloc()
+    assert b == a                        # reusable again (LIFO free list)
+
+
+def test_block_pool_double_free_of_shared_block_is_loud():
+    pool = BlockPool(2, block_size=4)
+    a = pool.alloc()
+    pool.share(a)                        # two references
+    pool.free([a])
+    pool.free([a])                       # both released: legal
+    with pytest.raises(ValueError, match="double free"):
+        pool.free([a])                   # one more: a bookkeeping bug
+    with pytest.raises(ValueError, match="cannot share"):
+        pool.share(a)                    # sharing a free block is stale
+    with pytest.raises(ValueError, match="null block"):
+        pool.share(NULL_BLOCK)
+
+
+def test_block_pool_stats_count_shares():
+    pool = BlockPool(4, block_size=8)
+    a = pool.alloc()
+    pool.share(a)
+    s = pool.stats()
+    assert s["block_shares"] == 1 and s["blocks_shared"] == 1
+    pool.free([a])
+    assert pool.stats()["blocks_shared"] == 0   # one holder left
+
+
+# ---------------------------------------------------------------------------
+# Prefix cache (pure bookkeeping)
+
+
+def test_page_hashes_chain_commits_to_whole_prefix():
+    from horovod_tpu.serving import page_hashes
+
+    toks = np.arange(32, dtype=np.int32)
+    h = page_hashes(toks, 8)
+    assert len(h) == 4                   # whole pages only
+    assert len(page_hashes(toks[:31], 8)) == 3
+    # Same page-2 tokens after an EARLIER divergence: every digest from
+    # the divergence on must change (chained, not per-page).
+    other = toks.copy()
+    other[0] += 1
+    h2 = page_hashes(other, 8)
+    assert h[0] != h2[0] and h[2] != h2[2] and h[3] != h2[3]
+    # Determinism.
+    assert page_hashes(toks, 8) == h
+
+
+def test_prefix_cache_lookup_insert_and_cap():
+    from horovod_tpu.serving import PrefixCache, page_hashes
+
+    pool = BlockPool(8, block_size=4)
+    cache = PrefixCache(pool)
+    toks = np.arange(12, dtype=np.int32)         # 3 whole pages
+    hashes = page_hashes(toks, 4)
+    blocks = pool.alloc_many(3)
+    for digest, block in zip(hashes, blocks):
+        assert cache.insert(digest, block)
+        assert not cache.insert(digest, block)   # refresh, not re-add
+    assert pool.refcount(blocks[0]) == 2         # cache holds one ref
+    # An unaligned prompt past the cached pages maps them all warm.
+    warm, got_hashes = cache.lookup(np.arange(13, dtype=np.int32))
+    assert got_hashes == hashes
+    assert warm == blocks
+    # Page-aligned prompt: the warm run is capped one page short so the
+    # prefill keeps >= 1 real token (fully-warm aligned prompts
+    # recompute exactly their last page).
+    warm_aligned, _ = cache.lookup(toks)         # 12 = exactly 3 pages
+    assert warm_aligned == blocks[:2]
+    warm_aligned, _ = cache.lookup(toks[:8])
+    assert warm_aligned == blocks[:1]
+    # A cold middle page breaks the run (later isolated hits are
+    # useless: their KV assumes a different history).
+    cache.release(8, for_capacity=True)
+    for digest, block in ((hashes[0], blocks[0]), (hashes[2], blocks[2])):
+        cache.insert(digest, block)
+    warm_broken, _ = cache.lookup(toks)
+    assert warm_broken == [blocks[0]]
+
+
+def test_prefix_cache_release_skips_live_and_frees_cold():
+    from horovod_tpu.serving import PrefixCache, page_hashes
+
+    pool = BlockPool(4, block_size=4)
+    cache = PrefixCache(pool)
+    toks = np.arange(8, dtype=np.int32)
+    hashes = page_hashes(toks, 4)
+    blocks = pool.alloc_many(2)
+    for digest, block in zip(hashes, blocks):
+        cache.insert(digest, block)
+    # Simulate the donor retiring: pages become cache-only.
+    pool.free([blocks[1]])
+    assert cache.cache_only_blocks() == 1
+    # blocks[0] still has a live holder: release must skip it and free
+    # only the cache-only page.
+    freed = cache.release(2)
+    assert freed == 1
+    assert pool.refcount(blocks[1]) == 0         # returned to the pool
+    assert pool.refcount(blocks[0]) == 2         # untouched (live + cache)
+    assert cache.evictions == 1
+
+
+def test_prefix_cache_capacity_lru():
+    from horovod_tpu.serving import PrefixCache, page_hashes
+
+    pool = BlockPool(8, block_size=4)
+    cache = PrefixCache(pool, capacity_blocks=2)
+    toks = np.arange(16, dtype=np.int32)
+    hashes = page_hashes(toks, 4)
+    blocks = pool.alloc_many(4)
+    for digest, block in zip(hashes[:2], blocks[:2]):
+        cache.insert(digest, block)
+    assert len(cache) == 2
+    cache.lookup(toks[:5])               # refreshes page 0's LRU slot
+    cache.insert(hashes[2], blocks[2])   # evicts LRU = page 1
+    assert len(cache) == 2
+    warm, _ = cache.lookup(toks)
+    assert warm == [blocks[0]]           # page 1 gone -> run stops there
+    assert cache.evictions == 1
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: warm admission + copy-on-write
+
+
+def test_scheduler_warm_admission_maps_shared_blocks():
+    from horovod_tpu.serving import PrefixCache, page_hashes
+
+    pool = BlockPool(8, 4)
+    cache = PrefixCache(pool)
+    sched = Scheduler(pool, max_batch=2, queue_depth=4, max_seq_len=32,
+                      prefix_cache=cache)
+    donor = pool.alloc_many(2)
+    toks = np.arange(10, dtype=np.int32)          # 2 whole pages + tail
+    for digest, block in zip(page_hashes(toks, 4), donor):
+        cache.insert(digest, block)
+    req = Request(rid=0, prompt=toks, max_new_tokens=4)
+    sched.enqueue(req)
+    [admitted] = sched.admit()
+    assert admitted.warm_pages == 2
+    assert admitted.blocks[:2] == donor           # mapped, not copied
+    assert pool.refcount(donor[0]) == 3           # donor + cache + req
+    assert cache.hits == 2 and cache.misses == 0  # no 3rd whole page
+    # The donor freeing its pages keeps them live for the request.
+    pool.free(donor)
+    assert pool.refcount(donor[0]) == 2
+    sched.retire(req, "finished")
+    assert pool.refcount(donor[0]) == 1           # cache only now
+
+
+def test_scheduler_cow_private_copy_before_shared_write():
+    """A sequence whose next KV write targets a shared page gets a
+    private copy first: fresh block swapped into its table, the (src,
+    dst) pair queued for the engine, and its reference on the shared
+    original released."""
+    pool = BlockPool(8, 4)
+    sched = Scheduler(pool, max_batch=2, queue_depth=4, max_seq_len=32)
+    req = Request(rid=0, prompt=np.arange(6, dtype=np.int32),
+                  max_new_tokens=8)
+    sched.enqueue(req)
+    [r] = sched.admit()
+    # Another holder appears on the write-target block (position
+    # total_len()-1 = 5 -> block index 1).
+    src = r.blocks[1]
+    pool.share(src)
+    sched.ensure_decode_capacity()
+    assert sched.cow_copies == 1
+    assert r.blocks[1] != src
+    assert sched.pending_copies == [(src, r.blocks[1])]
+    assert pool.refcount(src) == 1               # our release went through
+    assert pool.refcount(r.blocks[1]) == 1
+    # Already-private target: no further copies.
+    sched.pending_copies.clear()
+    sched.ensure_decode_capacity()
+    assert sched.cow_copies == 1
+
+
+def test_scheduler_cow_under_preemption_pressure():
+    """COW with a dry pool: the fresh private block comes from
+    preempting the youngest sequence, and the victim's own queued
+    copies die with it (its blocks return to the pool)."""
+    pool = BlockPool(4, 4)
+    sched = Scheduler(pool, max_batch=2, queue_depth=4, max_seq_len=16)
+    r0 = Request(rid=0, prompt=np.arange(6, dtype=np.int32),
+                 max_new_tokens=8)
+    r1 = Request(rid=1, prompt=np.arange(6, dtype=np.int32),
+                 max_new_tokens=8)
+    sched.enqueue(r0)
+    sched.enqueue(r1)
+    assert len(sched.admit()) == 2               # 2 blocks each: pool full
+    src = r0.blocks[1]
+    pool.share(src)                              # external holder
+    preempted = sched.ensure_decode_capacity()
+    assert preempted == [r1]                     # youngest paid for the copy
+    assert r1.blocks == [] and r1.state == "waiting"
+    assert sched.cow_copies == 1
+    assert sched.pending_copies == [(src, r0.blocks[1])]
+    assert r0.blocks[1] != src
+    assert pool.refcount(src) == 1               # the external holder
+
+
+# ---------------------------------------------------------------------------
+# Engine: sharing parity (the round-11 acceptance bar)
+
+
+def _shared_prefix_workload(rng, n, prefix_len, tail_lens, new_tokens):
+    shared = rng.randint(0, CFG.vocab_size, (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate(
+        [shared, rng.randint(0, CFG.vocab_size,
+                             (tail_lens[i % len(tail_lens)],)
+                             ).astype(np.int32)]) for i in range(n)]
+    news = [new_tokens[i % len(new_tokens)] for i in range(n)]
+    return prompts, news
+
+
+def test_engine_parity_sharing_on_off_single_device(tiny_variables):
+    """Per-request tokens with prefix sharing ON are bit-identical to
+    sharing OFF and to bare generate() — and the warm path genuinely
+    engaged (prefix hits, shared blocks)."""
+    rng = np.random.RandomState(7)
+    prompts, news = _shared_prefix_workload(rng, 8, 16, [3, 5, 9, 17],
+                                            [4, 6, 8])
+    on = ServingEngine(MODEL, tiny_variables, config=SCFG)
+    handles_on = [on.submit(p, n) for p, n in zip(prompts, news)]
+    on.run_until_idle()
+    _assert_parity(on, tiny_variables, prompts, news, handles_on)
+    stats = on.stats()
+    assert stats["prefix_hits"] > 0, "warm path never engaged"
+    assert any(h.warm_pages > 0 for h in handles_on)
+    off = ServingEngine(MODEL, tiny_variables,
+                        config=dataclasses.replace(SCFG,
+                                                   prefix_cache=False))
+    handles_off = [off.submit(p, n) for p, n in zip(prompts, news)]
+    off.run_until_idle()
+    assert off.stats()["prefix_hits"] == 0
+    for a, b in zip(handles_on, handles_off):
+        assert a.result(timeout=0) == b.result(timeout=0)
+
+
+def test_engine_parity_sharing_tp(tp_setup):
+    """The same sharing-on parity on the TP-sharded decode path (the
+    warm prefill's gather + tail-run must be bit-exact under
+    shard_map/GSPMD too)."""
+    mesh, sharded = tp_setup
+    engine = ServingEngine(MODEL, sharded, config=SCFG)
+    assert engine.decode_path.path == "kernel_tp"
+    rng = np.random.RandomState(8)
+    prompts, news = _shared_prefix_workload(rng, 6, 16, [4, 7, 12],
+                                            [5, 7])
+    handles = [engine.submit(p, n) for p, n in zip(prompts, news)]
+    engine.run_until_idle()
+    assert engine.stats()["prefix_hits"] > 0
+    _assert_parity(engine, sharded, prompts, news, handles, mesh=mesh)
+
+
+def test_engine_parity_sharing_across_preemption_and_donor_eviction(
+        tiny_variables):
+    """The hard corner pinned by the acceptance criteria: an undersized
+    pool forces preemption while requests share warm pages; donors
+    retire (and their pages get evicted under pressure) while sharers
+    still run. Every request must still match bare generate()."""
+    scfg = ServingConfig(max_batch=3, block_size=4, num_blocks=10,
+                         queue_depth=32, max_seq_len=28)
+    engine = ServingEngine(MODEL, tiny_variables, config=scfg)
+    rng = np.random.RandomState(5)
+    prompts, news = _shared_prefix_workload(rng, 6, 8, [2, 3, 5],
+                                            [10, 12])
+    handles = [engine.submit(p, n) for p, n in zip(prompts, news)]
+    engine.run_until_idle()
+    stats = engine.stats()
+    assert stats["preemptions"] > 0, "pool sizing did not force preemption"
+    assert stats["prefix_hits"] > 0, "sharing never engaged"
+    assert stats["prefix_evictions"] > 0, "pressure never evicted a donor"
+    _assert_parity(engine, tiny_variables, prompts, news, handles)
+    assert engine.stats()["blocks_live"] == 0
+
+
+def test_engine_cow_copy_is_content_correct(tiny_variables):
+    """Force a COW on a live decode write: an external reference lands
+    on the write-target block mid-generation; the engine must copy the
+    page on-device before writing, and the final tokens still match
+    bare generate() (proof the copy carried the right bytes)."""
+    engine = ServingEngine(MODEL, tiny_variables, config=SCFG)
+    prompt = np.random.RandomState(6).randint(
+        0, CFG.vocab_size, (9,)).astype(np.int32)
+    handle = engine.submit(prompt, 8)
+    engine.step()                        # prefill + first decode step
+    with engine._cond:
+        req = engine._sched.running[handle._req.slot]
+        widx = (req.total_len() - 1) // SCFG.block_size
+        shared_block = req.blocks[widx]
+        engine._sched.pool.share(shared_block)   # external holder appears
+    engine.run_until_idle()
+    assert engine.stats()["cow_copies"] >= 1
+    ref = generate(MODEL, tiny_variables, jnp.asarray(prompt[None]),
+                   max_new_tokens=8)
+    assert handle.result(timeout=0) == list(np.asarray(ref)[0, 9:])
+    # The shared original still belongs to its external holder.
+    assert engine._sched.pool.refcount(shared_block) == 1
+
+
+def test_engine_recompute_readmits_warm_from_own_pages(tiny_variables):
+    """Preemption with the cache on is CHEAP: the preempted sequence's
+    pages survive in the index (free-while-shared), so its recompute
+    prefill maps them warm instead of replaying the whole prefix."""
+    scfg = ServingConfig(max_batch=2, block_size=4, num_blocks=8,
+                         queue_depth=8, max_seq_len=32)
+    engine = ServingEngine(MODEL, tiny_variables, config=scfg)
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, CFG.vocab_size, (8,)).astype(np.int32)
+               for _ in range(2)]
+    handles = [engine.submit(p, 12) for p in prompts]
+    engine.run_until_idle()
+    stats = engine.stats()
+    assert stats["preemptions"] > 0
+    # The preempted request's readmission found its own pages warm.
+    assert any(h.warm_pages > 0 for h in handles)
+    _assert_parity(engine, tiny_variables, prompts, [12, 12], handles)
+
+
+def test_loadgen_prefix_share_trace_is_seeded_and_shared():
+    loadgen = _load_example("serving_loadgen")
+    kw = dict(requests=12, rate=0.0, min_prompt=40, max_prompt=64,
+              min_new=4, max_new=8, vocab_size=512, prefix_share=3,
+              prefix_len=32)
+    a = loadgen.build_trace(seed=11, **kw)
+    b = loadgen.build_trace(seed=11, **kw)
+    for (ta, pa, na), (tb, pb, nb) in zip(a, b):
+        assert ta == tb and na == nb
+        np.testing.assert_array_equal(pa, pb)
+    # Exactly 3 distinct shared prefixes, cycling round-robin.
+    firsts = [tuple(p[:32]) for _, p, _ in a]
+    assert len(set(firsts)) == 3
+    assert firsts[0] == firsts[3] == firsts[6]
+    # Tails unique and totals within bounds.
+    assert len({tuple(p[32:]) for _, p, _ in a}) == 12
+    assert all(40 <= len(p) <= 64 for _, p, _ in a)
