@@ -272,6 +272,25 @@ FULL_ROWS = {
         "script": "examples/wire_bandwidth_probe.py",
         "args": ["--out", "artifacts/allreduce_bandwidth_r10.json"],
         "json": True},
+    # Hierarchical wire-compression row (round 12): the two-level plane
+    # on a 4-rank 2x2 layout with the cross-node links emulated at
+    # 0.2 Gbit/s — cross-int8 vs uncompressed-hier vs the r10-style
+    # compressed flat ring on the same modeled fabric, with per-link
+    # byte proofs. Refreshes artifacts/allreduce_bandwidth_r12.json.
+    "allreduce_bandwidth_hier_4rank": {
+        "script": "examples/wire_bandwidth_probe.py",
+        "args": ["--hierarchical", "--sizes-mib", "16,64", "--reps", "5",
+                 "--out", "artifacts/allreduce_bandwidth_r12.json"],
+        "json": True},
+    # Backward-order bucket scheduling row (round 12): gradient
+    # allreduces launch per bucket while the simulated backward still
+    # runs (2-rank native engine); the row's overlap_efficiency field is
+    # the measured fraction of the backward window with a reduction in
+    # flight. Refreshes artifacts/overlap_r12.json.
+    "grad_overlap_bucketed_2rank": {
+        "script": "examples/overlap_probe.py",
+        "args": ["--out", "artifacts/overlap_r12.json"],
+        "json": True},
     "resnet50_b128": None,  # runs child_bench (median of 5 windows)
     "vit_s16_224_b64_adamw_spc8": {
         "script": "examples/jax_vit_training.py",

@@ -20,6 +20,20 @@ measured path is exactly the production one (quantize + residual capture).
 
 Writes ``artifacts/allreduce_bandwidth_r10.json`` via ``--out``; the last
 stdout line is a JSON summary for the ``bench.py --full`` row.
+
+``--hierarchical`` (round 12) instead probes the TWO-LEVEL data plane on
+a 4-rank 2x2 (local x cross) layout: a local ring inside each simulated
+node, a cross ring of the node roots, and — for the flat baselines — the
+flat 4-ring whose node-crossing edges are the same slow links. Because
+loopback has no slow hop, the cross-node links are EMULATED with the
+ring's token-bucket send cap (``hvd_ringh_set_rate``, ``--cross-gbps``,
+default 0.2 Gbit/s — slow enough that the modeled wire, not loopback's
+shared-CPU memcpy, dominates every mode), applied
+identically to the hierarchical cross ring and to the flat ring's two
+node-crossing edges, so the four modes compete on the same modeled
+fabric. Per-link wire counters (hvd_ring_get_wire_stats_link) prove the
+cross hop carries int8 bytes while the local hop stays f32. Writes
+``artifacts/allreduce_bandwidth_r12.json``.
 """
 
 import argparse
@@ -53,8 +67,15 @@ def _parse_args(argv=None):
     p.add_argument("--chunks-kib", default="256,1024")
     p.add_argument("--reps", type=int, default=7)
     p.add_argument("--out", default=None, help="artifact JSON path")
+    p.add_argument("--hierarchical", action="store_true",
+                   help="probe the two-level plane on a 4-rank 2x2 layout")
+    p.add_argument("--cross-gbps", type=float, default=0.2,
+                   help="emulated cross-node link rate (Gbit/s, send cap "
+                        "per connection; --hierarchical only)")
     p.add_argument("--child", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--addrs", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--local-addrs", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--cross-addrs", default=None, help=argparse.SUPPRESS)
     return p.parse_args(argv)
 
 
@@ -97,20 +118,119 @@ def child_main(args):
     ring.shutdown()
 
 
+def _link_delta(before, after, link):
+    row_b, row_a = before["by_link"][link], after["by_link"][link]
+    return {dtype: row_a["tx_bytes"][dtype] - row_b["tx_bytes"][dtype]
+            for dtype in row_a["tx_bytes"]}
+
+
+def child_hier_main(args):
+    """One of 4 ranks on the 2x2 layout: group = rank // 2 (simulated
+    node), local = rank % 2, roots = local 0. Modes probed per payload:
+    flat/none, flat/int8 (r10's compressed flat ring on the same modeled
+    fabric), hier/none, hier/int8-on-cross — every mode's allreduce is a
+    sum over all 4 ranks, so effective bandwidth rows are comparable."""
+    from horovod_tpu.core import bindings
+
+    rank, size = args.child, 4
+    group, local = rank // 2, rank % 2
+    rate = args.cross_gbps * 1e9 / 8.0
+    flat = bindings.RingBackend(rank, size, args.addrs, b"wire-bandwidth")
+    if rank in (1, 3):
+        # The flat ring's node-crossing edges (1->2 and 3->0): same
+        # emulated fabric as the hierarchical cross ring below.
+        flat.set_rate(rate)
+    local_ring = bindings.RingBackend(
+        local, 2, args.local_addrs.split(";")[group], b"wire-bandwidth")
+    local_ring.set_link("local")
+    cross = None
+    if local == 0:
+        cross = bindings.RingBackend(group, 2, args.cross_addrs,
+                                     b"wire-bandwidth")
+        cross.set_link("cross")
+        cross.set_rate(rate)
+
+    def hier_allreduce(buf, wire_code, residual):
+        local_ring.allreduce_(buf, False)
+        if cross is not None:
+            cross.allreduce_(buf, False, wire_dtype=wire_code,
+                             residual=residual)
+        local_ring.broadcast_(buf, 0)
+
+    rows = []
+    proofs = {}
+    for mib in [int(s) for s in args.sizes_mib.split(",")]:
+        n = mib * (1 << 20) // 4
+        base = np.random.RandomState(0).randn(n).astype(np.float32)
+        for mode, wire in (("flat", "none"), ("flat", "int8"),
+                           ("hier", "none"), ("hier", "int8")):
+            code = bindings.WIRE_DTYPE_CODES[wire]
+            residual = (np.zeros(n, np.float32) if wire == "int8" else None)
+            buf = base.copy()
+            run = (lambda: flat.allreduce_(buf, False, wire_dtype=code,
+                                           residual=residual)) \
+                if mode == "flat" else \
+                (lambda: hier_allreduce(buf, code, residual))
+            run()  # warmup: connections + scratch
+            before = bindings.wire_stats()
+            times = []
+            for _ in range(args.reps):
+                t0 = time.perf_counter()
+                run()
+                times.append(time.perf_counter() - t0)
+            after = bindings.wire_stats()
+            median = sorted(times)[len(times) // 2]
+            alg_bytes = 2 * (size - 1) / size * buf.nbytes
+            rows.append({
+                "payload_mib": mib, "mode": mode, "wire": wire,
+                "effective_GB_s": round(alg_bytes / median / 1e9, 3),
+                "step_ms": round(median * 1e3, 2),
+            })
+            if rank == 0 and mode == "hier":
+                # Per-link byte proof for the artifact: what THIS mode
+                # put on each hop (rank 0 = a local member and a root).
+                proofs[f"{mib}mib_{wire}"] = {
+                    "local_tx_delta": _link_delta(before, after, "local"),
+                    "cross_tx_delta": _link_delta(before, after, "cross"),
+                }
+    if rank == 0:
+        print("WIREBW " + json.dumps({
+            "rows": rows, "link_proofs": proofs,
+            "wire_stats": bindings.wire_stats()}), flush=True)
+    if cross is not None:
+        cross.shutdown()
+    local_ring.shutdown()
+    flat.shutdown()
+
+
 def main(argv=None):
     args = _parse_args(argv)
     if args.child is not None:
-        child_main(args)
+        if args.hierarchical:
+            child_hier_main(args)
+        else:
+            child_main(args)
         return
     # Build once in the parent so N children don't race the compiler.
     from horovod_tpu.core import bindings
 
     if bindings.load() is None:
         raise SystemExit("native core unavailable (no toolchain)")
+    if args.hierarchical:
+        args.ranks = 4  # the 2x2 layout is the probe's whole point
     addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(args.ranks))
     passthrough = ["--ranks", str(args.ranks), "--sizes-mib", args.sizes_mib,
                    "--wire", args.wire, "--chunks-kib", args.chunks_kib,
                    "--reps", str(args.reps)]
+    if args.hierarchical:
+        local_addrs = ";".join(
+            ",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+            for _ in range(2))
+        cross_addrs = ",".join(
+            f"127.0.0.1:{_free_port()}" for _ in range(2))
+        passthrough += ["--hierarchical", "--cross-gbps",
+                        str(args.cross_gbps), "--local-addrs", local_addrs,
+                        "--cross-addrs", cross_addrs]
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child", str(r),
          "--addrs", addrs] + passthrough,
@@ -137,6 +257,10 @@ def main(argv=None):
         sys.stderr.write(outs[0])
         raise SystemExit("rank 0 produced no WIREBW record")
     rows = payload["rows"]
+
+    if args.hierarchical:
+        _hier_summary(args, rows, payload)
+        return
 
     # Best chunk per (size, wire) — what a converged autotuner delivers —
     # and the headline speedups vs the uncompressed path at each size.
@@ -188,6 +312,82 @@ def main(argv=None):
                     "scalar) is compute-bound on this substrate; its "
                     "4x wire reduction pays off on links slower than "
                     "~2 GB/s. Box pace swings +-20% between runs."),
+            },
+            **summary,
+        }
+        out_path = os.path.join(REPO, args.out) \
+            if not os.path.isabs(args.out) else args.out
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(f"wrote {out_path}", file=sys.stderr)
+    print(json.dumps(summary))
+
+
+def _hier_summary(args, rows, payload):
+    """Summary + artifact for the 2x2 two-level probe: per-size speedups
+    of the cross-compressed hierarchical path over (a) the uncompressed
+    hierarchical path and (b) the r10-style compressed FLAT ring on the
+    same emulated fabric, plus the per-link byte proofs."""
+    by_key = {(r["payload_mib"], r["mode"], r["wire"]): r for r in rows}
+    speedups = {}
+    for mib in sorted({r["payload_mib"] for r in rows}):
+        hier_i8 = by_key.get((mib, "hier", "int8"))
+        hier_f32 = by_key.get((mib, "hier", "none"))
+        flat_i8 = by_key.get((mib, "flat", "int8"))
+        flat_f32 = by_key.get((mib, "flat", "none"))
+        if hier_i8 and hier_f32:
+            speedups[f"hier_int8_vs_hier_none_at_{mib}mib"] = round(
+                hier_i8["effective_GB_s"] / hier_f32["effective_GB_s"], 3)
+        if hier_i8 and flat_i8:
+            speedups[f"hier_int8_vs_flat_int8_at_{mib}mib"] = round(
+                hier_i8["effective_GB_s"] / flat_i8["effective_GB_s"], 3)
+        if hier_i8 and flat_f32:
+            speedups[f"hier_int8_vs_flat_none_at_{mib}mib"] = round(
+                hier_i8["effective_GB_s"] / flat_f32["effective_GB_s"], 3)
+    summary = {
+        "ranks": args.ranks,
+        "layout": "2x2 (2 simulated nodes x 2 local ranks)",
+        "cross_gbps_emulated": args.cross_gbps,
+        "rows": rows,
+        "speedups": speedups,
+        "link_proofs": payload["link_proofs"],
+        "wire_stats_rank0": payload["wire_stats"],
+    }
+    if args.out:
+        artifact = {
+            "what": ("Round-12 hierarchical wire compression: per-link "
+                     "wire dtypes on the two-level (local x cross) data "
+                     "plane, probed on a 4-rank 2x2 layout. The cross "
+                     "hop (and the flat baseline's two node-crossing "
+                     "edges) is rate-capped to %.2f Gbit/s via the "
+                     "ring's token-bucket send cap to model a slow "
+                     "inter-node link on a loopback box; int8+EF rides "
+                     "ONLY the cross hop (link_proofs: local hop stays "
+                     "f32). Effective bandwidth = 2(n-1)/n * payload / "
+                     "median step time over %d reps, n=4 for every row."
+                     % (args.cross_gbps, args.reps)),
+            "round": 12,
+            "cmd": ("python examples/wire_bandwidth_probe.py "
+                    "--hierarchical --sizes-mib " + args.sizes_mib),
+            "substrate": {
+                "transport": ("loopback TCP, shared cores; cross-node "
+                              "links EMULATED by a deterministic "
+                              "send-side token bucket (the only slow-"
+                              "link model available without a second "
+                              "host)"),
+                "host": platform.platform(),
+                "cpus": os.cpu_count(),
+                "honest_read": (
+                    "The emulated link rate dominates every row, so the "
+                    "mode RANKING is robust to the box's +-20% pace "
+                    "swings, but absolute GB/s are properties of the "
+                    "emulation, not of any real fabric. On real DCN the "
+                    "local/cross bandwidth gap is larger than loopback "
+                    "can model, which favors the hierarchical path "
+                    "further. int8 quantization (~0.6 Gelem/s scalar) "
+                    "is fully hidden behind the capped wire here, as it "
+                    "would be on a real slow link."),
             },
             **summary,
         }

@@ -69,3 +69,8 @@ from . import doctor  # noqa: F401  (hvd.doctor.report() / rule catalog)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State, docs/elastic.md)
 from . import serving  # noqa: F401  (hvd.serving.serve / stats, docs/serving.md)
 from .common import profiler  # noqa: F401
+from .controller.bucket_scheduler import (  # noqa: F401
+    BucketScheduler,
+    partition_buckets,
+    plan_from_compiled,
+)

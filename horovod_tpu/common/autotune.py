@@ -141,10 +141,17 @@ CATEGORICAL_KNOBS = ("hierarchical_allreduce", "hierarchical_allgather",
 # (docs/wire-compression.md); it only joins the search when the caller
 # provides an initial value (a job without the native ring has no chunk
 # to tune).
-CONTINUOUS_KNOBS = ("fusion_threshold", "cycle_time", "ring_chunk")
+CONTINUOUS_KNOBS = ("fusion_threshold", "cycle_time", "ring_chunk",
+                    "bucket_bytes")
 # log2-bytes box for the ring chunk: 64 KiB .. 2 MiB, bracketing the
 # per-link-class defaults (config.RING_CHUNK_BYTES_BY_LINK).
 RING_CHUNK_LOG2_BOUNDS = (16.0, 21.0)
+# log2-bytes box for the backward-order gradient bucket (round 12,
+# docs/overlap.md): 2 MiB .. 64 MiB, bracketing the 8 MiB default —
+# small buckets launch reductions earlier (more overlap), big buckets
+# amortize negotiation; the sweet spot is workload-dependent, which is
+# why it joins the search.
+BUCKET_BYTES_LOG2_BOUNDS = (21.0, 26.0)
 
 
 class ParameterManager:
@@ -184,7 +191,8 @@ class ParameterManager:
                  tune_hierarchical: bool = False,
                  hierarchical: bool = False,
                  straggler_weight: float = 0.0,
-                 ring_chunk_bytes: Optional[int] = None):
+                 ring_chunk_bytes: Optional[int] = None,
+                 bucket_bytes: Optional[int] = None):
         # Legacy spelling (round-3 callers/tests): hierarchical allreduce
         # only, tuned iff tune_hierarchical.
         if categoricals is None:
@@ -198,9 +206,17 @@ class ParameterManager:
         # search (and its exact behavior) bit for bit.
         self._tune_chunk = (ring_chunk_bytes is not None
                             and "ring_chunk" not in self.fixed)
+        # Gradient-bucket size (round 12) joins on the same terms: only
+        # when the caller supplies an initial value and the env didn't
+        # pin it — jobs without the bucket scheduler keep their exact
+        # search box.
+        self._tune_bucket = (bucket_bytes is not None
+                             and "bucket_bytes" not in self.fixed)
         bounds = [(20.0, 28.0), (1.0, 25.0)]  # (log2 fusion bytes, cycle ms)
         if self._tune_chunk:
             bounds.append(RING_CHUNK_LOG2_BOUNDS)  # log2 chunk bytes
+        if self._tune_bucket:
+            bounds.append(BUCKET_BYTES_LOG2_BOUNDS)  # log2 bucket bytes
         self._bo = BayesianOptimizer(bounds, seed=seed)
         # Exact pinned values for fixed knobs: a log2/2** round trip would
         # drift a non-power-of-two user threshold.
@@ -211,6 +227,9 @@ class ParameterManager:
         self.ring_chunk_bytes = (int(ring_chunk_bytes)
                                  if ring_chunk_bytes is not None else None)
         self.best_ring_chunk_bytes = self.ring_chunk_bytes
+        self.bucket_bytes = (int(bucket_bytes)
+                             if bucket_bytes is not None else None)
+        self.best_bucket_bytes = self.bucket_bytes
         self.categoricals = {k: bool(v) for k, v in categoricals.items()}
         self._warmup_left = self.WARMUP_SAMPLES
         self._scores: List[float] = []
@@ -253,7 +272,7 @@ class ParameterManager:
         if self._completed:
             return False
         cats_active = bool(self._cat_order) and not self._cats_converged
-        continuous_active = self._tune_chunk or not (
+        continuous_active = self._tune_chunk or self._tune_bucket or not (
             {"fusion_threshold", "cycle_time"} <= self.fixed)
         return cats_active or continuous_active
 
@@ -342,17 +361,22 @@ class ParameterManager:
         params = [np.log2(self.fusion_threshold), self.cycle_time_ms]
         if self._tune_chunk:
             params.append(np.log2(self.ring_chunk_bytes))
+        if self._tune_bucket:
+            params.append(np.log2(self.bucket_bytes))
         self._bo.add_sample(tuple(params), score)
         if score > self._best_score:
             self._best_score = score
             self.best_fusion_threshold = self.fusion_threshold
             self.best_cycle_time_ms = self.cycle_time_ms
             self.best_ring_chunk_bytes = self.ring_chunk_bytes
+            self.best_bucket_bytes = self.bucket_bytes
             self.best_categoricals = dict(self.categoricals)
             self.best_objective = dict(self.last_objective)
         if self._log_path:
             cat_items = sorted(self.categoricals.items())
             chunk_col = f",{self.ring_chunk_bytes}" if self._tune_chunk \
+                else ""
+            bucket_col = f",{self.bucket_bytes}" if self._tune_bucket \
                 else ""
             with open(self._log_path, "a") as f:
                 if self._log_header_due:
@@ -363,6 +387,8 @@ class ParameterManager:
                     if f.tell() == 0:
                         chunk_hdr = (",ring_chunk_bytes"
                                      if self._tune_chunk else "")
+                        chunk_hdr += (",bucket_bytes"
+                                      if self._tune_bucket else "")
                         f.write("time,fusion_threshold,cycle_time_ms"
                                 + chunk_hdr + ","
                                 + ",".join(k for k, _ in cat_items)
@@ -374,7 +400,8 @@ class ParameterManager:
                 # Log-row wall stamp, read next to other logs — not
                 # duration math. hvdlint: disable=HVD004
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
-                        f"{self.cycle_time_ms:.3f}{chunk_col},{cats},"
+                        f"{self.cycle_time_ms:.3f}{chunk_col}{bucket_col},"
+                        f"{cats},"
                         f"{throughput:.1f},{w * slack_frac:.6f},"
                         f"{w * wait_frac:.6f},{score:.1f}\n")
 
@@ -390,6 +417,7 @@ class ParameterManager:
             self.fusion_threshold = self.best_fusion_threshold
             self.cycle_time_ms = self.best_cycle_time_ms
             self.ring_chunk_bytes = self.best_ring_chunk_bytes
+            self.bucket_bytes = self.best_bucket_bytes
             self.categoricals = dict(self.best_categoricals)
             if self._log_path:
                 with open(self._log_path, "a") as f:
@@ -409,8 +437,12 @@ class ParameterManager:
         self.cycle_time_ms = (
             self._initial_cycle_ms if "cycle_time" in self.fixed
             else float(nxt[1]))
+        idx = 2
         if self._tune_chunk:
-            self.ring_chunk_bytes = int(2 ** nxt[2])
+            self.ring_chunk_bytes = int(2 ** nxt[idx])
+            idx += 1
+        if self._tune_bucket:
+            self.bucket_bytes = int(2 ** nxt[idx])
         self._scores = []
         self._slack_fracs = []
         self._wait_fracs = []
@@ -441,6 +473,11 @@ class ParameterManager:
             "best_ring_chunk_bytes": (int(self.best_ring_chunk_bytes)
                                       if self.best_ring_chunk_bytes
                                       is not None else None),
+            "bucket_bytes": (int(self.bucket_bytes)
+                             if self.bucket_bytes is not None else None),
+            "best_bucket_bytes": (int(self.best_bucket_bytes)
+                                  if self.best_bucket_bytes is not None
+                                  else None),
             "straggler_weight": self.straggler_weight,
             "last_objective": self.last_objective,
             "best_objective": self.best_objective,

@@ -160,6 +160,19 @@ def cross_ring_addrs() -> Optional[str]:
 # as (docs/wire-compression.md); must match core.bindings.WIRE_DTYPE_CODES.
 RING_WIRE_DTYPES = ("none", "bf16", "fp16", "int8")
 
+# Default wire dtype per link class — RING_CHUNK_BYTES_BY_LINK's sibling
+# table (docs/wire-compression.md). Fast intra-node/ICI links lose more
+# to the cast kernels than the saved bytes buy back, so they default to
+# the untouched f32 stream; DCN/TCP-class links are exactly where
+# int8+error-feedback pays (the reference's cross-node hop,
+# nccl_operations.cc:167-363).
+RING_WIRE_DTYPE_BY_LINK = {
+    "local": "none",
+    "ici": "none",
+    "tcp": "int8",
+    "dcn": "int8",
+}
+
 # Default transfer-chunk bytes per link class (docs/wire-compression.md):
 # loopback wants big chunks (syscall overhead dominates, no real wire to
 # overlap with), plain TCP keeps the round-3 256 KiB sweet spot, DCN-class
@@ -182,6 +195,69 @@ def ring_wire_dtype() -> str:
     every rank (launcher-exported, like the other ring knobs)."""
     val = (os.environ.get("HOROVOD_RING_WIRE_DTYPE") or "").strip().lower()
     return val if val in RING_WIRE_DTYPES else "none"
+
+
+def _link_class_env(name: str) -> Optional[str]:
+    """A *_LINK_CLASS env value when valid, else None (garbage falls back
+    to the caller's inference path, never crashes)."""
+    val = (os.environ.get(name) or "").strip().lower()
+    return val if val in RING_CHUNK_BYTES_BY_LINK else None
+
+
+def local_ring_link_class() -> str:
+    """``HOROVOD_LOCAL_RING_LINK_CLASS``: link class of the hierarchical
+    plane's intra-node ring. Unset/garbage -> inferred from the
+    launcher-exported local ring addresses (same-host ranks are loopback,
+    hence ``local``); operators on ICI fabrics export it explicitly."""
+    val = _link_class_env("HOROVOD_LOCAL_RING_LINK_CLASS")
+    if val is not None:
+        return val
+    from ..run.nic_discovery import infer_link_class
+
+    return infer_link_class(local_ring_addrs())
+
+
+def cross_ring_link_class() -> str:
+    """``HOROVOD_CROSS_RING_LINK_CLASS``: link class of the hierarchical
+    plane's inter-node ring (the local roots' ring). Unset/garbage ->
+    inferred from the cross ring addresses — anything spanning hosts is
+    ``tcp``; known DCN fabrics export the class explicitly (the chunk
+    table AND the wire-dtype table key off it)."""
+    val = _link_class_env("HOROVOD_CROSS_RING_LINK_CLASS")
+    if val is not None:
+        return val
+    from ..run.nic_discovery import infer_link_class
+
+    return infer_link_class(cross_ring_addrs())
+
+
+def _wire_dtype_for(env_name: str, link_class: str) -> str:
+    """Shared resolver for the per-link wire dtypes: an explicit valid
+    env value wins; unset/garbage falls back to the link-class default
+    (``RING_WIRE_DTYPE_BY_LINK``), never to a crash."""
+    val = (os.environ.get(env_name) or "").strip().lower()
+    if val in RING_WIRE_DTYPES:
+        return val
+    return RING_WIRE_DTYPE_BY_LINK[link_class]
+
+
+def ring_wire_dtype_local() -> str:
+    """``HOROVOD_RING_WIRE_DTYPE_LOCAL``: on-the-wire representation of
+    f32 allreduce payloads on the hierarchical plane's LOCAL (intra-node)
+    ring. Default by link class: local/ici -> ``none`` (the fast hop —
+    cast kernels cost more than the bytes they save), tcp/dcn -> ``int8``.
+    Launcher-exported, identical on every rank (like every ring knob)."""
+    return _wire_dtype_for("HOROVOD_RING_WIRE_DTYPE_LOCAL",
+                           local_ring_link_class())
+
+
+def ring_wire_dtype_cross() -> str:
+    """``HOROVOD_RING_WIRE_DTYPE_CROSS``: wire dtype for the hierarchical
+    plane's CROSS ring (local roots, the slow inter-node hop — exactly
+    where int8+error-feedback pays most; docs/wire-compression.md).
+    Default by link class: tcp/dcn -> ``int8``, local/ici -> ``none``."""
+    return _wire_dtype_for("HOROVOD_RING_WIRE_DTYPE_CROSS",
+                           cross_ring_link_class())
 
 
 def ring_chunk_bytes() -> int:
@@ -220,6 +296,32 @@ def resolved_ring_chunk_bytes() -> int:
     if explicit:
         return explicit
     return RING_CHUNK_BYTES_BY_LINK[ring_link_class()]
+
+
+# Default gradient-bucket size for the backward-order bucket scheduler
+# (docs/overlap.md): big enough that per-bucket negotiation overhead
+# amortizes, small enough that the first reduction launches while most of
+# the backward pass is still running (the reference's fusion-buffer cycle
+# achieves the same balance with its 64 MiB buffer + 5 ms cycle).
+DEFAULT_BUCKET_BYTES = 8 * 1024 * 1024
+
+
+def bucket_bytes() -> int:
+    """``HOROVOD_BUCKET_BYTES``: size bound for the backward-order
+    gradient buckets (controller/bucket_scheduler.py). 0 (default, and
+    for garbage) means auto — the 8 MiB default, and the knob joins the
+    GP autotuner's search when ``HOROVOD_AUTOTUNE`` is on. An explicit
+    positive value pins the knob (``fixed=`` semantics, like
+    HOROVOD_RING_CHUNK_BYTES)."""
+    return max(0, _env_int("HOROVOD_BUCKET_BYTES", 0))
+
+
+def resolved_bucket_bytes() -> int:
+    """The bucket size the scheduler should start at: the explicit env
+    value, or the default. One resolver so the scheduler, the autotuner
+    seeding, and docs agree."""
+    explicit = bucket_bytes()
+    return explicit if explicit else DEFAULT_BUCKET_BYTES
 
 
 def cpu_ops() -> str:

@@ -25,6 +25,7 @@ _FIXING_ENV = {
     "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
     "cycle_time": "HOROVOD_CYCLE_TIME",
     "ring_chunk": "HOROVOD_RING_CHUNK_BYTES",
+    "bucket_bytes": "HOROVOD_BUCKET_BYTES",
     "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
     "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
     "cache_enabled": "HOROVOD_CACHE_CAPACITY",
@@ -34,7 +35,8 @@ _FIXING_ENV = {
 def make_parameter_manager(config: Config,
                            tune_hierarchical: bool = False,
                            tune_cache: bool = False,
-                           tune_ring_chunk: bool = False) -> ParameterManager:
+                           tune_ring_chunk: bool = False,
+                           tune_bucket: bool = False) -> ParameterManager:
     fixed = {knob for knob, env in sorted(_FIXING_ENV.items())
              if env in os.environ}
     if not tune_hierarchical:
@@ -61,6 +63,17 @@ def make_parameter_manager(config: Config,
             # all parse to 0, the documented join-the-search sentinel) —
             # only an explicit positive value pins the knob.
             fixed.discard("ring_chunk")
+    bucket = None
+    if tune_bucket:
+        # The gradient-bucket size (docs/overlap.md) joins on the ring
+        # chunk's exact terms: seeded at the resolved value, pinned only
+        # by an explicit positive HOROVOD_BUCKET_BYTES.
+        from ..common.config import bucket_bytes as bucket_bytes_env
+        from ..common.config import resolved_bucket_bytes
+
+        bucket = resolved_bucket_bytes()
+        if bucket_bytes_env() == 0:
+            fixed.discard("bucket_bytes")
     return ParameterManager(
         fusion_threshold=config.fusion_threshold_bytes,
         cycle_time_ms=config.cycle_time_ms,
@@ -73,6 +86,7 @@ def make_parameter_manager(config: Config,
         fixed=fixed,
         straggler_weight=autotune_straggler_weight(),
         ring_chunk_bytes=ring_chunk,
+        bucket_bytes=bucket,
     )
 
 

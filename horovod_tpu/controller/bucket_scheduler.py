@@ -1,0 +1,375 @@
+"""Backward-order gradient bucket scheduling (round 12, ROADMAP item 3).
+
+The reference's 90%-at-512-devices claim rests on overlapping gradient
+reduction with backward compute: its background thread reduces tensors as
+autograd produces them, packed into a fusion buffer per cycle
+(``horovod/common/operations.cc`` cycle loop). On the eager tier here the
+machinery below closes the same loop *ahead of time*: the compiled HLO
+schedule already says in which order the backward pass produces each
+gradient group (``utils.overlap.sync_collective_placement`` — fixed in
+r10 to identify hvd's own all-reduces by op_name marker), so the bucket
+plan is derived once from the schedule, and at step time each bucket's
+allreduce is enqueued the moment its producers complete instead of
+waiting for the full gradient pytree.
+
+Two pieces:
+
+* :func:`partition_buckets` / :func:`plan_from_compiled` — pure planning:
+  gradient tensors in backward production order, packed into consecutive
+  size-bounded buckets (the reference's fusion-buffer cycle, derived
+  statically).
+* :class:`BucketScheduler` — the driver: call :meth:`grad_ready` as each
+  gradient materializes; a full bucket launches immediately (every tensor
+  in it enqueued in one shot, so the engine's Tensor Fusion packs them
+  into one wire collective — the bucket is the *launch* unit, fusion
+  stays the *wire* unit); :meth:`finish` flushes the tail, waits, and
+  reports the measured ``overlap_efficiency`` — the fraction of the
+  backward window during which at least one reduction was in flight,
+  computed by the SAME union formula the scaling model predicts with
+  (``utils.scaling_model.overlap_efficiency_from_events``), so model and
+  measurement are directly comparable.
+
+Works against either controller (they share the async surface); the
+compressed wire (docs/wire-compression.md) applies underneath unchanged —
+buckets launch *compressed* allreduces when the wire dtype says so, and
+the per-name error-feedback residuals keep working because bucket
+launches preserve the caller's stable gradient names.
+
+Knobs: ``HOROVOD_BUCKET_BYTES`` (0 = auto, joins the GP autotuner —
+docs/autotune.md); metrics: ``hvd_overlap_buckets_total``,
+``hvd_overlap_efficiency`` (docs/overlap.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import metrics
+from ..common.config import resolved_bucket_bytes
+from ..utils.scaling_model import (
+    BucketEvent,
+    GradGroup,
+    measured_overlap_report,
+)
+
+# Autotuner override (rank 0 pushes the GP's current value here, the way
+# it pushes the ring chunk into the native core). None = use the
+# env/default resolution.
+_autotuned_bucket_bytes: Optional[int] = None
+
+
+def set_autotuned_bucket_bytes(nbytes: Optional[int]) -> None:
+    """Push a tuned bucket size (None restores the env/default value).
+    PROCESS-local: the native tune loop runs on rank 0 only, so on a
+    multi-rank job other ranks keep the env/default value until an
+    operator pins ``HOROVOD_BUCKET_BYTES``. That skew is safe — bucket
+    boundaries only shape WHEN this rank enqueues; the engine's
+    negotiation launches each collective once every rank has enqueued
+    it, whatever the local grouping — but it blunts the GP's signal on
+    multi-rank jobs (docs/overlap.md records the limitation; shipping
+    the tuned value over the synced cycle reply is future work). Safe
+    to retune live: the size never touches the wire format."""
+    global _autotuned_bucket_bytes
+    _autotuned_bucket_bytes = int(nbytes) if nbytes else None
+
+
+def current_bucket_bytes() -> int:
+    """The size bound a new scheduler starts with: autotuner override,
+    else the HOROVOD_BUCKET_BYTES/default resolution."""
+    if _autotuned_bucket_bytes is not None:
+        return _autotuned_bucket_bytes
+    return resolved_bucket_bytes()
+
+
+@dataclasses.dataclass
+class Bucket:
+    """One launch unit: consecutive gradients in backward production
+    order whose payload fits the size bound."""
+
+    index: int
+    names: List[str]
+    payload_bytes: int
+
+
+def partition_buckets(entries: Sequence[Tuple[str, int]],
+                      bucket_bytes: int) -> List[Bucket]:
+    """Pack ``(name, payload_bytes)`` pairs — already in backward
+    production order — into consecutive size-bounded buckets. A bucket
+    closes when adding the next tensor would exceed the bound; a single
+    tensor larger than the bound gets its own bucket (it cannot be
+    split — the wire layer's chunking handles big payloads). Degenerate
+    cases: empty input -> no buckets; bound so large everything fits ->
+    one bucket (the unbucketed fall-back, bit-identical by
+    construction)."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be positive, got {bucket_bytes}")
+    buckets: List[Bucket] = []
+    names: List[str] = []
+    total = 0
+    for name, nbytes in entries:
+        if names and total + int(nbytes) > bucket_bytes:
+            buckets.append(Bucket(len(buckets), names, total))
+            names, total = [], 0
+        names.append(str(name))
+        total += int(nbytes)
+    if names:
+        buckets.append(Bucket(len(buckets), names, total))
+    return buckets
+
+
+@dataclasses.dataclass
+class BucketPlan:
+    """A schedule-derived plan plus the scaling model's inputs for the
+    same gradients, so measured overlap can be validated against the
+    model's prediction (``utils.scaling_model.predicted_bucket_events``)."""
+
+    buckets: List[Bucket]
+    groups: List[GradGroup]
+    bucket_bytes: int
+
+    @property
+    def order(self) -> List[str]:
+        return [n for b in self.buckets for n in b.names]
+
+
+def plan_from_compiled(compiled_or_text: Any,
+                       bucket_bytes: Optional[int] = None,
+                       min_bytes: int = 1 << 16) -> BucketPlan:
+    """Derive the bucket plan from a compiled module's schedule: every
+    gradient all-reduce (hvd's op_name marker, or the size heuristic for
+    unmarked schedules — the exact filter
+    ``scaling_model.groups_from_overlap_report`` applies) in schedule
+    order, which for a scheduled TPU module IS backward production
+    order. Tensor names come from the op_name metadata when present
+    (stable across steps — the error-feedback residual key), else a
+    positional ``grad.<i>``."""
+    from ..utils import overlap as overlap_mod
+    from ..utils.scaling_model import (
+        GRADIENT_MARKER,
+        groups_from_overlap_report,
+    )
+
+    report = overlap_mod.overlap_report(compiled_or_text)
+    entries: List[Tuple[str, int]] = []
+    groups: List[GradGroup] = []
+    for i, s in enumerate(report["sync_collectives"]):
+        if s["opcode"] != "all-reduce":
+            continue
+        marked = GRADIENT_MARKER in s.get("op_name", "")
+        if not marked and s["payload_bytes"] < min_bytes:
+            continue
+        name = s.get("op_name") or f"grad.{i}"
+        entries.append((name, s["payload_bytes"]))
+        groups.append(GradGroup(s["payload_bytes"], s["compute_after_frac"]))
+    # Cross-check against the model's own filter: the two consume the
+    # same report, so a drift here means the filter rules forked.
+    model_groups = groups_from_overlap_report(report, min_bytes=min_bytes)
+    assert len(model_groups) == len(groups), (
+        "bucket plan and scaling model disagree on the gradient set "
+        f"({len(groups)} vs {len(model_groups)}) — filter rules drifted")
+    size = bucket_bytes if bucket_bytes else current_bucket_bytes()
+    return BucketPlan(partition_buckets(entries, size), groups, size)
+
+
+class _LocalHandle:
+    """Immediately-done handle for the size-1 identity path."""
+
+    def __init__(self, array):
+        self._array = array
+
+    def done(self) -> bool:
+        return True
+
+    def wait(self):
+        return self._array
+
+
+class _LocalIdentityController:
+    """Size-1 fall-back: allreduce of one rank is the identity (sum of
+    one; the average divides by one). Mirrors the async surface the
+    schedulers drive."""
+
+    def allreduce_async(self, array, average=True, name=None):
+        return _LocalHandle(np.asarray(array))
+
+
+_m = None
+
+
+def _overlap_metrics():
+    """Lazy registration (never at import time — tests/test_metrics_lint)."""
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            buckets=metrics.counter(
+                "hvd_overlap_buckets_total",
+                "Gradient buckets launched by the backward-order bucket "
+                "scheduler."),
+            efficiency=metrics.gauge(
+                "hvd_overlap_efficiency",
+                "Measured fraction of the last backward window during "
+                "which at least one bucket reduction was in flight "
+                "(docs/overlap.md)."),
+        )
+    return _m
+
+
+class BucketScheduler:
+    """Launches gradient allreduces in backward order, bucket by bucket,
+    while the backward pass still runs.
+
+    Usage::
+
+        sched = BucketScheduler(controller)          # or bucket_bytes=...
+        sched.backward_started()                     # optional, tightens
+                                                     # the measured window
+        for name, grad in backward_in_production_order():
+            sched.grad_ready(name, grad)             # may launch a bucket
+        results, report = sched.finish()             # waits; name -> array
+
+    Results are bit-identical to one-by-one (or whole-pytree) allreduce
+    of the same named tensors — bucketing changes WHEN collectives
+    launch, never what they compute (pinned by the mp acceptance test).
+    One carve-out, inherited from the wire layer: under the int8 wire
+    dtype the quantization blocks span the FUSED buffer, so a different
+    fusion grouping (which bucketing influences, exactly like a retuned
+    fusion threshold would) shifts block boundaries and the results may
+    differ by a bounded quantization ulp — the per-name error-feedback
+    residuals compensate across steps as always
+    (docs/wire-compression.md). The scheduler is single-step state:
+    construct (or :meth:`reset`) per step."""
+
+    def __init__(self, controller: Optional[Any] = None,
+                 bucket_bytes: Optional[int] = None,
+                 average: bool = True):
+        if controller is None:
+            # The running job's controller — the surface a user script
+            # reaches for as hvd.BucketScheduler(). state() itself
+            # raises the curated "use hvd.init()" error when
+            # uninitialized.
+            from ..common import basics
+
+            controller = basics.state().controller
+            if controller is None:
+                if basics.size() == 1:
+                    # Single-process eager tier has no controller; the
+                    # sum-of-one identity keeps user scripts portable
+                    # from 1 to N ranks.
+                    controller = _LocalIdentityController()
+                else:
+                    raise ValueError(
+                        "BucketScheduler needs an eager controller: "
+                        "launch through horovodrun (which bootstraps "
+                        "it), or pass a controller explicitly")
+        self._ctl = controller
+        self.bucket_bytes = int(bucket_bytes) if bucket_bytes \
+            else current_bucket_bytes()
+        self._average = average
+        self.reset()
+
+    def reset(self) -> None:
+        self._pending: List[Tuple[str, Any]] = []
+        self._pending_bytes = 0
+        # In-flight buckets: list of dicts {handles: [(name, handle)],
+        # launch_s, complete_s (None until observed)}.
+        self._inflight: List[dict] = []
+        self._results: Dict[str, Any] = {}
+        self._t_backward_start: Optional[float] = None
+        self._t_last_ready: Optional[float] = None
+        self._buckets_launched = 0
+
+    # ------------------------------------------------------------- driving
+
+    def backward_started(self) -> None:
+        """Mark the start of backward compute. Optional: without it the
+        window opens at the first :meth:`grad_ready`, which understates
+        the overlappable compute (the pre-first-gradient stretch is
+        invisible to the scheduler)."""
+        self._t_backward_start = time.monotonic()
+
+    def grad_ready(self, name: str, array: Any) -> None:
+        """Feed one produced gradient (call in backward production
+        order). Closes and launches the current bucket when adding this
+        tensor would exceed the size bound — so the reduction of earlier
+        gradients rides concurrently with the production of later
+        ones."""
+        now = time.monotonic()
+        if self._t_backward_start is None:
+            self._t_backward_start = now
+        self._t_last_ready = now
+        self._poll_inflight(now)
+        arr = np.asarray(array)
+        if self._pending and \
+                self._pending_bytes + arr.nbytes > self.bucket_bytes:
+            self._launch()
+        self._pending.append((str(name), arr))
+        self._pending_bytes += arr.nbytes
+        if self._pending_bytes >= self.bucket_bytes:
+            self._launch()
+
+    def _launch(self) -> None:
+        if not self._pending:
+            return
+        launch_s = time.monotonic()
+        handles = [(name, self._ctl.allreduce_async(
+            arr, average=self._average, name=name))
+            for name, arr in self._pending]
+        self._inflight.append(
+            {"handles": handles, "launch_s": launch_s, "complete_s": None})
+        self._buckets_launched += 1
+        self._pending = []
+        self._pending_bytes = 0
+        if metrics.on():
+            _overlap_metrics().buckets.inc()
+
+    def _poll_inflight(self, now: float) -> None:
+        # Opportunistic completion stamping: the engine resolves handles
+        # on its background thread; observing done() here (between
+        # gradient productions) bounds the recorded complete time without
+        # blocking the backward pass.
+        for b in self._inflight:
+            if b["complete_s"] is None and \
+                    all(h.done() for _, h in b["handles"]):
+                b["complete_s"] = now
+
+    # ------------------------------------------------------------ finishing
+
+    def finish(self) -> Tuple[Dict[str, Any], dict]:
+        """Flush the tail bucket, wait for every reduction, and return
+        ``(results, report)``: reduced arrays by name, and the measured
+        overlap report (``overlap_efficiency`` et al, the shape the
+        bench row embeds). Also mirrors ``hvd_overlap_efficiency``."""
+        self._launch()
+        t_compute_end = (self._t_last_ready
+                         if self._t_last_ready is not None
+                         else time.monotonic())
+        events: List[BucketEvent] = []
+        for b in self._inflight:
+            for name, h in b["handles"]:
+                self._results[name] = h.wait()
+            if b["complete_s"] is None:
+                b["complete_s"] = time.monotonic()
+            events.append(BucketEvent(b["launch_s"], b["complete_s"]))
+        start = (self._t_backward_start
+                 if self._t_backward_start is not None else t_compute_end)
+        report = measured_overlap_report(events, start, t_compute_end)
+        report["bucket_bytes"] = self.bucket_bytes
+        report["events"] = [
+            {"launch_s": round(e.launch_s - start, 6),
+             "complete_s": round(e.complete_s - start, 6)}
+            for e in events]
+        if metrics.on():
+            _overlap_metrics().efficiency.set(report["overlap_efficiency"])
+        results = dict(self._results)
+        # Full reset: the scheduler is single-step state, and a partial
+        # cleanup would let an accidentally-reused instance silently
+        # merge stale results and stretch the overlap window across
+        # steps.
+        self.reset()
+        return results, report

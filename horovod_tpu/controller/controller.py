@@ -254,16 +254,33 @@ class Controller:
         from ..common.config import ring_wire_dtype
         from ..core.bindings import WIRE_DTYPE_CODES
 
+        def _python_engine_wire(wire: str, which: str) -> str:
+            # One downgrade rule for all three link knobs: the Python
+            # engine has no residual store, so int8 would silently change
+            # the convergence contract — keep the uncompressed stream.
+            # Warn only when the ENV explicitly asked for int8: the
+            # per-link knobs default to int8 from the link-class table,
+            # and an operator who set nothing must not be told they
+            # misconfigured something.
+            if wire == "int8":
+                explicit = (config_mod.env_str(which) or "") \
+                    .strip().lower() == "int8"
+                if explicit:
+                    logging.warning(
+                        "%s=int8 requires the native engine "
+                        "(error-feedback residuals live in "
+                        "controller/native.py); the Python engine keeps "
+                        "the uncompressed wire — set "
+                        "HOROVOD_ENGINE=native, or use bf16/fp16 here",
+                        which)
+                return "none"
+            return wire
+
         wire = ring_wire_dtype()
-        if wire == "int8":
-            if self._ring is not None:
-                logging.warning(
-                    "HOROVOD_RING_WIRE_DTYPE=int8 requires the native "
-                    "engine (error-feedback residuals live in "
-                    "controller/native.py); the Python engine keeps the "
-                    "uncompressed wire — set HOROVOD_ENGINE=native, or "
-                    "use bf16/fp16 here")
-            wire = "none"
+        if self._ring is None and wire == "int8":
+            wire = "none"  # no flat ring: nothing to warn about
+        else:
+            wire = _python_engine_wire(wire, "HOROVOD_RING_WIRE_DTYPE")
         self._wire_code = WIRE_DTYPE_CODES[wire]
 
         # Two-level (hierarchical) data plane: a ring inside each node plus a
@@ -302,10 +319,25 @@ class Controller:
                 self._local_ring = RingBackend(
                     topology.local_rank, topology.local_size, local_addrs,
                     job_secret())
+                self._local_ring.set_link("local")
                 if topology.local_rank == 0:
                     self._cross_ring = RingBackend(
                         topology.cross_rank, topology.cross_size, cross_addrs,
                         job_secret())
+                    self._cross_ring.set_link("cross")
+        # Per-link wire dtypes for the two-level plane (docs/
+        # wire-compression.md): independent knobs for the local and cross
+        # hops, int8 downgraded exactly like the flat knob above.
+        from ..common.config import (ring_wire_dtype_cross,
+                                     ring_wire_dtype_local)
+
+        self._wire_local_code = WIRE_DTYPE_CODES["none"]
+        self._wire_cross_code = WIRE_DTYPE_CODES["none"]
+        if self._local_ring is not None:
+            self._wire_local_code = WIRE_DTYPE_CODES[_python_engine_wire(
+                ring_wire_dtype_local(), "HOROVOD_RING_WIRE_DTYPE_LOCAL")]
+            self._wire_cross_code = WIRE_DTYPE_CODES[_python_engine_wire(
+                ring_wire_dtype_cross(), "HOROVOD_RING_WIRE_DTYPE_CROSS")]
         if (self._ring is not None or self._local_ring is not None
                 or self._cross_ring is not None):
             # Transfer-chunk size (explicit env or link-class default) —
@@ -1460,12 +1492,14 @@ class Controller:
             # local roots' cross ring, fan the result back out locally
             # (NCCLHierarchicalAllreduce shape, nccl_operations.cc:167-363).
             result = np.array(buf, copy=True)
-            self._local_ring.allreduce_(result, average=False)
+            self._local_ring.allreduce_(result, average=False,
+                                        wire_dtype=self._wire_local_code)
             if self.topo.local_rank == 0:
                 # The cross ring's membership IS the local roots — the
                 # rank-conditional matches the subgroup exactly, so this
                 # cannot diverge. hvdlint: disable=HVD001
-                self._cross_ring.allreduce_(result, average=False)
+                self._cross_ring.allreduce_(result, average=False,
+                                            wire_dtype=self._wire_cross_code)
             self._local_ring.broadcast_(result, 0)
         elif self._use_ring(dtype):
             # Native C++ ring (bandwidth-optimal; reduce-scatter + allgather).

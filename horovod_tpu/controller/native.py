@@ -189,11 +189,21 @@ class NativeController:
         key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
         timeline = (config.timeline_filename or "") if topology.rank == 0 else ""
         # Wire compression for the ring's allreduce data phases
-        # (docs/wire-compression.md). The dtype code rides init; the
-        # int8 error-feedback residuals live HERE, per tensor name
-        # (self._residuals) — the engine only transports the error.
+        # (docs/wire-compression.md). The flat code plus the hierarchical
+        # plane's per-link pair (local/cross — resolved from
+        # HOROVOD_RING_WIRE_DTYPE_LOCAL/_CROSS + link-class defaults) all
+        # ride init; the int8 error-feedback residuals live HERE, per
+        # tensor name (self._residuals) — the engine only transports the
+        # error, whichever hop quantized it.
+        from ..common.config import (ring_wire_dtype_cross,
+                                     ring_wire_dtype_local)
+
         self._wire_dtype = ring_wire_dtype()
         self._wire_code = bindings.WIRE_DTYPE_CODES[self._wire_dtype]
+        self._wire_local_code = bindings.WIRE_DTYPE_CODES[
+            ring_wire_dtype_local()]
+        self._wire_cross_code = bindings.WIRE_DTYPE_CODES[
+            ring_wire_dtype_cross()]
         self._residuals: Dict[str, np.ndarray] = {}
         self._warned_unnamed_int8 = False
         rc = lib.hvd_eng_init(
@@ -202,11 +212,25 @@ class NativeController:
             config.cache_capacity, 1 if config.stall_check_disable else 0,
             config.stall_check_seconds, config.stall_shutdown_seconds,
             timeline.encode(), 1 if config.timeline_mark_cycles else 0,
-            self._wire_code)
+            self._wire_code, self._wire_local_code, self._wire_cross_code)
         if rc != 0:
             raise RuntimeError(
                 "native engine init failed: "
                 + lib.hvd_eng_last_error().decode(errors="replace"))
+        # Error feedback is live when int8 rides whichever plane this
+        # job's ALLREDUCES actually take: the hierarchical local/cross
+        # hops when the two-level plane is up AND routing allreduces
+        # (hier_active alone also covers allgather-only hierarchy, whose
+        # allreduces still ride the flat ring), else the flat ring.
+        # Residual plumbing through a non-quantizing call is harmless
+        # (the ring zeroes the buffer), so the predicate only gates the
+        # bookkeeping cost, never correctness.
+        int8_code = bindings.WIRE_DTYPE_CODES["int8"]
+        if lib.hvd_eng_hier_active() and config.hierarchical_allreduce:
+            self._ef_enabled = int8_code in (self._wire_local_code,
+                                             self._wire_cross_code)
+        else:
+            self._ef_enabled = self._wire_code == int8_code
         # Transfer-chunk size: explicit env value, else the link-class
         # default (loopback/tcp/dcn/ici table). Per-rank pipelining
         # granularity only, so each rank may set — and later retune — its
@@ -225,8 +249,13 @@ class NativeController:
             # The native engine always rides the ring data plane, so the
             # ring transfer chunk joins the search (unless the env pinned
             # it); tuned values are pushed in _tune_loop.
+            # Ring transfer chunk and gradient-bucket size both join
+            # the search on the native engine (the bucket scheduler rides
+            # either controller, but its tuned value is pushed from this
+            # loop).
             self._param_manager = make_parameter_manager(
-                config, tune_ring_chunk=topology.size > 1)
+                config, tune_ring_chunk=topology.size > 1,
+                tune_bucket=True)
             self._tuner = threading.Thread(
                 target=self._tune_loop, name="hvd-native-autotune",
                 daemon=True)
@@ -361,8 +390,7 @@ class NativeController:
         # explicit (step-stable) one — autonames increment per call and
         # would leak one dead residual per step.
         residual = None
-        if self._wire_code == bindings.WIRE_DTYPE_CODES["int8"] \
-                and array.dtype == np.float32:
+        if self._ef_enabled and array.dtype == np.float32:
             doomed_duplicate = name is not None and \
                 self._name_still_pending(name)
             if name is None:
@@ -511,6 +539,11 @@ class NativeController:
                     # live, no cross-rank agreement needed (the int8 wire
                     # format is anchored on fixed quant blocks).
                     bindings.set_chunk_bytes(int(chunk))
+                bucket = self._param_manager.bucket_bytes
+                if bucket:
+                    from .bucket_scheduler import set_autotuned_bucket_bytes
+
+                    set_autotuned_bucket_bytes(int(bucket))
                 logging.debug(
                     "native autotune: threshold=%d cycle=%.2fms chunk=%s",
                     int(threshold), float(cycle_ms), chunk)

@@ -36,6 +36,15 @@ WIRE_DTYPE_CODES = {
 }
 WIRE_DTYPE_NAMES = {v: k for k, v in WIRE_DTYPE_CODES.items()}
 
+# Must match enum WireLink in ring.cc: which plane's connections a ring's
+# wire traffic rides (indexes the per-link counter rows).
+WIRE_LINK_CODES = {
+    "flat": 0,
+    "local": 1,
+    "cross": 2,
+}
+WIRE_LINK_NAMES = {v: k for k, v in WIRE_LINK_CODES.items()}
+
 # Must match enum DType in ring.cc.
 _DTYPE_CODES = {
     "float32": 0,
@@ -142,12 +151,19 @@ def loaded() -> Optional[ctypes.CDLL]:
 
 def wire_stats() -> dict:
     """Ring wire-traffic counters (hvd_ring_get_wire_stats): actual and
-    f32-equivalent bytes per wire dtype plus cumulative compress seconds.
-    All-zeros when the native core was never loaded."""
+    f32-equivalent bytes per wire dtype plus cumulative compress seconds,
+    with a per-link-class breakdown under ``by_link`` (flat/local/cross —
+    how the two-level plane proves the cross hop carries int8 while the
+    local hop stays f32). All-zeros when the native core was never
+    loaded."""
     lib = loaded()
     out = {
         "tx_bytes": {name: 0 for name in WIRE_DTYPE_CODES},
         "logical_bytes": {name: 0 for name in WIRE_DTYPE_CODES},
+        "by_link": {
+            link: {"tx_bytes": {name: 0 for name in WIRE_DTYPE_CODES},
+                   "logical_bytes": {name: 0 for name in WIRE_DTYPE_CODES}}
+            for link in WIRE_LINK_CODES},
         "compress_seconds": 0.0,
         "chunk_bytes": 0,
     }
@@ -160,6 +176,12 @@ def wire_stats() -> dict:
     for name, code in WIRE_DTYPE_CODES.items():
         out["tx_bytes"][name] = int(tx[code])
         out["logical_bytes"][name] = int(logical[code])
+    for link, lcode in WIRE_LINK_CODES.items():
+        lib.hvd_ring_get_wire_stats_link(lcode, tx, logical)
+        row = out["by_link"][link]
+        for name, code in WIRE_DTYPE_CODES.items():
+            row["tx_bytes"][name] = int(tx[code])
+            row["logical_bytes"][name] = int(logical[code])
     out["compress_seconds"] = float(comp.value)
     out["chunk_bytes"] = int(lib.hvd_ring_get_chunk_bytes())
     return out
@@ -214,6 +236,16 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_longlong),
             ctypes.POINTER(ctypes.c_double)]
         lib.hvd_ring_get_wire_stats.restype = None
+        # Round 12: per-link-class counter slice + link tagging + the
+        # send-rate cap (bandwidth-probe link emulation).
+        lib.hvd_ring_get_wire_stats_link.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvd_ring_get_wire_stats_link.restype = None
+        lib.hvd_ringh_set_link.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.hvd_ringh_set_link.restype = None
+        lib.hvd_ringh_set_rate.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.hvd_ringh_set_rate.restype = None
         lib.hvd_ring_allgather.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_long), ctypes.c_void_p,
             ctypes.c_int]
@@ -253,7 +285,8 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_double,
             ctypes.c_longlong, ctypes.c_int, ctypes.c_int, ctypes.c_double,
-            ctypes.c_double, ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
         lib.hvd_eng_init.restype = ctypes.c_int
         lib.hvd_eng_enqueue.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
@@ -376,6 +409,18 @@ class RingBackend:
         if rc != 0:
             raise RuntimeError(f"ring broadcast failed: {self._last_error()}")
         return array
+
+    def set_link(self, link) -> None:
+        """Tag this ring's link class (``WIRE_LINK_CODES`` name or code)
+        so its traffic lands in the right per-link counter row."""
+        code = WIRE_LINK_CODES.get(link, link)
+        self._lib.hvd_ringh_set_link(self._handle, int(code))
+
+    def set_rate(self, bytes_per_s: float) -> None:
+        """Cap this ring's send rate (bytes/s; 0 = unlimited). Emulation
+        knob for the bandwidth probe — models a slow cross-node link on a
+        loopback box; production jobs leave it unset."""
+        self._lib.hvd_ringh_set_rate(self._handle, float(bytes_per_s))
 
     def shutdown(self) -> None:
         if getattr(self, "_handle", None):

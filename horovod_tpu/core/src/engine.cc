@@ -74,6 +74,9 @@ void* hvd_ringh_create(int rank, int size, const char* addrs,
                        const uint8_t* secret, int secret_len);
 int hvd_ringh_allreduce(void* h, void* buf, long count, int dtype,
                         int average);
+int hvd_ringh_allreduce_wire(void* h, void* buf, long count, int dtype,
+                             int average, int wire_dtype, void* residual);
+void hvd_ringh_set_link(void* h, int link);
 int hvd_ringh_allgather(void* h, const void* in, const long* counts,
                         void* out, int dtype);
 int hvd_ringh_broadcast(void* h, void* buf, long count, int dtype, int root);
@@ -238,6 +241,12 @@ struct HierState {
   void* shm = nullptr;         // /dev/shm local group (preferred local plane)
   int local_rank = 0, local_size = 1, cross_rank = 0, cross_size = 1;
   bool allreduce = false, allgather = false;
+  // Per-link wire dtypes (WireDType codes) for the two-level allreduce
+  // data plane: independent knobs for the local and cross hops
+  // (HOROVOD_RING_WIRE_DTYPE_LOCAL/_CROSS via common/config.py, defaults
+  // by link class). wire_local is ignored when the local plane is the
+  // /dev/shm segment — memcpys through one mapping have no wire.
+  int wire_local = 0, wire_cross = 0;
 };
 HierState g_hier;
 
@@ -855,10 +864,10 @@ class Engine {
       if (timeline_) timeline_->activity_start(tname, allreduce_activity());
       if (size_ > 1) {
         if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
-          hier_ring_allreduce(e->user, (long)(total_bytes / esz), dtype);
-          // Hierarchical plane is uncompressed: no error this round.
-          if (e->residual)
-            std::memset(e->residual, 0, (total_bytes / esz) * sizeof(float));
+          // Per-link wire dtypes + residual threading: the hier plane
+          // fully writes e->residual (errors or zeros) like the flat one.
+          hier_ring_allreduce(e->user, (long)(total_bytes / esz), dtype,
+                              e->residual);
         } else if (hvd_ring_allreduce_wire(e->user, (long)(total_bytes / esz),
                                            dtype, 0, wire_dtype_,
                                            e->residual) != 0) {
@@ -908,7 +917,8 @@ class Engine {
     if (size_ > 1) {
       if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
         hier_ring_allreduce(fusion_buffer_.data(),
-                            (long)(total_bytes / esz), dtype);
+                            (long)(total_bytes / esz), dtype,
+                            fused_residual);
       } else if (hvd_ring_allreduce_wire(fusion_buffer_.data(),
                                          (long)(total_bytes / esz), dtype,
                                          0, wire_dtype_,
@@ -927,8 +937,9 @@ class Engine {
     for (Entry* e : entries) {
       std::memcpy(e->user, fusion_buffer_.data() + off, e->nbytes);
       if (e->residual) {
-        if (fused_residual && size_ > 1 &&
-            !(hier_.allreduce && (hier_.local_ring || hier_.shm)))
+        // Both data planes fully write the fused scratch (quantization
+        // errors or zeros), so a slice copy is always correct.
+        if (fused_residual && size_ > 1)
           std::memcpy(e->residual, fused_residual + off / esz,
                       (e->nbytes / esz) * sizeof(float));
         else
@@ -943,14 +954,51 @@ class Engine {
 
   // Two-level allreduce: sum inside the node (through /dev/shm when
   // active, TCP local ring otherwise), exchange node sums across the local
-  // roots' cross ring, fan back out locally.
-  void hier_ring_allreduce(void* buf, long count, uint8_t dtype) {
+  // roots' cross ring, fan back out locally. Each hop applies ITS link's
+  // wire dtype (hier_.wire_local / wire_cross) to f32 payloads — the
+  // cross hop is the slow inter-node link where int8+EF pays most
+  // (docs/wire-compression.md).
+  //
+  // Residual contract (matches ring_allreduce's): when `residual` is
+  // non-null it is FULLY written by this call — each element receives the
+  // exact quantization error this rank introduced on whichever hops it
+  // quantized (local errors + the root's cross errors), or zero. Summing
+  // every rank's residual gives exactly true_sum - computed_sum (local
+  // sums are exact or locally compensated; cross errors live on the
+  // roots), so the error-feedback telescoping holds through the
+  // two-level plane end-to-end.
+  void hier_ring_allreduce(void* buf, long count, uint8_t dtype,
+                           float* residual) {
+    bool f32 = dtype == 0;
+    int wl = f32 ? hier_.wire_local : 0;
+    int wc = f32 ? hier_.wire_cross : 0;
+    bool is_root = hier_.local_rank == 0;
+    bool local_q = f32 && wl == 3 /* WIRE_I8 */ && hier_.local_size > 1 &&
+                   hier_.local_ring != nullptr;
+    bool cross_q = f32 && wc == 3 && hier_.cross_size > 1 && is_root;
+    // Cross errors go to the caller's buffer directly when the local hop
+    // recorded nothing; when BOTH hops quantize, the cross hop stages
+    // through a scratch that is added in (each ring call overwrites its
+    // residual buffer, so the two contributions must be summed here).
+    float* cross_res = nullptr;
+    if (residual) {
+      if (cross_q && local_q) {
+        hier_residual_scratch_.resize((size_t)count);
+        cross_res = hier_residual_scratch_.data();
+      } else if (cross_q) {
+        cross_res = residual;
+      }
+      if (!local_q && !cross_q)
+        std::memset(residual, 0, (size_t)count * sizeof(float));
+    }
     if (hier_.shm) {
+      // Local plane is the shared segment: memcpys, no wire, exact sums.
       if (hvd_shm_allreduce_g(hier_.shm, buf, count, dtype) != 0)
         throw EngineError(std::string("shm local allreduce failed: ") +
                           hvd_shm_last_error());
-      if (hier_.local_rank == 0 &&
-          hvd_ringh_allreduce(hier_.cross_ring, buf, count, dtype, 0) != 0)
+      if (is_root &&
+          hvd_ringh_allreduce_wire(hier_.cross_ring, buf, count, dtype, 0,
+                                   wc, cross_res) != 0)
         throw EngineError(std::string("cross ring allreduce failed: ") +
                           hvd_ring_last_error());
       if (hvd_shm_broadcast_g(hier_.shm, buf, count, dtype, 0) != 0)
@@ -958,13 +1006,17 @@ class Engine {
                           hvd_shm_last_error());
       return;
     }
-    if (hvd_ringh_allreduce(hier_.local_ring, buf, count, dtype, 0) != 0)
+    if (hvd_ringh_allreduce_wire(hier_.local_ring, buf, count, dtype, 0, wl,
+                                 local_q ? residual : nullptr) != 0)
       throw EngineError(std::string("local ring allreduce failed: ") +
                         hvd_ring_last_error());
-    if (hier_.local_rank == 0 &&
-        hvd_ringh_allreduce(hier_.cross_ring, buf, count, dtype, 0) != 0)
+    if (is_root &&
+        hvd_ringh_allreduce_wire(hier_.cross_ring, buf, count, dtype, 0, wc,
+                                 cross_res) != 0)
       throw EngineError(std::string("cross ring allreduce failed: ") +
                         hvd_ring_last_error());
+    if (residual && local_q && cross_q)
+      for (long i = 0; i < count; i++) residual[i] += cross_res[i];
     if (hvd_ringh_broadcast(hier_.local_ring, buf, count, dtype, 0) != 0)
       throw EngineError(std::string("local ring broadcast failed: ") +
                         hvd_ring_last_error());
@@ -1073,10 +1125,13 @@ class Engine {
   double stall_warn_s_, stall_shutdown_s_;
   // Wire compression for the flat ring's allreduce data phases (WireDType
   // code from HOROVOD_RING_WIRE_DTYPE via common/config.py; ring.cc only
-  // applies it to f32 payloads). The hierarchical local/cross planes stay
-  // uncompressed this round.
+  // applies it to f32 payloads). The hierarchical plane has its own
+  // per-link pair in hier_.wire_local / hier_.wire_cross.
   int wire_dtype_ = 0;
   std::vector<float> residual_scratch_;  // fused-buffer EF staging
+  // Cross-hop EF staging when BOTH hier hops quantize (local errors land
+  // in the caller's residual, cross errors stage here and are added).
+  std::vector<float> hier_residual_scratch_;
 
   std::mutex mu_;  // guards table_/queue_/handles_/bit_pending_/cache_/closed_
   std::condition_variable handle_cv_;
@@ -1132,7 +1187,8 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
                  long long fusion_threshold, int cache_capacity,
                  int stall_disable, double stall_warn_s,
                  double stall_shutdown_s, const char* timeline_path,
-                 int timeline_mark_cycles, int wire_dtype) {
+                 int timeline_mark_cycles, int wire_dtype,
+                 int wire_dtype_local, int wire_dtype_cross) {
   std::lock_guard<std::mutex> g(hvd::g_engine_mu);
   if (hvd::g_engine && !hvd::g_engine->finished()) {
     hvd::g_last_error = "engine already initialized";
@@ -1176,6 +1232,13 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
   hvd::g_hier.local_size = env_int("HOROVOD_LOCAL_SIZE", 1);
   hvd::g_hier.cross_rank = env_int("HOROVOD_CROSS_RANK", 0);
   hvd::g_hier.cross_size = env_int("HOROVOD_CROSS_SIZE", 1);
+  // Per-link wire dtypes ride the ABI (resolved by common/config.py from
+  // HOROVOD_RING_WIRE_DTYPE_LOCAL/_CROSS + link-class defaults) so both
+  // engines share one resolver; clamp garbage to the untouched stream.
+  hvd::g_hier.wire_local =
+      (wire_dtype_local >= 0 && wire_dtype_local <= 3) ? wire_dtype_local : 0;
+  hvd::g_hier.wire_cross =
+      (wire_dtype_cross >= 0 && wire_dtype_cross <= 3) ? wire_dtype_cross : 0;
   if ((hvd::g_hier.allreduce || hvd::g_hier.allgather) && local_addrs &&
       cross_addrs && hvd::g_hier.local_size > 1 &&
       hvd::g_hier.cross_size > 1 && !(cpu_ops && strcmp(cpu_ops, "star") == 0)) {
@@ -1222,11 +1285,14 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
         hvd::g_last_error = hvd_ring_last_error();
         return -1;
       }
+      hvd_ringh_set_link(hvd::g_hier.local_ring, 1 /* LINK_LOCAL */);
     }
     if (hvd::g_hier.local_rank == 0) {
       hvd::g_hier.cross_ring = hvd_ringh_create(
           hvd::g_hier.cross_rank, hvd::g_hier.cross_size, cross_addrs, secret,
           secret_len);
+      if (hvd::g_hier.cross_ring)
+        hvd_ringh_set_link(hvd::g_hier.cross_ring, 2 /* LINK_CROSS */);
       if (!hvd::g_hier.cross_ring) {
         hvd::g_last_error = hvd_ring_last_error();
         // Don't leak the half-built pair (its bound listener would make a

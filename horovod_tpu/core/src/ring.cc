@@ -60,11 +60,31 @@ struct Ring {
   int right_fd = -1;  // send to right neighbor
   int listen_fd = -1;
   std::vector<uint8_t> secret;
+  // Link class this ring's connections ride (LINK_* below): indexes the
+  // per-link wire-traffic counters so the flat, local and cross planes
+  // account separately (hvd_ring_wire_bytes_total{dtype,link}).
+  int link = 0;
+  // Optional send-rate cap in bytes/s (0 = unlimited): a token bucket the
+  // send paths meter through, used by the bandwidth probe to emulate a
+  // slow cross-node link on a loopback box. Per ring, so a hierarchical
+  // layout can cap only its cross ring.
+  double rate_Bps = 0.0;
+  double rate_tokens = 0.0;
+  double rate_t = 0.0;
   // Wire-compression scratch, persistent across calls so steady-state
   // allreduces allocate nothing (single-threaded per ring by contract).
   std::vector<char> wtx, wrx, wfwd;
   std::vector<float> wscratch;
 };
+
+// Link classes for the wire-traffic counters. Must match
+// core.bindings.WIRE_LINK_CODES.
+enum WireLink {
+  LINK_FLAT = 0,   // the flat (global) ring
+  LINK_LOCAL = 1,  // hierarchical intra-node ring
+  LINK_CROSS = 2,  // hierarchical cross ring (local roots)
+};
+constexpr int kNumLinks = 3;
 
 enum DType {
   DT_F32 = 0,
@@ -124,12 +144,13 @@ std::atomic<long> g_chunk_bytes{256 * 1024};
 
 long chunk_bytes_now() { return g_chunk_bytes.load(std::memory_order_relaxed); }
 
-// Wire traffic accounting, indexed by WireDType: actual bytes handed to
-// the kernel vs the f32-equivalent ("logical") bytes they carry, plus
-// time spent in compress/decompress kernels. Python mirrors these into
-// hvd_ring_wire_bytes_total{dtype} / hvd_ring_compress_seconds.
-std::atomic<long long> g_wire_tx_bytes[4];
-std::atomic<long long> g_wire_logical_bytes[4];
+// Wire traffic accounting, indexed by [WireLink][WireDType]: actual bytes
+// handed to the kernel vs the f32-equivalent ("logical") bytes they
+// carry, plus time spent in compress/decompress kernels. Python mirrors
+// these into hvd_ring_wire_bytes_total{dtype,link} /
+// hvd_ring_compress_seconds.
+std::atomic<long long> g_wire_tx_bytes[kNumLinks][4];
+std::atomic<long long> g_wire_logical_bytes[kNumLinks][4];
 std::atomic<long long> g_compress_ns{0};
 
 struct CompressTimer {
@@ -561,6 +582,30 @@ void mark_progress() {
   if (sink) sink->store(now, std::memory_order_relaxed);
 }
 
+// Token-bucket gate for the optional per-ring send-rate cap: how many of
+// `want` bytes may go out now (0 = bucket dry; the caller retries after
+// the built-in short sleep). ~10 ms burst so pacing is smooth without
+// per-byte wakeups. Unlimited (the default) is a single branch.
+size_t rate_allow(Ring& ring, size_t want) {
+  if (ring.rate_Bps <= 0.0 || want == 0) return want;
+  double now = prog_mono_s();
+  if (ring.rate_t == 0.0) ring.rate_t = now;
+  ring.rate_tokens += (now - ring.rate_t) * ring.rate_Bps;
+  ring.rate_t = now;
+  double cap = ring.rate_Bps * 0.01 + 65536.0;
+  if (ring.rate_tokens > cap) ring.rate_tokens = cap;
+  if (ring.rate_tokens < 1.0) {
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+    return 0;
+  }
+  return want < (size_t)ring.rate_tokens ? want
+                                         : (size_t)ring.rate_tokens;
+}
+
+void rate_consume(Ring& ring, size_t n) {
+  if (ring.rate_Bps > 0.0) ring.rate_tokens -= (double)n;
+}
+
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = (const char*)buf;
   while (n > 0) {
@@ -663,14 +708,18 @@ bool exchange(Ring& ring, const void* sbuf, size_t sn, void* rbuf, size_t rn,
       return false;
     }
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
-      ssize_t k = send(ring.right_fd, (const char*)sbuf + soff, sn - soff,
-                       MSG_NOSIGNAL);
+      size_t allowed = rate_allow(ring, sn - soff);
+      ssize_t k = allowed == 0
+                      ? 0
+                      : send(ring.right_fd, (const char*)sbuf + soff, allowed,
+                             MSG_NOSIGNAL);
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         set_error(std::string("send: ") + strerror(errno));
         return false;
       }
       if (k > 0) {
         soff += (size_t)k;
+        rate_consume(ring, (size_t)k);
         mark_progress();
       }
     }
@@ -807,13 +856,17 @@ bool exchange_w(Ring& ring, CompressCursor* tx, const char* sbuf, size_t sn,
     }
     if (si >= 0 && (fds[si].revents & (POLLOUT | POLLERR | POLLHUP))) {
       size_t avail = tx ? tx->ready : sn;
-      ssize_t k = send(ring.right_fd, sp + soff, avail - soff, MSG_NOSIGNAL);
+      size_t allowed = rate_allow(ring, avail - soff);
+      ssize_t k = allowed == 0
+                      ? 0
+                      : send(ring.right_fd, sp + soff, allowed, MSG_NOSIGNAL);
       if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
         set_error(std::string("send: ") + strerror(errno));
         return false;
       }
       if (k > 0) {
         soff += (size_t)k;
+        rate_consume(ring, (size_t)k);
         mark_progress();
       }
     }
@@ -1039,8 +1092,8 @@ int ring_allreduce_wire_f32(Ring& ring, float* buf, long count, int average,
     if (!exchange_w(ring, &tx, nullptr, 0, ring.wrx.data(),
                     wire_nbytes(seg_len(s_recv), wire), &sink))
       return -1;
-    g_wire_tx_bytes[wire] += (long long)tx.total;
-    g_wire_logical_bytes[wire] += 4ll * seg_len(s_send);
+    g_wire_tx_bytes[ring.link][wire] += (long long)tx.total;
+    g_wire_logical_bytes[ring.link][wire] += 4ll * seg_len(s_send);
   }
 
   // Our own (fully reduced) segment: quantize it ONCE and keep the
@@ -1069,8 +1122,8 @@ int ring_allreduce_wire_f32(Ring& ring, float* buf, long count, int average,
     if (!exchange_w(ring, nullptr, ring.wfwd.data(), sn, ring.wrx.data(), rn,
                     &sink))
       return -1;
-    g_wire_tx_bytes[wire] += (long long)sn;
-    g_wire_logical_bytes[wire] += 4ll * seg_len(s_send);
+    g_wire_tx_bytes[ring.link][wire] += (long long)sn;
+    g_wire_logical_bytes[ring.link][wire] += 4ll * seg_len(s_send);
     std::swap(ring.wfwd, ring.wrx);  // this step's recv = next step's send
   }
   if (average) scale(buf, count, DT_F32, 1.0 / ring.size);
@@ -1129,8 +1182,9 @@ int ring_allreduce(Ring& ring, void* buf, long count, int dtype, int average,
                   (size_t)seg_len(s_recv) * esz,
                   pipelined ? &sink : nullptr))
       return -1;
-    g_wire_tx_bytes[WIRE_NONE] += (long long)seg_len(s_send) * (long long)esz;
-    g_wire_logical_bytes[WIRE_NONE] +=
+    g_wire_tx_bytes[ring.link][WIRE_NONE] +=
+        (long long)seg_len(s_send) * (long long)esz;
+    g_wire_logical_bytes[ring.link][WIRE_NONE] +=
         (long long)seg_len(s_send) * (long long)esz;
     if (!pipelined)
       accumulate(base + seg_off(s_recv) * esz, tmp.data(), seg_len(s_recv),
@@ -1144,8 +1198,9 @@ int ring_allreduce(Ring& ring, void* buf, long count, int dtype, int average,
                   (size_t)seg_len(s_send) * esz, base + seg_off(s_recv) * esz,
                   (size_t)seg_len(s_recv) * esz))
       return -1;
-    g_wire_tx_bytes[WIRE_NONE] += (long long)seg_len(s_send) * (long long)esz;
-    g_wire_logical_bytes[WIRE_NONE] +=
+    g_wire_tx_bytes[ring.link][WIRE_NONE] +=
+        (long long)seg_len(s_send) * (long long)esz;
+    g_wire_logical_bytes[ring.link][WIRE_NONE] +=
         (long long)seg_len(s_send) * (long long)esz;
   }
   if (average) scale(buf, count, dtype, 1.0 / ring.size);
@@ -1342,17 +1397,56 @@ void hvd_ring_set_chunk_bytes(long nbytes) {
 long hvd_ring_get_chunk_bytes() { return chunk_bytes_now(); }
 
 // Cumulative allreduce data-phase traffic by wire dtype (index =
-// WireDType code 0..3): actual bytes this rank handed to the kernel and
-// the uncompressed-equivalent ("logical") bytes they carried, plus the
-// total time spent in compress/decompress kernels. Python mirrors these
-// into hvd_ring_wire_bytes_total{dtype} / hvd_ring_compress_seconds.
+// WireDType code 0..3), summed over link classes: actual bytes this rank
+// handed to the kernel and the uncompressed-equivalent ("logical") bytes
+// they carried, plus the total time spent in compress/decompress
+// kernels. Python mirrors these into hvd_ring_wire_bytes_total{dtype,
+// link} / hvd_ring_compress_seconds (per-link detail via
+// hvd_ring_get_wire_stats_link).
 void hvd_ring_get_wire_stats(long long* tx_bytes, long long* logical_bytes,
                              double* compress_s) {
   for (int i = 0; i < 4; i++) {
-    tx_bytes[i] = g_wire_tx_bytes[i].load(std::memory_order_relaxed);
-    logical_bytes[i] = g_wire_logical_bytes[i].load(std::memory_order_relaxed);
+    long long tx = 0, logical = 0;
+    for (int l = 0; l < kNumLinks; l++) {
+      tx += g_wire_tx_bytes[l][i].load(std::memory_order_relaxed);
+      logical += g_wire_logical_bytes[l][i].load(std::memory_order_relaxed);
+    }
+    tx_bytes[i] = tx;
+    logical_bytes[i] = logical;
   }
   *compress_s = g_compress_ns.load(std::memory_order_relaxed) / 1e9;
+}
+
+// Per-link-class slice of the same counters (link = WireLink code 0..2:
+// flat/local/cross). The two-level data plane accounts its local and
+// cross hops separately, so the wire counters can PROVE "the cross hop
+// carries int8 bytes while the local hop stays f32".
+void hvd_ring_get_wire_stats_link(int link, long long* tx_bytes,
+                                  long long* logical_bytes) {
+  if (link < 0 || link >= kNumLinks) link = 0;
+  for (int i = 0; i < 4; i++) {
+    tx_bytes[i] = g_wire_tx_bytes[link][i].load(std::memory_order_relaxed);
+    logical_bytes[i] =
+        g_wire_logical_bytes[link][i].load(std::memory_order_relaxed);
+  }
+}
+
+// Tag a handle-based ring with its link class (WireLink code) so its
+// traffic lands in the right counter row. The flat default is 0; the
+// engine/controller tag their hierarchical local/cross rings at init.
+void hvd_ringh_set_link(void* h, int link) {
+  ((Ring*)h)->link = (link >= 0 && link < kNumLinks) ? link : 0;
+}
+
+// Cap a handle-based ring's send rate (bytes/s; 0 restores unlimited).
+// Emulation/measurement knob: the bandwidth probe uses it to model a
+// slow cross-node link on a loopback test box (docs/wire-compression.md);
+// production jobs leave it unset.
+void hvd_ringh_set_rate(void* h, double bytes_per_s) {
+  Ring* ring = (Ring*)h;
+  ring->rate_Bps = bytes_per_s > 0.0 ? bytes_per_s : 0.0;
+  ring->rate_tokens = 0.0;
+  ring->rate_t = 0.0;
 }
 
 // Monotonic timestamp of the last byte any ring in this process moved

@@ -165,11 +165,12 @@ _ring_wire_seen: Dict[str, float] = {}
 def refresh_ring_wire_metrics() -> None:
     """Mirror the native ring's wire-compression counters
     (``hvd_ring_get_wire_stats``) into the registry:
-    ``hvd_ring_wire_bytes_total{dtype}`` (actual bytes the allreduce data
-    phases put on the wire, by wire dtype), ``hvd_ring_compress_seconds``
-    (cumulative compress/decompress kernel time) and
-    ``hvd_ring_chunk_bytes`` (the live transfer-chunk size). Never
-    triggers a native build: a process that hasn't loaded the core
+    ``hvd_ring_wire_bytes_total{dtype,link}`` (actual bytes the allreduce
+    data phases put on the wire, by wire dtype and link class —
+    flat/local/cross, so the two-level plane's hops read separately),
+    ``hvd_ring_compress_seconds`` (cumulative compress/decompress kernel
+    time) and ``hvd_ring_chunk_bytes`` (the live transfer-chunk size).
+    Never triggers a native build: a process that hasn't loaded the core
     observes nothing (and registers nothing)."""
     if not on():
         return
@@ -182,16 +183,19 @@ def refresh_ring_wire_metrics() -> None:
         wire_c = counter(
             "hvd_ring_wire_bytes_total",
             "Bytes the native ring's allreduce data phases put on the "
-            "wire, by wire dtype", labelnames=("dtype",))
+            "wire, by wire dtype and link class (flat/local/cross)",
+            labelnames=("dtype", "link"))
         comp_c = counter(
             "hvd_ring_compress_seconds",
             "Cumulative time in the ring's wire compress/decompress "
             "kernels")
-        for name, val in stats["tx_bytes"].items():
-            prev = _ring_wire_seen.get("tx." + name, 0.0)
-            if val > prev:
-                wire_c.labels(dtype=name).inc(val - prev)
-                _ring_wire_seen["tx." + name] = float(val)
+        for link, row in stats["by_link"].items():
+            for name, val in row["tx_bytes"].items():
+                key = f"tx.{link}.{name}"
+                prev = _ring_wire_seen.get(key, 0.0)
+                if val > prev:
+                    wire_c.labels(dtype=name, link=link).inc(val - prev)
+                    _ring_wire_seen[key] = float(val)
         comp = stats["compress_seconds"]
         prev = _ring_wire_seen.get("compress_s", 0.0)
         if comp > prev:
@@ -334,14 +338,27 @@ def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
 
         wire = bindings.wire_stats()
     except ImportError:  # stripped install; health must stay well-formed
-        wire = {"tx_bytes": {}, "logical_bytes": {},
+        wire = {"tx_bytes": {}, "logical_bytes": {}, "by_link": {},
                 "compress_seconds": 0.0, "chunk_bytes": 0}
     tx = wire["tx_bytes"]
     logical = wire["logical_bytes"]
-    comp_logical = sum(v for k, v in logical.items() if k != "none")
-    comp_tx = sum(v for k, v in tx.items() if k != "none")
-    savings = (round(1.0 - comp_tx / comp_logical, 4)
-               if comp_logical else 0.0)
+
+    def _savings(tx_row, logical_row):
+        # Fraction of the compressed dtypes' f32-equivalent bytes that
+        # compression kept off this link's wire.
+        comp_logical = sum(v for k, v in logical_row.items() if k != "none")
+        comp_tx = sum(v for k, v in tx_row.items() if k != "none")
+        return (round(1.0 - comp_tx / comp_logical, 4)
+                if comp_logical else 0.0)
+
+    # Per-link savings (flat/local/cross): the two-level plane's proof
+    # that the slow cross hop is the compressed one. Always well-formed —
+    # every link key present, zeros before any traffic.
+    by_link = {link: _savings(row.get("tx_bytes", {}),
+                              row.get("logical_bytes", {}))
+               for link, row in wire.get("by_link", {}).items()}
+    for link in ("flat", "local", "cross"):
+        by_link.setdefault(link, 0.0)
     return {
         "cycle_seconds_p50": round(p50, 6),
         "cycle_seconds_p99": round(p99, 6),
@@ -349,6 +366,7 @@ def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
             snap, "hvd_controller_fused_bytes_total") or 0,
         "cache_hit_rate": hit_rate,
         "wire_bytes_total": sum(tx.values()),
-        "wire_savings_frac": savings,
+        "wire_savings_frac": _savings(tx, logical),
+        "wire_savings_by_link": by_link,
         "wire_compress_seconds": round(wire["compress_seconds"], 6),
     }

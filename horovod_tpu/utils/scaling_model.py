@@ -146,3 +146,123 @@ def efficiency_curve(step_time_s: float, groups: Sequence[GradGroup],
                      overlap: bool = True) -> Dict[int, float]:
     return {n: dp_efficiency(step_time_s, groups, n, bw_bytes_per_s,
                              overlap) for n in sizes}
+
+
+# --------------------------------------------------------------------------
+# Overlap-efficiency validation (round 12): the bucket scheduler
+# (controller/bucket_scheduler.py) measures per-bucket launch/complete
+# times on the live controller; feeding them back through the SAME union
+# computation the model's event timeline uses validates the model's
+# overlap assumption against reality instead of assuming it
+# (ROADMAP item 4 prep).
+
+
+@dataclasses.dataclass
+class BucketEvent:
+    """One reduction's measured (or modeled) life on the comm engine."""
+
+    launch_s: float
+    complete_s: float
+
+
+def overlap_efficiency_from_events(
+        events: Sequence[BucketEvent],
+        compute_start_s: float, compute_end_s: float) -> float:
+    """Fraction of the backward-compute window during which at least one
+    reduction was in flight: the union of the [launch, complete]
+    intervals, clipped to [compute_start, compute_end], over the window
+    length. THE definition of ``overlap_efficiency`` — the scheduler's
+    measured value and the model's predicted value both come from this
+    function, so comparing them compares assumptions, not formulas.
+    Returns 0.0 for an empty/degenerate window (no compute to hide
+    behind)."""
+    window = compute_end_s - compute_start_s
+    if window <= 0 or not events:
+        return 0.0
+    spans = sorted(
+        (max(e.launch_s, compute_start_s), min(e.complete_s, compute_end_s))
+        for e in events)
+    covered = 0.0
+    cur_a, cur_b = None, None
+    for a, b in spans:
+        if b <= a:
+            continue
+        if cur_a is None:
+            cur_a, cur_b = a, b
+        elif a <= cur_b:
+            cur_b = max(cur_b, b)
+        else:
+            covered += cur_b - cur_a
+            cur_a, cur_b = a, b
+    if cur_a is not None:
+        covered += cur_b - cur_a
+    return min(1.0, covered / window)
+
+
+def predicted_bucket_events(step_time_s: float,
+                            groups: Sequence[GradGroup], n: int,
+                            bw_bytes_per_s: float) -> List[BucketEvent]:
+    """The :func:`dp_step_time` event model, returning the per-group
+    (launch, complete) timeline instead of only the final clock: group
+    *g* becomes available at ``(1 - compute_after_frac_g) * step_time``;
+    the single serial comm engine starts it when both it and the engine
+    are free. Feeding this through
+    :func:`overlap_efficiency_from_events` gives the model's PREDICTED
+    overlap efficiency for the same schedule the bucket scheduler runs —
+    tests/test_bucket_scheduler.py pins model-vs-measured within a
+    documented tolerance."""
+    if n <= 1:
+        return []
+    events: List[BucketEvent] = []
+    engine_free = 0.0
+    for g in sorted(groups, key=lambda g: g.compute_after_frac,
+                    reverse=True):
+        avail = (1.0 - g.compute_after_frac) * step_time_s
+        t_comm = ring_wire_bytes(n, g.payload_bytes) / bw_bytes_per_s
+        launch = max(engine_free, avail)
+        engine_free = launch + t_comm
+        events.append(BucketEvent(launch, engine_free))
+    return events
+
+
+def modeled_events_from_measured(
+        events: Sequence[BucketEvent],
+        window_s: float) -> List[BucketEvent]:
+    """Rebuild the model's serial-engine timeline FROM a measured bucket
+    timeline: buckets become available at uniform spacing across the
+    backward window, and each occupies the engine for the measured
+    MEDIAN bucket duration. Feeding the result through
+    :func:`overlap_efficiency_from_events` gives the model's predicted
+    overlap for the schedule that was actually run — THE model-vs-
+    measured validation recipe (examples/overlap_probe.py and
+    tests/test_bucket_scheduler.py both call this; the comparison is
+    meaningless unless both use the same reconstruction)."""
+    if not events or window_s <= 0:
+        return []
+    durations = sorted(e.complete_s - e.launch_s for e in events)
+    t_comm = durations[len(durations) // 2]
+    out: List[BucketEvent] = []
+    engine_free = 0.0
+    for i in range(len(events)):
+        avail = window_s * (i + 1) / len(events)
+        launch = max(engine_free, avail)
+        engine_free = launch + t_comm
+        out.append(BucketEvent(launch, engine_free))
+    return out
+
+
+def measured_overlap_report(events: Sequence[BucketEvent],
+                            compute_start_s: float,
+                            compute_end_s: float) -> dict:
+    """JSON-ready summary of a measured bucket timeline — what the bench
+    row and the ``hvd_overlap_*`` gauges carry."""
+    eff = overlap_efficiency_from_events(events, compute_start_s,
+                                         compute_end_s)
+    return {
+        "buckets": len(events),
+        "overlap_efficiency": round(eff, 4),
+        "compute_window_s": round(max(0.0, compute_end_s - compute_start_s),
+                                  6),
+        "comm_busy_s": round(sum(max(0.0, e.complete_s - e.launch_s)
+                                 for e in events), 6),
+    }

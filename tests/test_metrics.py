@@ -186,6 +186,8 @@ def test_controller_health_fresh_registry_is_well_formed_zeros():
     assert health == {"cycle_seconds_p50": 0.0, "cycle_seconds_p99": 0.0,
                       "fused_bytes_total": 0, "cache_hit_rate": 0.0,
                       "wire_bytes_total": 0, "wire_savings_frac": 0.0,
+                      "wire_savings_by_link": {"flat": 0.0, "local": 0.0,
+                                               "cross": 0.0},
                       "wire_compress_seconds": 0.0}
     # Partial population zero-fills the missing series, including a
     # registered-but-empty histogram and a 0/0 hit rate.

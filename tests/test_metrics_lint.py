@@ -94,6 +94,8 @@ def test_known_series_present():
         "hvd_ring_wire_bytes_total",
         "hvd_ring_compress_seconds",
         "hvd_ring_chunk_bytes",
+        "hvd_overlap_buckets_total",
+        "hvd_overlap_efficiency",
         "hvd_autotune_active",
         "hvd_autotune_steps_completed",
         "hvd_autotune_steps_remaining",

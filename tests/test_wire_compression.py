@@ -324,19 +324,214 @@ def test_build_freshness_and_abi_matches_bindings():
         if fname.endswith((".cc", ".h")):
             with open(os.path.join(src_dir, fname)) as f:
                 src += f.read()
+    # Flat-ring wire ABI (round 10) AND the hierarchical entry points
+    # (round 12: per-link wire stats, link tagging, rate cap, the
+    # handle-ring collectives the two-level plane is built from).
     for func in ("hvd_ring_allreduce_wire", "hvd_ringh_allreduce_wire",
                  "hvd_eng_init", "hvd_eng_enqueue",
-                 "hvd_ring_get_wire_stats"):
+                 "hvd_ring_get_wire_stats", "hvd_ring_get_wire_stats_link",
+                 "hvd_ringh_set_link", "hvd_ringh_set_rate",
+                 "hvd_ringh_allreduce", "hvd_ringh_allgather",
+                 "hvd_ringh_broadcast", "hvd_ringh_create"):
         assert hasattr(lib, func)
         declared = len(getattr(lib, func).argtypes)
         in_source = _c_arg_count(src, func)
         assert declared == in_source, (
             f"{func}: bindings.py declares {declared} args, native source "
             f"defines {in_source} — the ctypes ABI drifted")
-    # The wire-dtype arg specifically: hvd_eng_init grew to 14 args and
-    # enqueue to 8 in round 10.
-    assert len(lib.hvd_eng_init.argtypes) == 14
+    # The wire-dtype args specifically: hvd_eng_init grew to 14 args in
+    # round 10 and to 16 in round 12 (hierarchical local/cross wire
+    # dtypes); enqueue grew to 8 in round 10.
+    assert len(lib.hvd_eng_init.argtypes) == 16
     assert len(lib.hvd_eng_enqueue.argtypes) == 8
+
+
+# ---------------------------------------------------------- hierarchical
+# (round 12: per-link wire dtypes on the two-level plane)
+
+def _hier_env(size=4):
+    """local/cross ring addresses for a 2x2 layout (2 groups of 2), as
+    the env the child scenarios (and the native engine) read."""
+    assert size == 4
+    local = ";".join(",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+                     for _ in range(2))
+    cross = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(2))
+    return {"HVD_TEST_LOCAL_ADDRS": local, "HVD_TEST_CROSS_ADDRS": cross}
+
+
+def _simulate_two_level(xs, cross_wire):
+    """Numpy transcript of the 2x2 two-level plane: local sums are exact
+    (a 2-rank f32 ring performs ONE addition per element — bitwise
+    order-independent), the two group sums ride a 2-rank cross ring under
+    ``cross_wire`` (the flat-ring transcript applies — same schedule),
+    and the local broadcast copies bytes verbatim."""
+    s0 = xs[0] + xs[1]
+    s1 = xs[2] + xs[3]
+    return _simulate_ring([s0, s1], cross_wire)
+
+
+def test_hier_wire_bitwise_reference_and_ef_exact_mean():
+    """ONE 4-rank 2x2 job (tier-1 pays per-child jax imports, so the two
+    RingBackend-level contracts share a spawn): (a) all four cross wire
+    dtypes pinned bitwise against the numpy-simulated two-level
+    reference (the local hop stays f32 — its counters prove it in the
+    native-engine test); (b) the telescoping EF contract through the two
+    levels — cross errors recorded on the roots, carried into the next
+    round, T-step average converging to the exact mean
+    (docs/wire-compression.md)."""
+    count = 20021  # uneven cross segments AND a partial int8 quant block
+    results = _run_ring_job("hier_wire", 4, extra_env={
+        **_hier_env(), "HVD_TEST_COUNT": str(count)})
+    xs = [_rank_input(r, count) for r in range(4)]
+    for wire in ("none", "bf16", "fp16", "int8"):
+        expect = _simulate_two_level(xs, wire)
+        want = hashlib.sha256(expect.tobytes()).hexdigest()
+        for rank, res in enumerate(results):
+            assert res[wire] == want, (
+                f"hier cross={wire} rank {rank}: two-level ring result != "
+                f"numpy-simulated reference")
+    for res in results:
+        assert res["ef_rel_err"] < 3.0 * res["single_rel_err"] / res["T"], (
+            res)
+        assert res["noef_rel_err"] > 10 * res["ef_rel_err"], res
+
+
+def test_per_link_wire_dtype_default_selection(monkeypatch):
+    """Link-class defaults (ici/local -> none, tcp/dcn -> int8), explicit
+    env override, and garbage-env -> default for both the wire dtype and
+    the link class."""
+    from horovod_tpu.common import config as cfg
+
+    for var in ("HOROVOD_RING_WIRE_DTYPE_LOCAL",
+                "HOROVOD_RING_WIRE_DTYPE_CROSS",
+                "HOROVOD_LOCAL_RING_LINK_CLASS",
+                "HOROVOD_CROSS_RING_LINK_CLASS",
+                "HOROVOD_LOCAL_RING_ADDRS", "HOROVOD_CROSS_RING_ADDRS"):
+        monkeypatch.delenv(var, raising=False)
+    # Loopback local ring -> link class local -> uncompressed by default.
+    monkeypatch.setenv("HOROVOD_LOCAL_RING_ADDRS",
+                       "127.0.0.1:1,127.0.0.1:2")
+    assert cfg.local_ring_link_class() == "local"
+    assert cfg.ring_wire_dtype_local() == "none"
+    # Host-spanning cross ring -> tcp -> int8 by default.
+    monkeypatch.setenv("HOROVOD_CROSS_RING_ADDRS",
+                       "10.0.0.1:1,10.0.0.2:1")
+    assert cfg.cross_ring_link_class() == "tcp"
+    assert cfg.ring_wire_dtype_cross() == "int8"
+    # Explicit link classes key the sibling table both ways.
+    monkeypatch.setenv("HOROVOD_CROSS_RING_LINK_CLASS", "ici")
+    assert cfg.ring_wire_dtype_cross() == "none"
+    monkeypatch.setenv("HOROVOD_CROSS_RING_LINK_CLASS", "dcn")
+    assert cfg.ring_wire_dtype_cross() == "int8"
+    # Garbage wire dtype -> the link-class default, never a crash.
+    monkeypatch.setenv("HOROVOD_RING_WIRE_DTYPE_CROSS", "int4")
+    assert cfg.ring_wire_dtype_cross() == "int8"
+    # An explicit valid value wins over the default.
+    monkeypatch.setenv("HOROVOD_RING_WIRE_DTYPE_CROSS", "bf16")
+    assert cfg.ring_wire_dtype_cross() == "bf16"
+    # Garbage link class falls back to address inference (tcp here).
+    monkeypatch.delenv("HOROVOD_RING_WIRE_DTYPE_CROSS")
+    monkeypatch.setenv("HOROVOD_CROSS_RING_LINK_CLASS", "warp")
+    assert cfg.cross_ring_link_class() == "tcp"
+    assert cfg.ring_wire_dtype_cross() == "int8"
+    # The table rows the defaults come from (docs/wire-compression.md).
+    assert cfg.RING_WIRE_DTYPE_BY_LINK == {
+        "local": "none", "ici": "none", "tcp": "int8", "dcn": "int8"}
+
+
+def _run_hier_native_job(scenario, extra_env, timeout=180.0):
+    """4-rank 2x2 full-stack job on the NATIVE engine's two-level plane:
+    per-rank local/cross env + group-specific local ring addresses, the
+    exact surface hvd_eng_init reads."""
+    hier = _hier_env()
+    locals_by_group = hier["HVD_TEST_LOCAL_ADDRS"].split(";")
+    ring_addrs = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(4))
+    procs = []
+    for rank in range(4):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update({
+            "HOROVOD_RANK": str(rank), "HOROVOD_SIZE": "4",
+            "HOROVOD_LOCAL_RANK": str(rank % 2),
+            "HOROVOD_LOCAL_SIZE": "2",
+            "HOROVOD_CROSS_RANK": str(rank // 2),
+            "HOROVOD_CROSS_SIZE": "2",
+            "HOROVOD_RING_ADDRS": ring_addrs,
+            "HOROVOD_LOCAL_RING_ADDRS": locals_by_group[rank // 2],
+            "HOROVOD_CROSS_RING_ADDRS": hier["HVD_TEST_CROSS_ADDRS"],
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_CYCLE_TIME": "1",
+        })
+        env.update(extra_env)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), scenario, str(rank),
+             "4", ring_addrs],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    results = []
+    for rank, proc in enumerate(procs):
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for pr in procs:
+                pr.kill()
+            raise AssertionError(f"{scenario}: rank {rank} hung")
+        assert proc.returncode == 0, (
+            f"{scenario}: rank {rank} failed (exit {proc.returncode}):\n"
+            f"{out}")
+        payload = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                payload = json.loads(line[len("RESULT "):])
+        assert payload is not None, f"{scenario}: no RESULT in:\n{out}"
+        results.append(payload)
+    return results
+
+
+def _check_hier_native_results(results):
+    for rank, res in enumerate(results):
+        assert res["hier_active"], res
+        # Exact through engine fusion: int-valued payloads whose every
+        # 4096-block quantizes with a power-of-two scale survive the
+        # cross int8 hop bit-exactly, fused or not.
+        assert res["fused_exact"], res
+        # EF convergence end-to-end (controller residuals -> engine ->
+        # cross ring and back).
+        assert res["avg_rel_err"] < 0.3 * res["single_rel_err"], res
+        # The counters prove the split: the cross hop carries int8 bytes
+        # ON THE ROOTS (local_rank 0 owns the cross ring; non-roots never
+        # touch it), and the local hop stays f32 everywhere.
+        if rank % 2 == 0:
+            assert res["cross_int8_bytes"] > 0, res
+            assert res["health_cross_savings"] > 0.5, res
+        else:
+            assert res["cross_int8_bytes"] == 0, res
+        assert res["local_int8_bytes"] == 0, res
+        assert res["health_local_savings"] == 0.0, res
+
+
+def test_native_engine_hier_cross_int8_end_to_end():
+    """Tier-1 sibling: TCP local ring (shm disabled), cross int8 —
+    engine fusion exactness, EF convergence, per-link counter proof,
+    controller_health surfacing."""
+    results = _run_hier_native_job("hier_native", {
+        "HOROVOD_RING_WIRE_DTYPE_CROSS": "int8",
+        "HOROVOD_SHM_DISABLE": "1",
+    })
+    _check_hier_native_results(results)
+
+
+@pytest.mark.slow
+def test_native_engine_hier_cross_int8_shm_local_plane():
+    """Heavy variant: the /dev/shm local plane under the compressed
+    cross ring (the production same-host layout)."""
+    results = _run_hier_native_job("hier_native", {
+        "HOROVOD_RING_WIRE_DTYPE_CROSS": "int8",
+        "HVD_TEST_COUNT": str(16 * QUANT_BLOCK + 77),
+        "HVD_TEST_STEPS": "60",
+    })
+    _check_hier_native_results(results)
 
 
 # ------------------------------------------------------------ child ranks
@@ -476,11 +671,139 @@ def _child_wire_residual_zero(rank, size, addrs):
     ring.shutdown()
 
 
+def _hier_rings(rank, secret=b"hier-test"):
+    """local + (roots-only) cross RingBackends for the 2x2 layout, from
+    the HVD_TEST_*_ADDRS env the parent allocated."""
+    group, local = rank // 2, rank % 2
+    local_ring = bindings.RingBackend(
+        local, 2, os.environ["HVD_TEST_LOCAL_ADDRS"].split(";")[group],
+        secret)
+    local_ring.set_link("local")
+    cross = None
+    if local == 0:
+        cross = bindings.RingBackend(
+            group, 2, os.environ["HVD_TEST_CROSS_ADDRS"], secret)
+        cross.set_link("cross")
+    return local_ring, cross
+
+
+def _child_hier_wire(rank, size, addrs):
+    count = int(os.environ.get("HVD_TEST_COUNT", "20021"))
+    local_ring, cross = _hier_rings(rank)
+    x = _rank_input(rank, count)
+    out = {}
+    for wire, code in sorted(bindings.WIRE_DTYPE_CODES.items()):
+        buf = x.copy()
+        residual = np.zeros(count, np.float32) if wire == "int8" else None
+        local_ring.allreduce_(buf, False)
+        if cross is not None:
+            cross.allreduce_(buf, False, wire_dtype=code, residual=residual)
+        local_ring.broadcast_(buf, 0)
+        out[wire] = hashlib.sha256(buf.tobytes()).hexdigest()
+
+    # EF half of the contract (same rings, same spawn): telescoping
+    # exact-mean convergence with the cross hop on int8.
+    g = np.random.RandomState(500 + rank).randn(count).astype(np.float32)
+    # The mean every round telescopes toward, in the two-level sum order
+    # (local sums are exact single additions; the cross sum of two f32s
+    # is order-independent).
+    true = ((np.random.RandomState(500).randn(count).astype(np.float32)
+             + np.random.RandomState(501).randn(count).astype(np.float32))
+            + (np.random.RandomState(502).randn(count).astype(np.float32)
+               + np.random.RandomState(503).randn(count).astype(np.float32))
+            ) / np.float32(4)
+    T = 28
+
+    def run(feedback):
+        residual = np.zeros(count, np.float32)
+        acc = np.zeros(count, np.float64)
+        first = None
+        for _ in range(T):
+            xx = g + residual if feedback else g.copy()
+            local_ring.allreduce_(xx, False)
+            if cross is not None:
+                cross.allreduce_(xx, False, wire_dtype=3, residual=residual)
+            local_ring.broadcast_(xx, 0)
+            y = xx / 4
+            if first is None:
+                first = float(np.abs(y - true).max() / np.abs(true).max())
+            acc += y
+        avg = acc / T
+        return (float(np.abs(avg - true).max() / np.abs(true).max()), first)
+
+    ef_err, single_err = run(True)
+    noef_err, _ = run(False)
+    out.update({"T": T, "ef_rel_err": ef_err, "noef_rel_err": noef_err,
+                "single_rel_err": single_err})
+    print("RESULT " + json.dumps(out), flush=True)
+    if cross is not None:
+        cross.shutdown()
+    local_ring.shutdown()
+
+
+def _child_hier_native(rank, size, addrs):
+    from horovod_tpu import metrics
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.common.topology import Topology
+    from horovod_tpu.controller.native import NativeController
+
+    metrics.enable()
+    topo = Topology(rank=rank, size=4, local_rank=rank % 2, local_size=2,
+                    cross_rank=rank // 2, cross_size=2)
+    ctl = NativeController(Config.from_env(), topo)
+
+    # Exact-through-fusion payload: every 4096-block is the same integer
+    # pattern with amax exactly 127, so each two-level stage quantizes
+    # with a power-of-two scale (2p -> scale 2, 4p -> scale 4) and int8
+    # round-trips bit-exactly — fused or unfused, any fusion order.
+    pat = (np.arange(QUANT_BLOCK) % 255 - 127).astype(np.float32)
+    fused_exact = True
+    handles = []
+    for i, blocks in enumerate((1, 2, 1)):
+        x = np.tile(pat, blocks)
+        handles.append((x, ctl.allreduce_async(
+            x, average=True, name=f"hx.{i}")))
+    for x, h in handles:
+        got = np.asarray(h.wait())
+        fused_exact = fused_exact and bool(np.array_equal(got, x))
+
+    # EF convergence end-to-end (residuals live on the controller, the
+    # engine threads them through the cross hop).
+    count = int(os.environ.get("HVD_TEST_COUNT", str(2 * QUANT_BLOCK + 33)))
+    T = int(os.environ.get("HVD_TEST_STEPS", "20"))
+    g = np.random.RandomState(700 + rank).randn(count).astype(np.float32)
+    true = sum(np.random.RandomState(700 + r).randn(count).astype(np.float32)
+               for r in range(4)) / 4.0
+    acc = np.zeros(count, np.float64)
+    single = None
+    for _ in range(T):
+        y = np.asarray(ctl.allreduce(g, average=True, name="hef.grad"))
+        if single is None:
+            single = float(np.abs(y - true).max() / np.abs(true).max())
+        acc += y
+    avg = acc / T
+    health = metrics.controller_health()
+    stats = bindings.wire_stats()
+    print("RESULT " + json.dumps({
+        "hier_active": bool(ctl.hierarchical_active),
+        "fused_exact": fused_exact,
+        "avg_rel_err": float(np.abs(avg - true).max() / np.abs(true).max()),
+        "single_rel_err": single,
+        "cross_int8_bytes": stats["by_link"]["cross"]["tx_bytes"]["int8"],
+        "local_int8_bytes": stats["by_link"]["local"]["tx_bytes"]["int8"],
+        "health_cross_savings": health["wire_savings_by_link"]["cross"],
+        "health_local_savings": health["wire_savings_by_link"]["local"],
+    }), flush=True)
+    ctl.shutdown()
+
+
 _CHILDREN = {
     "wire_result": _child_wire_result,
     "wire_ef": _child_wire_ef,
     "native_ef": _child_native_ef,
     "wire_residual_zero": _child_wire_residual_zero,
+    "hier_wire": _child_hier_wire,
+    "hier_native": _child_hier_native,
 }
 
 if __name__ == "__main__":
