@@ -291,6 +291,16 @@ FULL_ROWS = {
         "script": "examples/overlap_probe.py",
         "args": ["--out", "artifacts/overlap_r12.json"],
         "json": True},
+    # Control-plane scaling row (round 13): negotiation / reshape /
+    # heartbeat-fanout costs measured at 8-64 multiplexed logical ranks
+    # on the simcluster harness (docs/simcluster.md), with the fitted
+    # linear calibration + per-size model residuals and the overlap
+    # model-vs-measured check at 8 and 32 ranks. CPU-only; refreshes
+    # artifacts/simcluster_r13.json (substrate recorded honestly inside).
+    "simcluster_control_plane_8_64": {
+        "script": "examples/simcluster_probe.py",
+        "args": ["--out", "artifacts/simcluster_r13.json"],
+        "json": True},
     "resnet50_b128": None,  # runs child_bench (median of 5 windows)
     "vit_s16_224_b64_adamw_spc8": {
         "script": "examples/jax_vit_training.py",

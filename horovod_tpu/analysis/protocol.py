@@ -394,6 +394,16 @@ def protocheck_enabled() -> bool:
     return bool(_protocheck_mode())
 
 
+def refresh_mode() -> None:
+    """Drop the cached HOROVOD_PROTOCHECK mode so the next check re-reads
+    the environment. Real ranks only ever set the knob before launch (the
+    cache is correct for them); the in-process sim harness
+    (horovod_tpu/sim) toggles it around a cluster's lifetime and must
+    re-resolve on both edges."""
+    global _mode
+    _mode = None
+
+
 class ProtocolViolationError(RuntimeError):
     """An off-spec wire transition under ``HOROVOD_PROTOCHECK=raise``."""
 

@@ -369,9 +369,14 @@ class Controller:
                 publish_tuner_gauges,
             )
 
+            # The gradient-bucket size joins the search on the python
+            # engine too (r13): its tuned value rides the synced cycle
+            # reply (_apply_tune), so every rank's BucketScheduler moves
+            # together — the native engine's rank-0-local push cannot
+            # offer that (docs/overlap.md).
             self._param_manager = make_parameter_manager(
                 config, tune_hierarchical=self._local_ring is not None,
-                tune_cache=True)
+                tune_cache=True, tune_bucket=True)
             self._publish_tuner = publish_tuner_gauges
 
         addr = config_mod.controller_addr()
@@ -865,9 +870,16 @@ class Controller:
                     # effects); the hierarchical flag is applied ONLY via
                     # next cycle's synced reply — it changes the data-plane
                     # path, which must switch on every rank at the same
-                    # cycle boundary.
+                    # cycle boundary. The gradient-bucket size rides the
+                    # same reply (docs/overlap.md): every rank's
+                    # BucketScheduler must group launches identically or
+                    # the GP is scoring a world where only rank 0 moved.
                     self._fusion_threshold, self._cycle_time_ms = tuned[:2]
-                    self._pending_tune = tuned
+                    extras = {}
+                    bucket = self._param_manager.bucket_bytes
+                    if bucket:
+                        extras["bucket_bytes"] = int(bucket)
+                    self._pending_tune = tuned + (extras,)
                 if (mon and self._param_manager.steps_scored
                         != self._autotune_steps_pub):
                     # First pass publishes the initial state (active flag,
@@ -1112,6 +1124,36 @@ class Controller:
 
     # ----------------------------------------------------------- both sides
 
+    def _apply_tune(self, tune: tuple) -> bool:
+        """Adopt one synced parameter push from the cycle reply, on
+        EVERY rank (reference SyncParams, parameter_manager.cc:223).
+        Continuous knobs and the categorical data-plane flags as before;
+        element 3 (round 13) is an extras dict carrying the autotuned
+        gradient-bucket size, pushed into the process-wide scheduler
+        override so bucket launch grouping stays identical across ranks
+        (docs/overlap.md). Returns whether the response cache was
+        turned OFF by this push (the caller must renegotiate tensors
+        stranded on cache bits)."""
+        self._fusion_threshold, self._cycle_time_ms = tune[:2]
+        cache_turned_off = False
+        if len(tune) > 2:
+            cats = tune[2]
+            self._hier_allreduce = bool(
+                cats.get("hierarchical_allreduce",
+                         self._hier_allreduce))
+            self._hier_allgather = bool(
+                cats.get("hierarchical_allgather",
+                         self._hier_allgather))
+            new_cache = bool(
+                cats.get("cache_enabled", self._cache_enabled))
+            cache_turned_off = self._cache_enabled and not new_cache
+            self._cache_enabled = new_cache
+        if len(tune) > 3 and tune[3].get("bucket_bytes"):
+            from .bucket_scheduler import set_autotuned_bucket_bytes
+
+            set_autotuned_bucket_bytes(int(tune[3]["bucket_bytes"]))
+        return cache_turned_off
+
     def _process_reply(self, reply: dict) -> int:
         # One stamp for the whole reply: negotiate spans end when the
         # reply ARRIVED, not when each response's turn to execute came
@@ -1120,19 +1162,7 @@ class Controller:
         tune = reply.get("tune")
         cache_turned_off = False
         if tune is not None:
-            self._fusion_threshold, self._cycle_time_ms = tune[:2]
-            if len(tune) > 2:
-                cats = tune[2]
-                self._hier_allreduce = bool(
-                    cats.get("hierarchical_allreduce",
-                             self._hier_allreduce))
-                self._hier_allgather = bool(
-                    cats.get("hierarchical_allgather",
-                             self._hier_allgather))
-                new_cache = bool(
-                    cats.get("cache_enabled", self._cache_enabled))
-                cache_turned_off = self._cache_enabled and not new_cache
-                self._cache_enabled = new_cache
+            cache_turned_off = self._apply_tune(tune)
         executed_bytes = 0
         for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
             name = None

@@ -297,8 +297,15 @@ class CoordinatorService(_HeartbeatMixin):
         self._join_thread.start()
 
     def has_pending_joiners(self) -> bool:
+        return self.parked_joiner_count() > 0
+
+    def parked_joiner_count(self) -> int:
+        """How many validated joiners are parked awaiting an epoch
+        boundary — the deterministic "is my joiner visible yet" probe
+        the sim harness (horovod_tpu/sim) and tests poll instead of
+        sleeping an arbitrary wall-clock amount."""
         with self._wires_lock:
-            return bool(self._pending_joins)
+            return len(self._pending_joins)
 
     def reform(self, dead, min_ranks: int = 1,
                max_ranks: int = 0) -> Optional[ReshapeResult]:

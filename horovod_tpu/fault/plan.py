@@ -34,10 +34,19 @@ Rule fields (JSON object per rule):
                        (it must not replay this rule, or a join storm
                        becomes a fork bomb) and is admitted at the next
                        membership epoch boundary
+             "group_kill" — cycle only: SIGKILL every process whose
+                       rank is in ``ranks`` at the SAME cycle count — a
+                       correlated failure (a whole rack / power domain),
+                       not N independent ones. The lockstep protocol
+                       keeps cycle counts aligned across ranks, so the
+                       deaths land together; the sim harness
+                       (horovod_tpu/sim, docs/simcluster.md) applies the
+                       rule to all its logical ranks in one stroke
     at       fire on the at-th event at this site (1-based); "wedge"
              ignores it (always the first ``times`` attempts)
     times    how many consecutive events fire (default 1)
     rank     only apply in the process with this HOROVOD_RANK (default all)
+    ranks    "group_kill" only: the ranks that die together (required)
     seconds  delay duration (action "delay")
     jitter   ± fraction of ``seconds`` (seeded; default 0 = deterministic)
     message  error text for action "raise"
@@ -62,11 +71,11 @@ VALID_SITES = ("wire_send", "wire_recv", "cycle", "init",
                "init_distributed")
 _INIT_SITES = ("init", "init_distributed")
 VALID_ACTIONS = ("kill", "exit", "delay", "drop", "raise", "wedge",
-                 "join", "leave")
+                 "join", "leave", "group_kill")
 # Membership-churn actions fire at controller-cycle granularity only: a
 # join/leave mid-frame would tear a wire stream rather than exercise the
 # elastic reshape path it exists to test.
-_MEMBERSHIP_ACTIONS = ("join", "leave")
+_MEMBERSHIP_ACTIONS = ("join", "leave", "group_kill")
 
 
 def _graceful_leave() -> None:
@@ -108,6 +117,7 @@ class FaultRule:
     at: Optional[int] = None
     times: int = 1
     rank: Optional[int] = None
+    ranks: Optional[List[int]] = None  # "group_kill": correlated victims
     seconds: float = 0.0
     jitter: float = 0.0
     message: str = ""
@@ -129,6 +139,20 @@ class FaultRule:
             raise ValueError(
                 f'action "{self.action}" only applies to site "cycle" '
                 "(membership churn is an epoch-boundary event)")
+        if self.action == "group_kill":
+            if not self.ranks:
+                # Without victims the rule is a silent no-op — a chaos
+                # run that tests nothing. Fail at load, like the "at"
+                # check below.
+                raise ValueError(
+                    'action "group_kill" needs "ranks" (the list of '
+                    "ranks that die together)")
+            self.ranks = sorted(int(r) for r in self.ranks)
+        elif self.ranks is not None:
+            raise ValueError(
+                f'"ranks" only applies to action "group_kill" '
+                f'(got action {self.action!r}); use "rank" to scope a '
+                "single-process rule")
         if self.action != "wedge" and self.at is None:
             # Without "at" the rule would never fire — a chaos run that
             # silently tests nothing. Fail at load, not at runtime.
@@ -152,8 +176,21 @@ class FaultPlan:
                  rank: Optional[int] = None):
         self.seed = seed
         self.rank = rank
+        # group_kill scopes by membership in its victim list — a rule
+        # with ranks=[4,5,6,7] must load in exactly those processes (all
+        # of which then die at the same lockstep cycle count); every
+        # other action keeps the single-rank / all-ranks scoping. That
+        # scoping NEEDS a rank identity: with HOROVOD_RANK unset or
+        # unparseable the victim test would silently drop every
+        # group_kill rule — a chaos run that tests nothing, the exact
+        # failure mode this module fails loudly on.
+        if rank is None and any(r.ranks is not None for r in rules):
+            raise ValueError(
+                "a group_kill rule needs this process's rank to scope "
+                "its victim list, but HOROVOD_RANK is unset/unparseable")
         self.rules = [r for r in rules
-                      if r.rank is None or r.rank == rank]
+                      if (rank in r.ranks if r.ranks is not None
+                          else r.rank is None or r.rank == rank)]
         self._counts: Dict[str, int] = {}
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -203,7 +240,10 @@ class FaultPlan:
             if delay > 0:
                 time.sleep(delay)
         for rule in fired:
-            if rule.action == "kill":
+            if rule.action in ("kill", "group_kill"):
+                # group_kill reaches here only in processes whose rank is
+                # in the victim list (the constructor filter): each dies
+                # at the same cycle count — the correlated failure.
                 os.kill(os.getpid(), signal.SIGKILL)
             elif rule.action == "exit":
                 os._exit(1)

@@ -127,9 +127,16 @@ def reset_for_tests() -> None:
         _remote.clear()
     _registry.clear()
     for name, mod in list(sys.modules.items()):
-        if (name.startswith("horovod_tpu") and mod is not None
-                and isinstance(getattr(mod, "_m", None), SimpleNamespace)):
-            mod._m = None
+        if not name.startswith("horovod_tpu") or mod is None:
+            continue
+        # controller.py keeps its elastic-membership namespace under
+        # _em beside the package-convention _m; both point at orphaned
+        # objects after a registry clear (a second in-process elastic
+        # controller — the sim harness — would otherwise record
+        # reshapes into metrics no snapshot can see).
+        for cache_attr in ("_m", "_em"):
+            if isinstance(getattr(mod, cache_attr, None), SimpleNamespace):
+                setattr(mod, cache_attr, None)
 
 
 def default_registry() -> MetricsRegistry:

@@ -1,0 +1,55 @@
+"""simcluster — multiplexed hundred-rank simulation (docs/simcluster.md).
+
+Everything elastic/doctor/protocol shipped since round 7 was validated at
+2–3 ranks because each rank is a full process. This package multiplexes
+N *logical* worker ranks onto the calling thread of ONE process, behind
+the exact ``common/wire.py`` seams production uses: every logical rank
+dials the coordinator over a real loopback TCP socket, speaks the real
+authenticated frame protocol (kind bytes, HMAC, deadlines, heartbeats,
+``ProtocolMonitor`` hooks), and the coordinator side is the REAL
+``Controller`` + ``CoordinatorService`` — negotiation, Tensor Fusion,
+elastic ``reform()``, the doctor sweep, all unmodified. What is
+simulated is only the worker-side *process*: a :class:`SimWorker` is a
+lockstep protocol state machine, not a training job.
+
+That buys a 64–256-rank world for the cost of a couple of threads, which
+turns the round-13 protocol spec and the round-7 FaultPlan into
+cluster-scale conformance tools: join/leave storms, correlated rack
+failures (the ``group_kill`` plan kind), and flapping-NIC delay bursts
+all run under ``HOROVOD_PROTOCHECK=1`` with the doctor expected to name
+every injected fault — in tier-1, in well under the cost of one 3-rank
+process-per-rank chaos test.
+
+The same harness is the measurement rig for ``utils/scaling_model.py``:
+:mod:`~horovod_tpu.sim.measure` records negotiation, reshape, and
+heartbeat-fanout costs per world size (``artifacts/simcluster_r13.json``)
+and the scaling model's control-plane calibration is fitted from that
+data instead of assumed.
+
+Entry points:
+
+* :class:`~horovod_tpu.sim.cluster.SimCluster` — the harness.
+* ``python -m horovod_tpu.tools.simcluster --ranks N --plan @file`` — a
+  seeded scenario runner that exits non-zero on any conformance
+  violation or undiagnosed fault.
+"""
+
+from .cluster import SimCluster, SimStepTorn, StepSpec, allreduce_spec
+from .faults import SimFaultDriver, expected_diagnoses, sim_supported_plan
+from .scenario import ScenarioResult, run_scenario
+from .worker import SimOp, SimWorker, SimWorkerDead
+
+__all__ = [
+    "ScenarioResult",
+    "SimCluster",
+    "SimFaultDriver",
+    "SimOp",
+    "SimStepTorn",
+    "SimWorker",
+    "SimWorkerDead",
+    "StepSpec",
+    "allreduce_spec",
+    "expected_diagnoses",
+    "run_scenario",
+    "sim_supported_plan",
+]

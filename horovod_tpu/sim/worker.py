@@ -1,0 +1,247 @@
+"""One multiplexed logical rank: the worker side of the lockstep protocol
+as an explicitly-phased state machine.
+
+A real worker rank is a whole ``Controller`` — a background cycle thread,
+a heartbeat thread, handle tables. At 256 ranks that is 500+ threads in
+one process, which is exactly the cost this harness exists to avoid.
+A :class:`SimWorker` keeps only what the *wire contract* requires: it
+dials the coordinator through the real :class:`WorkerClient` (real
+socket, real frames, real HMAC, real ``ProtocolMonitor`` role), and
+exposes the per-cycle protocol as separate phases — send the tick, recv
+the reply, run each response's data exchange — so ONE driving thread can
+interleave any number of logical ranks without deadlocking: the lockstep
+protocol's global order (all ticks → reply fanout → per-response data
+walks) is re-created by the driver calling each phase across all workers
+before advancing (``sim/cluster.py``).
+
+Fidelity boundary (docs/simcluster.md): everything ON the wire is real —
+frame kinds, epochs, reshape acks, abort payloads, conformance
+monitoring. What is simulated is the process around it: "killing" a
+logical rank closes its socket (how a SIGKILLed process looks from the
+coordinator's side of the wire), and a delayed tick is the driver
+sleeping, not a loaded host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.message import Request, RequestList, RequestType, ResponseType
+from ..common.wire import RanksChangedError, RemoteAbortError
+from ..controller.service import WorkerClient
+
+
+class SimWorkerDead(ConnectionError):
+    """An operation was driven on a logical rank whose wire is gone."""
+
+
+@dataclasses.dataclass
+class SimOp:
+    """One collective this logical rank submits on a tick: the sim-side
+    mirror of a user calling ``hvd.allreduce_async`` on a real rank."""
+
+    kind: str                       # "allreduce" | "allgather" | "broadcast"
+    name: str
+    array: np.ndarray
+    root_rank: int = -1             # broadcast only
+
+    _TYPES = {"allreduce": RequestType.ALLREDUCE,
+              "allgather": RequestType.ALLGATHER,
+              "broadcast": RequestType.BROADCAST}
+
+    def request(self, rank: int) -> Request:
+        return Request(
+            request_rank=rank, request_type=self._TYPES[self.kind],
+            tensor_name=self.name, tensor_dtype=str(self.array.dtype),
+            tensor_shape=tuple(self.array.shape), root_rank=self.root_rank)
+
+
+class SimWorker:
+    """A logical worker rank multiplexed onto the driver thread."""
+
+    def __init__(self, addr: str, rank: int, size: int,
+                 join: bool = False,
+                 comm_timeout: Optional[float] = None):
+        self.rank = rank
+        self.size = size
+        self.epoch = 1
+        self.alive = True
+        self.joined_at_epoch: Optional[int] = None
+        # What the driver learns from replies, for assertions: results by
+        # tensor name (this step), the last abort/error seen, the last
+        # synced autotune push (docs/overlap.md bucket sync).
+        self.results: Dict[str, np.ndarray] = {}
+        self.executed: set = set()
+        self.errors: List[str] = []
+        self.abort: Optional[RemoteAbortError] = None
+        self.reshapes = 0
+        self.last_tune: Optional[tuple] = None
+        self.tuned_bucket_bytes: Optional[int] = None
+        self._pending: Dict[str, SimOp] = {}
+        self._client = WorkerClient(addr, rank, join=join,
+                                    comm_timeout=comm_timeout)
+        if join:
+            # A joiner has no identity until the admission assignment;
+            # rank/size above are provisional (advisory hello only).
+            self.epoch = 0
+
+    # ------------------------------------------------------------ admission
+
+    def await_admission(self) -> None:
+        """Joiner half of the elastic handshake: block for the RESHAPE
+        assignment, adopt it, and acknowledge — exactly what a real
+        joiner's Controller does at init."""
+        exc = self._client.await_assignment()
+        self._adopt(exc)
+        self.joined_at_epoch = exc.epoch
+        self._client.wire.send_join({"ack": exc.epoch})
+
+    # ---------------------------------------------------------- tick phase
+
+    def send_tick(self, ops: Optional[List[SimOp]] = None,
+                  shutdown: bool = False) -> None:
+        """Phase 1 of a cycle: this rank's tick. ``ops`` mirror what the
+        coordinator rank enqueued this step (negotiation completes only
+        when every rank reports a tensor). The sim never advertises
+        cache bits — the harness pins HOROVOD_CACHE_CAPACITY=0, the one
+        documented fidelity carve-out (docs/simcluster.md)."""
+        if not self.alive:
+            raise SimWorkerDead(f"logical rank {self.rank} is gone")
+        ops = ops or []
+        # Accumulate, don't replace: the coordinator builds its own tick
+        # BEFORE blocking on worker ticks, so a tensor announced on
+        # cycle k may only negotiate (and exchange data) on cycle k+1,
+        # after an empty follow-up tick.
+        self._pending.update({op.name: op for op in ops})
+        requests = [op.request(self.rank) for op in ops]
+        self._client.send({
+            "rank": self.rank,
+            "cache_mask": 0,
+            "invalid_mask": 0,
+            "requests": RequestList(requests=requests, shutdown=shutdown),
+        })
+
+    def recv_reply(self) -> Tuple[str, Optional[dict]]:
+        """Phase 2: the coordinator's cycle reply. Returns
+        ``("reply", reply_dict)`` in the steady case; ``("reshape", None)``
+        after adopting + acking a membership change mid-stream (the
+        step's collectives are torn — the driver retries them at the new
+        epoch, like ``hvd.elastic.run``); ``("abort", None)`` after a
+        coordinated abort (this rank records the diagnosis and is done)."""
+        if not self.alive:
+            raise SimWorkerDead(f"logical rank {self.rank} is gone")
+        try:
+            reply = self._client.recv()
+        except RanksChangedError as exc:
+            self.apply_reshape(exc)
+            return "reshape", None
+        except RemoteAbortError as exc:
+            self.abort = exc
+            self.close()
+            return "abort", None
+        tune = reply.get("tune")
+        if tune is not None:
+            # Mirror Controller._apply_tune: the synced knobs every rank
+            # adopts from the cycle reply — including the r13 bucket-size
+            # element (docs/overlap.md), which the sync test pins here.
+            self.last_tune = tune
+            if len(tune) > 3 and tune[3].get("bucket_bytes"):
+                self.tuned_bucket_bytes = int(tune[3]["bucket_bytes"])
+        return "reply", reply
+
+    # ----------------------------------------------------------- data phase
+
+    def data_send(self, response) -> None:
+        """Per-response send half, in the identical order every rank
+        walks (the lockstep contract). Fused allreduces concatenate in
+        ``tensor_names`` order, exactly like ``_execute_allreduce``."""
+        if not self.alive:
+            raise SimWorkerDead(f"logical rank {self.rank} is gone")
+        rtype = response.response_type
+        self.executed.update(response.tensor_names)
+        if rtype == ResponseType.ERROR:
+            self.errors.append(response.error_message)
+            for name in response.tensor_names:
+                self._pending.pop(name, None)
+            return
+        if rtype == ResponseType.ALLREDUCE:
+            arrays = [self._pending[n].array.ravel()
+                      for n in response.tensor_names]
+            buf = arrays[0] if len(arrays) == 1 else np.concatenate(arrays)
+            self._client.send_bytes(buf.tobytes())
+        elif rtype == ResponseType.ALLGATHER:
+            op = self._pending[response.tensor_names[0]]
+            self._client.send_bytes(op.array.tobytes())
+        elif rtype == ResponseType.BROADCAST:
+            op = self._pending[response.tensor_names[0]]
+            if self.rank == op.root_rank:
+                self._client.send_bytes(op.array.tobytes())
+
+    def data_recv(self, response) -> None:
+        """Per-response receive half; stores results by tensor name."""
+        if not self.alive:
+            raise SimWorkerDead(f"logical rank {self.rank} is gone")
+        rtype = response.response_type
+        if rtype == ResponseType.ERROR:
+            return
+        if rtype == ResponseType.ALLREDUCE:
+            entries = [self._pending.pop(n) for n in response.tensor_names]
+            dtype = entries[0].array.dtype
+            flat = np.frombuffer(self._client.recv_bytes(), dtype=dtype)
+            offset = 0
+            for op in entries:
+                n = op.array.size
+                self.results[op.name] = np.array(
+                    flat[offset:offset + n]).reshape(op.array.shape)
+                offset += n
+        elif rtype == ResponseType.ALLGATHER:
+            op = self._pending.pop(response.tensor_names[0])
+            rest = op.array.shape[1:]
+            raw = np.frombuffer(self._client.recv_bytes(),
+                                dtype=op.array.dtype)
+            self.results[op.name] = raw.reshape(
+                (sum(response.tensor_sizes),) + rest)
+        elif rtype == ResponseType.BROADCAST:
+            op = self._pending.pop(response.tensor_names[0])
+            if self.rank == op.root_rank:
+                self.results[op.name] = op.array
+            else:
+                raw = np.frombuffer(self._client.recv_bytes(),
+                                    dtype=op.array.dtype)
+                self.results[op.name] = raw.reshape(op.array.shape)
+
+    # ------------------------------------------------------------ membership
+
+    def apply_reshape(self, exc: RanksChangedError) -> None:
+        """Adopt a membership assignment and acknowledge it — the worker
+        half of ``reform()``'s ack handshake. Pending collectives from
+        the dead epoch are discarded, mirroring ``_drain_epoch``."""
+        self._adopt(exc)
+        self._pending.clear()
+        self.reshapes += 1
+        self._client.wire.send_join({"ack": exc.epoch})
+
+    def _adopt(self, exc: RanksChangedError) -> None:
+        self.rank = int(exc.rank)
+        self.size = int(exc.size)
+        self.epoch = int(exc.epoch)
+
+    # ------------------------------------------------------------- lifetime
+
+    def kill(self) -> None:
+        """A crash, as the coordinator sees one: the socket closes with
+        no farewell. (A graceful FaultPlan "leave" looks identical on
+        the wire — the exit-code difference is a process-tier concept
+        with no wire-level footprint.)"""
+        self.close()
+
+    def close(self) -> None:
+        if self.alive:
+            self.alive = False
+            try:
+                self._client.close()
+            except OSError:
+                pass

@@ -251,6 +251,129 @@ def modeled_events_from_measured(
     return out
 
 
+# --------------------------------------------------------------------------
+# Control-plane cost calibration (round 13): until the sim harness
+# (horovod_tpu/sim, docs/simcluster.md) existed, everything this module
+# said about hundred-rank behavior was extrapolated from <= 4-rank
+# measurements. The simcluster measurement rig records per-world-size
+# negotiation step latency, elastic reshape time, and heartbeat fanout
+# cost (artifacts/simcluster_r13.json); the functions below fit the
+# model's control-plane curves FROM that data — linear in world size,
+# which is what the coordinator's O(N) tick gather / assignment fanout
+# predicts — and the artifact gate (tests/test_simcluster.py) asserts
+# model-vs-measured agreement at multiple world sizes, so the curve is
+# validated, not assumed.
+
+
+@dataclasses.dataclass
+class ControlPlaneCalibration:
+    """Fitted linear cost curves for the coordinator's O(N) loops:
+    ``cost(n) = base + per_rank * n`` seconds."""
+
+    negotiation_base_s: float
+    negotiation_per_rank_s: float
+    reshape_base_s: float
+    reshape_per_rank_s: float
+    heartbeat_base_s: float
+    heartbeat_per_rank_s: float
+    source: str = "assumed"
+
+    def negotiation_seconds(self, n: int) -> float:
+        return self.negotiation_base_s + self.negotiation_per_rank_s * n
+
+    def reshape_seconds(self, n: int) -> float:
+        return self.reshape_base_s + self.reshape_per_rank_s * n
+
+    def heartbeat_fanout_seconds(self, n: int) -> float:
+        return self.heartbeat_base_s + self.heartbeat_per_rank_s * n
+
+
+def fit_linear(points: Dict[int, float]) -> Tuple[float, float]:
+    """Least-squares ``base + per_rank * n`` over ``{n: seconds}``,
+    clamped to non-negative coefficients (a negative marginal cost per
+    rank is measurement noise, not physics). One point degenerates to a
+    pure per-rank rate — the conservative reading at larger n."""
+    items = sorted(points.items())
+    if not items:
+        raise ValueError("fit_linear needs at least one (n, seconds) point")
+    if len(items) == 1:
+        n, secs = items[0]
+        return 0.0, max(0.0, secs / max(1, n))
+    ns = [float(n) for n, _ in items]
+    ys = [float(y) for _, y in items]
+    n_mean = sum(ns) / len(ns)
+    y_mean = sum(ys) / len(ys)
+    var = sum((n - n_mean) ** 2 for n in ns)
+    cov = sum((n - n_mean) * (y - y_mean) for n, y in zip(ns, ys))
+    slope = cov / var if var else 0.0
+    slope = max(0.0, slope)
+    base = max(0.0, y_mean - slope * n_mean)
+    return base, slope
+
+
+def fit_control_plane(measured: Dict[int, dict],
+                      source: str = "measured") -> ControlPlaneCalibration:
+    """Fit the three control-plane curves from per-world-size sim
+    measurements: ``{n: {"negotiate_step_seconds": s,
+    "reshape_seconds": s, "heartbeat_fanout_seconds": s}}`` (absent
+    fields are skipped per curve)."""
+
+    def curve(key: str) -> Tuple[float, float]:
+        pts = {n: row[key] for n, row in sorted(measured.items())
+               if row.get(key) is not None}
+        if not pts:
+            return 0.0, 0.0
+        return fit_linear(pts)
+
+    neg = curve("negotiate_step_seconds")
+    resh = curve("reshape_seconds")
+    hb = curve("heartbeat_fanout_seconds")
+    return ControlPlaneCalibration(
+        negotiation_base_s=neg[0], negotiation_per_rank_s=neg[1],
+        reshape_base_s=resh[0], reshape_per_rank_s=resh[1],
+        heartbeat_base_s=hb[0], heartbeat_per_rank_s=hb[1],
+        source=source)
+
+
+def control_plane_report(measured: Dict[int, dict]) -> dict:
+    """Fit + per-size model-vs-measured residuals, JSON-ready — the
+    shape ``artifacts/simcluster_r13.json`` embeds and the artifact gate
+    asserts on. Residuals are relative to the measured value."""
+    cal = fit_control_plane(measured)
+    rows = {}
+    for n in sorted(measured):
+        row = measured[n]
+        entry = {}
+        for key, predict in (
+                ("negotiate_step_seconds", cal.negotiation_seconds),
+                ("reshape_seconds", cal.reshape_seconds),
+                ("heartbeat_fanout_seconds", cal.heartbeat_fanout_seconds)):
+            got = row.get(key)
+            if got is None:
+                continue
+            pred = predict(n)
+            entry[key] = {
+                "measured": round(float(got), 6),
+                "predicted": round(float(pred), 6),
+                "rel_err": (round(abs(pred - got) / got, 4)
+                            if got else None),
+            }
+        rows[str(n)] = entry
+    return {
+        "calibration": dataclasses.asdict(cal),
+        "model_vs_measured": rows,
+    }
+
+
+def control_plane_from_artifact(data: dict) -> ControlPlaneCalibration:
+    """Rebuild the calibration from a loaded simcluster artifact (the
+    ``control_plane`` section keyed by world size)."""
+    measured = {int(n): row
+                for n, row in sorted(data["control_plane"].items())}
+    return fit_control_plane(
+        measured, source=data.get("substrate", "artifact"))
+
+
 def measured_overlap_report(events: Sequence[BucketEvent],
                             compute_start_s: float,
                             compute_end_s: float) -> dict:

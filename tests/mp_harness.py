@@ -68,6 +68,17 @@ def free_port():
     return port
 
 
+def counter_by_label(snap, name):
+    """First-label -> value view of one labeled counter in a metrics
+    snapshot (hvd.metrics.snapshot() shape). Shared by the mp elastic
+    acceptance tests and their in-process simcluster siblings — both
+    assert on the same membership counters, one from a printed rank-0
+    snapshot, the other from the harness's final snapshot."""
+    entry = snap.get(name) or {}
+    return {tuple(labels)[0] if labels else "": value
+            for labels, value in entry.get("values", [])}
+
+
 def launch_rank(scenario, rank, size, addr, extra_env=None):
     """Spawn ONE mp_worker rank against an existing controller address.
     Building block for run_ranks and for elastic tests that add late

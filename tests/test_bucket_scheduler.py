@@ -298,6 +298,74 @@ def test_bucket_bytes_joins_gp_search_and_env_pins(monkeypatch):
     assert current_bucket_bytes() == 8 << 20
 
 
+# ----------------------------------- synced push over the cycle reply (r13)
+
+
+def test_tune_reply_element_applies_bucket_on_every_rank():
+    """The worker-side half of the r13 bucket sync: Controller's
+    _apply_tune adopts the reply's bucket element into the process-wide
+    scheduler override — the docs/overlap.md rank-0-local limitation is
+    gone on the TCP-star controller. Older 3-element pushes (no bucket)
+    must keep working untouched."""
+    from horovod_tpu.controller.controller import Controller
+
+    ctl = Controller.__new__(Controller)
+    ctl._fusion_threshold = 1 << 26
+    ctl._cycle_time_ms = 5.0
+    ctl._hier_allreduce = False
+    ctl._hier_allgather = False
+    ctl._cache_enabled = True
+    try:
+        off = ctl._apply_tune((1 << 25, 2.5, {}, {"bucket_bytes": 4 << 20}))
+        assert off is False
+        assert ctl._fusion_threshold == 1 << 25
+        assert current_bucket_bytes() == 4 << 20
+        # Legacy-shaped push: no extras element, override untouched.
+        ctl._apply_tune((1 << 24, 1.0, {"cache_enabled": True}))
+        assert current_bucket_bytes() == 4 << 20
+        # Cache-off push still reports it (the caller renegotiates).
+        assert ctl._apply_tune(
+            (1 << 24, 1.0, {"cache_enabled": False}, {})) is True
+    finally:
+        set_autotuned_bucket_bytes(None)
+
+
+def test_tuned_bucket_rides_synced_cycle_reply_to_every_rank():
+    """End to end over real wires: an autotuning TCP-star coordinator's
+    first scored configuration ships the bucket size in the cycle
+    reply's tune element, and every logical rank receives + adopts the
+    SAME value — pinned on the sim harness, whose workers record the
+    reply verbatim (the sync the GP needs to score a world where all
+    ranks moved together)."""
+    from horovod_tpu.sim import SimCluster, allreduce_spec
+
+    try:
+        with SimCluster(ranks=4, elastic=False,
+                        env={"HOROVOD_AUTOTUNE": "1"}) as c:
+            # warmup(3) + samples(10) scored cycles reach the first BO
+            # step; one more cycle carries the push. Generous margin.
+            synced = None
+            for k in range(40):
+                c.run_step([allreduce_spec(
+                    f"t.{k}", lambda r: np.ones(256, np.float32))])
+                values = {w.tuned_bucket_bytes
+                          for _, w in sorted(c.workers.items())}
+                if values != {None}:
+                    synced = values
+                    if None not in values:
+                        break
+            assert synced is not None, \
+                "no tune push carried a bucket size within 40 steps"
+            final = {w.tuned_bucket_bytes
+                     for _, w in sorted(c.workers.items())}
+            assert len(final) == 1 and None not in final, final
+            # The pushed value is the coordinator's live GP knob: the
+            # apply-side override must agree on this (rank-0) process.
+            assert current_bucket_bytes() in final
+    finally:
+        set_autotuned_bucket_bytes(None)
+
+
 # ------------------------------------------- mp acceptance (bit identity)
 
 from mp_harness import free_port as _free_port  # noqa: E402

@@ -520,6 +520,7 @@ def test_exporter_serves_doctor_route(monkeypatch):
 # persistent-straggler Diagnosis naming rank 1, live AND offline.
 
 
+@pytest.mark.slow  # tier-1 sibling: the 64-rank storm (test_simcluster.py) pins live straggler naming; rule units + CLI tests cover offline
 def test_delay_chaos_doctor_names_rank1_live_and_offline(tmp_path):
     """Acceptance: a seeded FaultPlan delay on every rank-1 wire_send
     yields a persistent-straggler Diagnosis naming rank 1 — (a) live via

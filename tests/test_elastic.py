@@ -17,6 +17,7 @@ import pytest
 
 from mp_harness import (
     assert_protocheck_clean,
+    counter_by_label,
     free_port,
     launch_rank,
     protocheck_env,
@@ -457,10 +458,7 @@ def _rank0_snapshot(outputs):
     return json.loads(lines[-1].split(" ", 1)[1])
 
 
-def _counter_by_label(snap, name):
-    entry = snap.get(name) or {}
-    return {tuple(labels)[0] if labels else "": value
-            for labels, value in entry.get("values", [])}
+_counter_by_label = counter_by_label  # shared helper (mp_harness)
 
 
 def _elastic_env():
@@ -508,6 +506,7 @@ def test_elastic_graceful_leave_shrinks_cleanly():
         assert "ELASTIC size=2 epoch=2" in outputs[rank], outputs[rank]
 
 
+@pytest.mark.slow  # tier-1 sibling: test_simcluster.py::test_sim_kill_shrink_then_join_regrow
 def test_elastic_join_admits_third_rank():
     """A 2-rank elastic job absorbs a late joiner: the joiner's JOIN
     hello is parked, admitted at the next epoch boundary, state syncs
@@ -549,6 +548,7 @@ def test_elastic_join_admits_third_rank():
     assert transitions.get("grow", 0) >= 1, transitions
 
 
+@pytest.mark.slow  # tier-1 sibling: test_simcluster.py::test_sim_parked_joiner_at_max_ranks_epoch_stable
 def test_elastic_parked_joiner_at_max_ranks_does_not_livelock():
     """A joiner dialing a job already at --max-ranks stays PARKED: the
     members keep training at epoch 1 with no reshape (an unconditional

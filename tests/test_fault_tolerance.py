@@ -528,6 +528,7 @@ def test_coordinator_death_aborts_workers_descriptively():
     assert "lost contact with the coordinator" in outs[1], outs[1]
 
 
+@pytest.mark.slow  # tier-1 sibling: test_simcluster.py::test_sim_dropped_tick_trips_deadline_and_aborts
 def test_dropped_tick_trips_deadline_and_coordinated_abort():
     """A dropped (not closed — the socket stays open) frame is invisible
     until the per-recv deadline fires: with heartbeats off, the coordinator

@@ -1,0 +1,544 @@
+"""simcluster (ISSUE 13): multiplexed hundred-rank simulation.
+
+Three layers of coverage:
+
+* **units** — group_kill plan validation + process-side scoping, the
+  sim fault driver's deterministic schedule, the expected-diagnoses
+  arithmetic, the scenario judge, and the linear control-plane fit.
+* **harness** — real Controller + CoordinatorService against
+  multiplexed SimWorkers: collective correctness, elastic shrink /
+  join / parked-at-capacity / correlated rack kill, the non-elastic
+  abort and dropped-tick deadline paths (in-process siblings of the
+  heaviest @slow mp chaos tests — see the sibling notes on each), every
+  one under ``HOROVOD_PROTOCHECK=1`` with zero violations asserted.
+* **acceptance** — the 64-logical-rank seeded join/leave storm with a
+  correlated rack failure and a flapping-NIC straggler: epochs settle,
+  collectives stay consistent with live membership, protocheck records
+  zero off-spec transitions, and the doctor names the injected
+  straggler AND the most-departed rank (256-rank variant @slow).
+  Plus the artifact gate: ``artifacts/simcluster_r13.json``'s fitted
+  control-plane calibration must reproduce its own measured points at
+  every world size, and the 8/32-rank overlap model check must agree
+  within the documented tolerance.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mp_harness import counter_by_label
+
+from horovod_tpu.fault.plan import FaultPlan, FaultRule
+from horovod_tpu.sim import (
+    SimCluster,
+    SimFaultDriver,
+    allreduce_spec,
+    expected_diagnoses,
+    run_scenario,
+)
+from horovod_tpu.sim.cluster import StepSpec
+from horovod_tpu.sim.faults import load_rules
+from horovod_tpu.sim.scenario import _judge_diagnoses
+from horovod_tpu.utils import scaling_model as sm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "artifacts", "simcluster_r13.json")
+
+
+# ---------------------------------------------------------------------------
+# group_kill plan kind (fault/plan.py)
+
+
+def test_group_kill_rule_requires_cycle_site_and_ranks():
+    with pytest.raises(ValueError, match="group_kill.*needs.*ranks"):
+        FaultRule(site="cycle", action="group_kill", at=3)
+    with pytest.raises(ValueError, match='only applies to site "cycle"'):
+        FaultRule(site="wire_send", action="group_kill", at=3,
+                  ranks=[1, 2])
+    with pytest.raises(ValueError, match="ranks.*only applies"):
+        FaultRule(site="cycle", action="kill", at=3, ranks=[1, 2])
+    rule = FaultRule(site="cycle", action="group_kill", at=3,
+                     ranks=[5, 4, 4])
+    assert rule.ranks == [4, 4, 5]  # sorted, validated ints
+    assert rule.fires_at(3) and not rule.fires_at(2)
+
+
+def test_group_kill_scopes_to_victim_ranks_per_process():
+    """The process-side filter: the rule loads in exactly the victim
+    ranks, so each dies at the same lockstep cycle count — nobody else
+    even counts it."""
+    text = json.dumps({"faults": [
+        {"site": "cycle", "action": "group_kill", "at": 7,
+         "ranks": [2, 3]},
+        {"site": "cycle", "action": "delay", "at": 1, "rank": 1,
+         "seconds": 0.0},
+    ]})
+    in_victim = FaultPlan.from_json(text, rank=2)
+    assert [r.action for r in in_victim.rules] == ["group_kill"]
+    outside = FaultPlan.from_json(text, rank=1)
+    assert [r.action for r in outside.rules] == ["delay"]
+    # No rank identity -> the victim test cannot run: fail at load, not
+    # silently drop the rule (a chaos run that tests nothing).
+    with pytest.raises(ValueError, match="HOROVOD_RANK"):
+        FaultPlan.from_json(text, rank=None)
+
+
+# ---------------------------------------------------------------------------
+# sim fault driver + expectations
+
+
+def test_sim_fault_driver_schedule_is_deterministic():
+    plan = json.dumps({"seed": 7, "faults": [
+        {"site": "cycle", "action": "kill", "rank": 3, "at": 2},
+        {"site": "cycle", "action": "group_kill", "ranks": [5, 6],
+         "at": 4},
+        {"site": "cycle", "action": "leave", "rank": 7, "at": 4},
+        {"site": "cycle", "action": "join", "rank": 1, "at": 5},
+        {"site": "cycle", "action": "delay", "rank": 2, "at": 1,
+         "times": 3, "seconds": 0.02, "jitter": 0.5},
+    ]})
+    alive = list(range(1, 9))
+
+    def schedule():
+        driver = SimFaultDriver.from_json(plan)
+        rows = []
+        for cycle in range(1, 6):
+            f = driver.faults_for_cycle(cycle, alive)
+            rows.append((sorted(f.kills), sorted(f.leaves), f.joins,
+                         {r: round(s, 9) for r, s in sorted(
+                             f.delays.items())}))
+        return rows
+
+    first, second = schedule(), schedule()
+    assert first == second  # seeded jitter: bit-identical schedules
+    assert first[1][0] == [3]
+    assert first[3][0] == [5, 6] and first[3][1] == [7]
+    assert first[4][2] == 1
+    assert 2 in first[0][3] and 0.01 <= first[0][3][2] <= 0.03
+
+
+def test_sim_driver_rejects_unsupported_rules():
+    with pytest.raises(ValueError, match="cycle granularity"):
+        SimFaultDriver([FaultRule(site="wire_send", action="drop", at=1)])
+    with pytest.raises(ValueError, match="cannot express"):
+        SimFaultDriver([FaultRule(site="cycle", action="raise", at=1)])
+
+
+def test_expected_diagnoses_arithmetic():
+    rules, _ = load_rules(json.dumps({"faults": [
+        # 30 delayed cycles >= the live straggler rule's 20-sample floor
+        {"site": "cycle", "action": "delay", "rank": 5, "at": 1,
+         "times": 30, "seconds": 0.03},
+        # below the 10ms lateness floor: must NOT be expected
+        {"site": "cycle", "action": "delay", "rank": 6, "at": 1,
+         "times": 30, "seconds": 0.004},
+        {"site": "cycle", "action": "kill", "rank": 9, "at": 4},
+        {"site": "cycle", "action": "group_kill", "ranks": [20, 21],
+         "at": 8},
+        {"site": "cycle", "action": "kill", "rank": 9, "at": 12},
+        {"site": "cycle", "action": "join", "rank": 1, "at": 14},
+    ]}))
+    exp = expected_diagnoses(rules, cycles=34)
+    assert exp["straggler_ranks"] == [5]
+    # 3 departure cycles + 1 join cycle = 4 transitions >= churn floor;
+    # a group_kill is ONE reshape however many victims it takes.
+    assert exp["churn"] is True
+    assert exp["most_departed"] == 9  # departed twice (renumbered slot)
+    assert exp["departures"] == {9: 2, 20: 1, 21: 1}
+    # Truncated run: rules past the horizon don't count.
+    exp_short = expected_diagnoses(rules, cycles=3)
+    assert exp_short["churn"] is False and \
+        exp_short["most_departed"] is None
+
+
+def test_expected_diagnoses_counts_wildcard_departures_as_churn():
+    """A rank=None kill/leave departs every alive rank (the driver's
+    semantics): the victims can't be named from the plan alone, but the
+    churn must still be EXPECTED — otherwise a wildcard storm silently
+    weakens the judge into exit-0 without checking diagnoses."""
+    rules, _ = load_rules(json.dumps({"faults": [
+        {"site": "cycle", "action": "leave", "at": 2, "times": 3}]}))
+    exp = expected_diagnoses(rules, cycles=10)
+    assert exp["churn"] is True         # 3 departure cycles >= floor
+    assert exp["most_departed"] is None  # honestly unattributable
+
+
+def test_scenario_judge_flags_undiagnosed_faults():
+    expected = {"straggler_ranks": [5], "churn": True,
+                "most_departed": 9, "departures": {9: 2}}
+    problems = []
+    _judge_diagnoses(
+        [{"rule": "persistent_straggler", "rank": 5, "severity": "warning",
+          "summary": "s"},
+         {"rule": "membership_churn", "rank": 9, "severity": "warning",
+          "summary": "s"}],
+        expected, problems)
+    assert problems == []
+    problems = []
+    _judge_diagnoses(
+        [{"rule": "membership_churn", "rank": 3, "severity": "warning",
+          "summary": "s"}],
+        expected, problems)
+    assert len(problems) == 2  # missing straggler + wrong churn rank
+    assert any("straggler rank 5" in p for p in problems)
+    assert any("most-departed rank 9" in p for p in problems)
+
+
+# ---------------------------------------------------------------------------
+# control-plane fit
+
+
+def test_fit_linear_recovers_exact_line_and_clamps():
+    base, slope = sm.fit_linear({8: 1.8, 16: 2.6, 32: 4.2, 64: 7.4})
+    assert abs(base - 1.0) < 1e-9 and abs(slope - 0.1) < 1e-9
+    # Negative marginal cost is noise, not physics: clamped to zero.
+    base, slope = sm.fit_linear({8: 2.0, 64: 1.0})
+    assert slope == 0.0 and base > 0
+    # One point degenerates to a conservative pure per-rank rate.
+    base, slope = sm.fit_linear({32: 6.4})
+    assert base == 0.0 and abs(slope - 0.2) < 1e-9
+    with pytest.raises(ValueError):
+        sm.fit_linear({})
+
+
+def test_control_plane_report_shape():
+    measured = {8: {"negotiate_step_seconds": 0.008,
+                    "reshape_seconds": 0.004,
+                    "heartbeat_fanout_seconds": 0.0005},
+                64: {"negotiate_step_seconds": 0.064,
+                     "reshape_seconds": 0.032,
+                     "heartbeat_fanout_seconds": 0.004}}
+    rep = sm.control_plane_report(measured)
+    cal = rep["calibration"]
+    assert cal["negotiation_per_rank_s"] == pytest.approx(1e-3)
+    rows = rep["model_vs_measured"]
+    assert sorted(rows) == ["64", "8"]
+    assert rows["64"]["negotiate_step_seconds"]["rel_err"] < 1e-6
+
+
+# ---------------------------------------------------------------------------
+# harness: collectives + elastic membership, all under protocheck
+
+
+def test_sim_collectives_match_across_64_logical_ranks():
+    """64 logical ranks in-process: allreduce/allgather/broadcast all
+    agree bit-exactly between the real coordinator and every multiplexed
+    worker, and the whole run is protocol-conformant."""
+    with SimCluster(ranks=64, elastic=False) as c:
+        res = c.run_step([
+            allreduce_spec("ar", lambda r: np.array([r + 1.0, 2.0],
+                                                    np.float32)),
+            StepSpec("allgather", "ag",
+                     lambda r: np.array([[r]], np.int64)),
+            StepSpec("broadcast", "bc",
+                     lambda r: (np.array([3.5], np.float32) if r == 7
+                                else np.zeros(1, np.float32)),
+                     root_rank=7),
+        ])
+        assert float(res.results0["ar"][0]) == sum(range(1, 65))
+        assert float(res.results0["ar"][1]) == 128.0
+        assert res.results0["ag"].ravel().tolist() == list(range(64))
+        assert res.results0["bc"].tolist() == [3.5]
+        for rank in sorted(c.workers):
+            w = c.workers[rank]
+            np.testing.assert_array_equal(w.results["ar"],
+                                          res.results0["ar"])
+            np.testing.assert_array_equal(w.results["bc"],
+                                          res.results0["bc"])
+    rep = c.protocheck_report
+    assert rep["ok"] and rep["transitions"] > 0, rep
+
+
+def test_sim_kill_shrink_then_join_regrow():
+    """In-process sibling of the @slow mp pair
+    ``test_elastic_shrink_survives_killed_rank`` /
+    ``test_elastic_join_admits_third_rank``: a kill re-forms at epoch 2
+    with the shrink + departure counters; a joiner is parked, admitted
+    at the next boundary, and the world regrows — collectives exact
+    throughout."""
+    with SimCluster(ranks=8, elastic=True) as c:
+        c.run_step([allreduce_spec("warm",
+                                   lambda r: np.ones(1, np.float32))])
+        c.kill(3)
+        res = c.run_step([allreduce_spec(
+            "shrunk", lambda r: np.ones(1, np.float32))])
+        assert c.epoch == 2 and c.size == 7
+        assert float(res.results0["shrunk"][0]) == 7.0
+        c.spawn_joiner()
+        res = c.run_step([allreduce_spec(
+            "regrown", lambda r: np.ones(1, np.float32))])
+        assert c.epoch == 3 and c.size == 8
+        assert float(res.results0["regrown"][0]) == 8.0
+        assert sorted(c.workers) == list(range(1, 8))  # contiguous again
+    assert c.protocheck_report["ok"]
+    snap = c.final_metrics
+    transitions = counter_by_label(snap,
+                                   "hvd_membership_transitions_total")
+    assert transitions.get("shrink", 0) >= 1
+    assert transitions.get("grow", 0) >= 1
+    departures = counter_by_label(
+        snap, "hvd_membership_rank_departures_total")
+    assert departures.get("3", 0) >= 1
+
+
+def test_sim_parked_joiner_at_max_ranks_epoch_stable():
+    """In-process sibling of the @slow livelock regression
+    ``test_elastic_parked_joiner_at_max_ranks_does_not_livelock``: at
+    --max-ranks capacity a parked joiner must WAIT — no reshape, no
+    epoch bump, members undisturbed — then admission happens the moment
+    capacity frees."""
+    with SimCluster(ranks=6, elastic=True, max_ranks=6) as c:
+        c.spawn_joiner()
+        for k in range(4):
+            res = c.run_step([allreduce_spec(
+                f"parked.{k}", lambda r: np.ones(1, np.float32))])
+            assert c.epoch == 1, "epoch bumped with a parked joiner"
+            assert float(res.results0[f"parked.{k}"][0]) == 6.0
+        assert c.controller._service.parked_joiner_count() == 1
+        c.kill(5)  # capacity frees: the parked joiner takes the slot
+        res = c.run_step([allreduce_spec(
+            "swapped", lambda r: np.ones(1, np.float32))])
+        assert c.size == 6 and c.epoch >= 2
+        assert float(res.results0["swapped"][0]) == 6.0
+        assert c.controller._service.parked_joiner_count() == 0
+    assert c.protocheck_report["ok"]
+
+
+def test_sim_nonelastic_kill_aborts_survivors_descriptively():
+    """In-process sibling of
+    ``test_worker_death_mid_allreduce_aborts_survivors_descriptively``:
+    without elastic, a dead rank becomes ONE coordinated abort naming
+    the rank, delivered to every survivor."""
+    with SimCluster(ranks=6, elastic=False) as c:
+        c.run_step([allreduce_spec("warm",
+                                   lambda r: np.ones(1, np.float32))])
+        c.kill(2)
+        res = c.step([allreduce_spec("doomed",
+                                     lambda r: np.ones(1, np.float32))])
+        assert res.aborted
+        aborted = [w for _, w in sorted(c.workers.items())
+                   if w.abort is not None]
+        assert aborted, "no survivor saw the coordinated abort"
+        for w in aborted:
+            assert w.abort.dead_rank == 2, str(w.abort)
+    assert c.protocheck_report["ok"]
+
+
+def test_sim_dropped_tick_trips_deadline_and_aborts():
+    """In-process sibling of the @slow
+    ``test_dropped_tick_trips_deadline_and_coordinated_abort``: a rank
+    that stays silent (tick never sent) is diagnosed by the
+    coordinator's recv deadline, not by the driver, and the survivors
+    get the abort naming it."""
+    with SimCluster(ranks=4, elastic=False, comm_timeout=1.0) as c:
+        c.run_step([allreduce_spec("warm",
+                                   lambda r: np.ones(1, np.float32))])
+        res = c.step([allreduce_spec("dropped",
+                                     lambda r: np.ones(1, np.float32))],
+                     skip_ticks={2})
+        assert res.aborted
+        for rank in (1, 3):
+            w = c.workers[rank]
+            assert w.abort is not None and w.abort.dead_rank == 2
+    assert c.protocheck_report["ok"]
+    trips = counter_by_label(c.final_metrics,
+                             "hvd_wire_deadline_trips_total")
+    assert trips.get("recv", 0) >= 1, trips
+
+
+def test_sim_correlated_rack_kill_settles_through_retry():
+    """A group_kill of a whole 'rack' lands as ONE correlated failure:
+    reform() drops the other victims mid-handshake and retries at fresh
+    epochs until the world settles — the exact path a rack power cut
+    takes — and the epoch drain keeps collectives exact."""
+    plan = json.dumps({"faults": [
+        {"site": "cycle", "action": "group_kill",
+         "ranks": [8, 9, 10, 11], "at": 2}]})
+    driver = SimFaultDriver.from_json(plan)
+    with SimCluster(ranks=16, elastic=True) as c:
+        for cycle in (1, 2, 3):
+            f = driver.faults_for_cycle(cycle, c.alive_worker_ranks)
+            for rank in sorted(f.kills):
+                c.kill(rank)
+            res = c.run_step([allreduce_spec(
+                f"rack.{cycle}", lambda r: np.ones(1, np.float32))])
+            assert float(res.results0[f"rack.{cycle}"][0]) == float(c.size)
+        assert c.size == 12 and c.epoch >= 2
+    assert c.protocheck_report["ok"]
+    departures = counter_by_label(
+        c.final_metrics, "hvd_membership_rank_departures_total")
+    assert {r for r in departures if departures[r] > 0} == \
+        {"8", "9", "10", "11"}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the seeded storm (ISSUE 13 headline)
+
+STORM_PLAN = {"seed": 13, "faults": [
+    # flapping NIC: rank 5's ticks 30ms late for 30 cycles (>= the
+    # straggler rule's 20-sample / 10ms floors)
+    {"site": "cycle", "action": "delay", "rank": 5, "at": 1,
+     "times": 30, "seconds": 0.03},
+    {"site": "cycle", "action": "kill", "rank": 9, "at": 6},
+    {"site": "cycle", "action": "leave", "rank": 20, "at": 10},
+    # correlated rack failure: four ranks at once
+    {"site": "cycle", "action": "group_kill",
+     "ranks": [40, 41, 42, 43], "at": 14},
+    {"site": "cycle", "action": "join", "rank": 1, "at": 16},
+    {"site": "cycle", "action": "join", "rank": 1, "at": 18},
+    # the renumbered slot 9 dies AGAIN: the most-departed label
+    {"site": "cycle", "action": "kill", "rank": 9, "at": 22},
+]}
+
+
+def _storm(ranks, steps=34):
+    driver = SimFaultDriver.from_json(json.dumps(STORM_PLAN))
+    result = run_scenario(ranks, driver, steps=steps)
+    assert result.ok, "\n".join(result.problems)
+    # Membership settled: 2 joiners replaced 2 of the 7 departures.
+    assert result.final_size == ranks - 5
+    assert result.final_epoch >= 6
+    assert result.transitions > 0 and not result.violations
+    # Set-based: at large N the shared-GIL substrate can make the doctor
+    # flag additional (real, harness-induced) stragglers beside the
+    # injected one — the contract is that the INJECTED faults are named.
+    stragglers = {f["rank"] for f in result.findings
+                  if f["rule"] == "persistent_straggler"}
+    assert 5 in stragglers, result.findings
+    churn = {f["rank"] for f in result.findings
+             if f["rule"] == "membership_churn"}
+    assert 9 in churn, result.findings
+    return result
+
+
+def test_sim_64_rank_storm_protocheck_zero_doctor_names_faults():
+    """THE acceptance scenario: a 64-logical-rank job survives a seeded
+    join/leave storm with a correlated rack failure and a flapping-NIC
+    straggler — membership epochs settle, every completed step's
+    allreduce matches the live world size, HOROVOD_PROTOCHECK records
+    zero off-spec transitions across every wire of every epoch, and the
+    live doctor names the injected straggler (rank 5) and the
+    most-departed rank (9)."""
+    _storm(64)
+
+
+@pytest.mark.slow
+def test_sim_256_rank_storm_protocheck_zero_doctor_names_faults():
+    _storm(256)
+
+
+# ---------------------------------------------------------------------------
+# artifact gate: calibration is validated, not assumed
+
+
+def test_simcluster_artifact_model_vs_measured_gate():
+    """The committed measurement record must stay self-consistent: the
+    linear control-plane fit reproduces the measured negotiation and
+    reshape points at EVERY recorded world size (negotiation within
+    15%, reshape/heartbeat within 35% — small-n rows carry sub-ms
+    absolute costs), and re-fitting from the raw rows reproduces the
+    recorded calibration."""
+    with open(ARTIFACT, encoding="utf-8") as f:
+        data = json.load(f)
+    sizes = data["world_sizes"]
+    assert len(sizes) >= 4 and max(sizes) >= 64
+    rows = data["model_vs_measured"]
+    checked = 0
+    for n in sorted(rows, key=int):
+        entry = rows[n]
+        assert entry["negotiate_step_seconds"]["rel_err"] <= 0.15, (n, entry)
+        if "reshape_seconds" in entry:
+            assert entry["reshape_seconds"]["rel_err"] <= 0.35, (n, entry)
+        assert entry["heartbeat_fanout_seconds"]["rel_err"] <= 0.35, \
+            (n, entry)
+        checked += 1
+    assert checked >= 2  # the >=2-world-sizes acceptance bar
+    refit = sm.control_plane_from_artifact(data)
+    cal = data["calibration"]
+    assert refit.negotiation_per_rank_s == pytest.approx(
+        cal["negotiation_per_rank_s"], rel=1e-6)
+    assert refit.reshape_per_rank_s == pytest.approx(
+        cal["reshape_per_rank_s"], rel=1e-6)
+    # The curves are real costs: strictly positive per-rank terms.
+    assert refit.negotiation_per_rank_s > 0
+    assert refit.reshape_per_rank_s > 0
+
+
+def test_simcluster_artifact_overlap_model_beyond_2_ranks():
+    """Round-12's model-vs-measured overlap check extended past its
+    2-rank probe: the committed 8- and 32-rank runs agree within the
+    documented 0.25 tolerance, and the recorded diff is re-derivable
+    from the recorded efficiencies."""
+    with open(ARTIFACT, encoding="utf-8") as f:
+        data = json.load(f)
+    overlap = data["overlap"]
+    assert len(overlap) >= 2 and any(int(n) > 4 for n in overlap)
+    for n in sorted(overlap, key=int):
+        row = overlap[n]
+        assert row["model_vs_measured_diff"] <= 0.25, (n, row)
+        assert row["model_vs_measured_diff"] == pytest.approx(
+            abs(row["overlap_efficiency"]
+                - row["modeled_overlap_efficiency"]), abs=1e-3)
+        assert row["buckets"] >= 2
+
+
+def test_overlap_model_validated_live_at_8_ranks():
+    """Satellite: the overlap/scaling model holds ON THIS BOX at >4
+    ranks — a live 8-logical-rank bucket-scheduler run, measured and
+    reconstructed with the same r12 recipe, within the same 0.25
+    tolerance docs/overlap.md documents (generous: the box's pace
+    swings +-20%)."""
+    from horovod_tpu.sim.measure import run_overlap_probe
+
+    row = run_overlap_probe(8, grads=8, grad_elems=4096,
+                            interval_s=0.004)
+    assert row["buckets"] >= 2
+    assert 0.0 < row["overlap_efficiency"] <= 1.0
+    assert row["model_vs_measured_diff"] <= 0.25, row
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_tools_simcluster_cli_clean_run_exits_zero(capsys):
+    from horovod_tpu.tools.simcluster import main
+
+    rc = main(["--ranks", "8", "--steps", "4"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "8 logical ranks" in out and "0 violation(s)" in out
+
+
+def test_tools_simcluster_cli_total_rack_loss_yields_verdict(capsys):
+    """A plan that kills EVERY worker at once must still end in a
+    verdict, not a traceback: the elastic coordinator re-forms down to
+    a size-1 world and rank 0's collectives execute alone (the step
+    machinery waits its handles instead of abandoning them)."""
+    from horovod_tpu.tools.simcluster import main
+
+    plan = json.dumps({"faults": [
+        {"site": "cycle", "action": "group_kill", "ranks": [1, 2, 3],
+         "at": 2}]})
+    rc = main(["--ranks", "4", "--steps", "4", "--plan", plan, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    verdict = json.loads(out)
+    assert verdict["final_size"] == 1 and verdict["problems"] == []
+
+
+def test_tools_simcluster_cli_json_verdict(tmp_path, capsys):
+    from horovod_tpu.tools.simcluster import main
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"faults": [
+        {"site": "cycle", "action": "kill", "rank": 3, "at": 2}]}))
+    rc = main(["--ranks", "6", "--steps", "5", "--plan", f"@{plan}",
+               "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    verdict = json.loads(out)
+    assert verdict["final_size"] == 5 and verdict["final_epoch"] == 2
+    assert verdict["problems"] == [] and verdict["violations"] == []
