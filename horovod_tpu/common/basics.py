@@ -338,7 +338,11 @@ def init(ranks: Optional[Sequence[int]] = None) -> None:
         # the TCP ring) is the default whenever the launcher exported ring
         # addresses; HOROVOD_ENGINE=python (or the star data plane) keeps the
         # Python controller. The choice must be identical on every rank —
-        # both derive from launcher-exported env, so it is.
+        # both derive from launcher-exported env, so it is. Tracing
+        # (HOROVOD_TRACE_DIR) no longer steers this choice: since round 14
+        # the native engine stamps the same span vocabulary into its C
+        # ring (docs/tracing.md), so traced jobs keep the fast path; only
+        # elastic membership still requires the python controller below.
         from .config import ring_data_plane_enabled
 
         engine = config_mod.engine()

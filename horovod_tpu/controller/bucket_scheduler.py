@@ -65,16 +65,14 @@ _autotuned_bucket_bytes: Optional[int] = None
 def set_autotuned_bucket_bytes(nbytes: Optional[int]) -> None:
     """Push a tuned bucket size (None restores the env/default value).
 
-    Two callers (docs/overlap.md): on the python (TCP-star) controller
-    the value arrives on EVERY rank via the synced cycle reply
-    (``Controller._apply_tune``, r13) so bucket launch grouping moves
-    together across the job; on the native engine the tune loop runs on
-    rank 0 only and this push stays rank-0-local (the bucket is a
-    Python-tier knob with no C++ token slot yet) — that skew is safe,
-    bucket boundaries only shape WHEN a rank enqueues and negotiation
-    launches each collective once every rank has enqueued it, but pin
-    ``HOROVOD_BUCKET_BYTES`` for multi-rank native determinism. Safe to
-    retune live: the size never touches the wire format."""
+    Two callers, one sync contract (docs/overlap.md): on the python
+    (TCP-star) controller the value arrives on EVERY rank via the synced
+    cycle reply (``Controller._apply_tune``, r13); on the native engine
+    the value rides a token slot on the C++ cycle reply
+    (``hvd_eng_set_tuned_bucket``, r14) and every rank's telemetry loop
+    applies it here — so bucket launch grouping moves together across
+    the job under either engine. Safe to retune live: the size never
+    touches the wire format."""
     global _autotuned_bucket_bytes
     _autotuned_bucket_bytes = int(nbytes) if nbytes else None
 

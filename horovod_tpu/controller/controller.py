@@ -372,8 +372,8 @@ class Controller:
             # The gradient-bucket size joins the search on the python
             # engine too (r13): its tuned value rides the synced cycle
             # reply (_apply_tune), so every rank's BucketScheduler moves
-            # together — the native engine's rank-0-local push cannot
-            # offer that (docs/overlap.md).
+            # together — the native engine syncs it the same way through
+            # its C++ reply token slot (docs/overlap.md).
             self._param_manager = make_parameter_manager(
                 config, tune_hierarchical=self._local_ring is not None,
                 tune_cache=True, tune_bucket=True)

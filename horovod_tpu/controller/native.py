@@ -29,6 +29,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 import numpy as np
@@ -236,6 +237,45 @@ class NativeController:
         # granularity only, so each rank may set — and later retune — its
         # own without cross-rank agreement.
         bindings.set_chunk_bytes(resolved_ring_chunk_bytes())
+
+        # Cluster tracing (docs/tracing.md): the engine stamps per-op
+        # spans into its C ring (enqueue/negotiate/fuse/execute/done with
+        # the coordinator-assigned seq id — the same vocabulary and
+        # correlation key the Python controller emits), and the telemetry
+        # thread below drains them into the ordinary per-rank TraceWriter
+        # each cycle. Inert without HOROVOD_TRACE_DIR: the engine's span
+        # path stays behind one never-armed atomic flag.
+        self._tracer = None
+        self._trace_dir = config.trace_dir
+        if config.trace_dir:
+            from ..common.config import _env_int
+            from ..trace import TraceWriter, rank_trace_path
+
+            try:
+                os.makedirs(config.trace_dir, exist_ok=True)
+                self._tracer = TraceWriter(
+                    rank_trace_path(config.trace_dir, topology.rank),
+                    topology.rank)
+            except OSError as exc:
+                logging.error(
+                    "trace: cannot write under %s (%s); rank %d will "
+                    "record no spans", config.trace_dir, exc, topology.rank)
+            if self._tracer is not None:
+                # Ring capacity: the span cap knob, clamped by the C side
+                # ([256, 2^20]); 0 keeps the engine default (2^16).
+                lib.hvd_eng_trace_set(
+                    1, _env_int("HOROVOD_TRACE_MAX_EVENTS", 0))
+
+        # Telemetry thread (every rank): drains the engine's span ring
+        # into the TraceWriter and adopts the synced tuned-bucket value
+        # from the cycle reply. The hvd_native_* metrics mirror rides
+        # metrics.snapshot() instead (the hvd_ring_* pattern).
+        self._applied_bucket = 0
+        self._telemetry_stop = threading.Event()
+        self._telemetry = threading.Thread(
+            target=self._telemetry_loop, name="hvd-native-telemetry",
+            daemon=True)
+        self._telemetry.start()
 
         # Coordinator-side autotuner: sample engine throughput, retune with
         # the GP, push parameters into the engine (reference ParameterManager
@@ -541,12 +581,85 @@ class NativeController:
                     bindings.set_chunk_bytes(int(chunk))
                 bucket = self._param_manager.bucket_bytes
                 if bucket:
-                    from .bucket_scheduler import set_autotuned_bucket_bytes
-
-                    set_autotuned_bucket_bytes(int(bucket))
+                    # Synced push (docs/overlap.md): the value rides the
+                    # next cycle reply's token slot, so EVERY rank — this
+                    # one included, via its telemetry loop — adopts the
+                    # same bucket size together.
+                    self._lib.hvd_eng_set_tuned_bucket(int(bucket))
                 logging.debug(
                     "native autotune: threshold=%d cycle=%.2fms chunk=%s",
                     int(threshold), float(cycle_ms), chunk)
+
+    def _telemetry_loop(self) -> None:
+        try:
+            # Traced jobs drain the span ring every 20 ms; untraced jobs
+            # only consume the synced bucket value, which moves at
+            # autotune cadence (seconds) — a lazy poll spares the 50 Hz
+            # full-counter marshal (and its tele_mu_ traffic) for one
+            # scalar nobody reads faster than the tuner writes it.
+            interval = 0.02 if self._tracer is not None else 0.5
+            while not self._telemetry_stop.wait(interval):
+                self._drain_telemetry()
+            # Last act, on THIS thread (shutdown() sets the stop flag
+            # only after the engine loop exited, and joins us): drain the
+            # ring's tail spans, close the span file, and merge on rank 0
+            # — the telemetry thread owns the writer's whole lifecycle.
+            self._drain_telemetry()
+            if self._tracer is not None:
+                self._tracer.close()
+                if self.topo.rank == 0:
+                    self._finalize_trace()
+        except Exception as exc:  # telemetry must never wedge a job
+            logging.error("native telemetry thread failed: %s", exc)
+
+    def _drain_telemetry(self) -> None:
+        """One telemetry pass: adopt the synced tuned-bucket value and
+        move any stamped spans from the engine's C ring into the
+        per-rank TraceWriter (same fixed phase vocabulary — merge.py and
+        the straggler attribution consume these with zero changes)."""
+        counters = bindings.native_counters()
+        if counters is not None:
+            bucket = counters["bucket_bytes"]
+            if bucket and bucket != self._applied_bucket:
+                from .bucket_scheduler import set_autotuned_bucket_bytes
+
+                # Arrived on the cycle reply: every rank lands here with
+                # the identical value (docs/overlap.md sync contract).
+                set_autotuned_bucket_bytes(int(bucket))
+                self._applied_bucket = bucket
+        if self._tracer is None:
+            return
+        from ..trace.tracer import PHASES
+
+        for phase, seq, t0, t1, tensors, op in bindings.drain_engine_spans():
+            if not 0 <= phase < len(PHASES):
+                continue  # unknown code from a stale .so: drop, not crash
+            kwargs = {"tensors": tensors} if tensors else {}
+            self._tracer.span(PHASES[phase], t0, t1,
+                              seq=seq if seq >= 0 else None,
+                              op=op or None, **kwargs)
+
+    def _finalize_trace(self) -> None:
+        """Rank 0: merge the per-rank span files and write the straggler
+        report once every rank's file lands (the circulated shutdown flag
+        closes all ranks on the same cycle, so the wait is short). Crash
+        paths leave the per-rank files on disk for horovodrun's post-run
+        merge or the offline CLI — exactly like the Python engine."""
+        from ..trace import merge_trace_dir, write_report
+        from ..trace.merge import rank_trace_files
+
+        deadline = time.monotonic() + 10.0
+        while (len(rank_trace_files(self._trace_dir)) < self.topo.size
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        try:
+            merge_trace_dir(self._trace_dir)
+            write_report(self._trace_dir, feed=True)
+        except Exception as exc:  # never fail shutdown over a merge
+            logging.warning(
+                "trace: native merge failed (%s); merge offline with "
+                "python -m horovod_tpu.tools.straggler %s", exc,
+                self._trace_dir)
 
     @property
     def hierarchical_active(self) -> bool:
@@ -563,3 +676,10 @@ class NativeController:
         if self._tuner is not None:
             self._tuner.join(timeout=2.0)
         self._lib.hvd_eng_shutdown()
+        # The telemetry thread performs the final drain, closes the span
+        # file and (rank 0) merges as its exit path — the engine loop has
+        # already exited above, so the ring's tail spans are all there.
+        # The join bound covers the rank-0 wait for sibling span files; a
+        # stuck merge degrades to the offline CLI, never a wedged job.
+        self._telemetry_stop.set()
+        self._telemetry.join(timeout=40.0)

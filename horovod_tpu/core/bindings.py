@@ -45,6 +45,26 @@ WIRE_LINK_CODES = {
 }
 WIRE_LINK_NAMES = {v: k for k, v in WIRE_LINK_CODES.items()}
 
+# Native-engine telemetry plane (engine.cc): counter-slot layout of
+# hvd_eng_get_counters. MUST mirror enum CounterSlot — the ABI freshness
+# smoke test pins the total slot count against the C return value.
+NATIVE_HIST_BUCKETS = 22   # kHistBuckets: registry DEFAULT_TIME_BUCKETS
+NATIVE_HIST_SLOTS = NATIVE_HIST_BUCKETS + 1  # + the +Inf overflow slot
+NATIVE_COUNTER_SCALARS = (
+    "cycles", "tensors", "fused_tensors", "processed_bytes",
+    "fusion_capacity", "fusion_fill", "spans", "spans_dropped",
+    "bucket_bytes", "cache_hits", "cache_misses")
+_NATIVE_CYCLE_HIST_BASE = len(NATIVE_COUNTER_SCALARS)            # 11
+_NATIVE_EXEC_HIST_BASE = _NATIVE_CYCLE_HIST_BASE + 2 + NATIVE_HIST_SLOTS
+# Trailing slot: engine generation (bumped per init — lets the metrics
+# mirror re-baseline when a new engine restarts the counters at zero).
+_NATIVE_ENGINE_GEN = _NATIVE_EXEC_HIST_BASE + 2 + NATIVE_HIST_SLOTS  # 61
+N_NATIVE_COUNTER_SLOTS = _NATIVE_ENGINE_GEN + 1                      # 62
+
+# Must match enum SpanPhase in engine.cc: codes index the tracer's fixed
+# PHASES vocabulary ("enqueue", "negotiate", "fuse", "execute", "done").
+NATIVE_SPAN_OP_BYTES = 64
+
 # Must match enum DType in ring.cc.
 _DTYPE_CODES = {
     "float32": 0,
@@ -195,6 +215,70 @@ def set_chunk_bytes(nbytes: int) -> None:
         lib.hvd_ring_set_chunk_bytes(int(nbytes))
 
 
+def native_counters() -> Optional[dict]:
+    """The native engine's cumulative telemetry counters
+    (``hvd_eng_get_counters``) as a dict: the scalar slots by name plus
+    ``cycle_seconds``/``execute_seconds`` histograms ({count, sum_seconds,
+    counts[23]} over the registry's DEFAULT_TIME_BUCKETS edges). None when
+    the core isn't loaded, no engine ever initialized in this process
+    (e.g. the Python controller merely using the ring data plane), or the
+    loaded .so reports a different slot layout (ABI drift — also caught
+    loudly by the freshness smoke test)."""
+    lib = loaded()
+    if lib is None or not lib.hvd_eng_active():
+        return None
+    arr = (ctypes.c_longlong * N_NATIVE_COUNTER_SLOTS)()
+    n = lib.hvd_eng_get_counters(arr, N_NATIVE_COUNTER_SLOTS)
+    if n != N_NATIVE_COUNTER_SLOTS:
+        logging.warning(
+            "native engine counter layout drift (.so reports %d slots, "
+            "bindings expect %d); rebuild the core", n,
+            N_NATIVE_COUNTER_SLOTS)
+        return None
+    out = {name: int(arr[i]) for i, name in enumerate(NATIVE_COUNTER_SCALARS)}
+
+    def _hist(base):
+        return {"count": int(arr[base]),
+                "sum_seconds": arr[base + 1] / 1e6,
+                "counts": [int(arr[base + 2 + i])
+                           for i in range(NATIVE_HIST_SLOTS)]}
+
+    out["cycle_seconds"] = _hist(_NATIVE_CYCLE_HIST_BASE)
+    out["execute_seconds"] = _hist(_NATIVE_EXEC_HIST_BASE)
+    out["engine_gen"] = int(arr[_NATIVE_ENGINE_GEN])
+    return out
+
+
+def drain_engine_spans(batch: int = 512):
+    """Yield ``(phase_code, seq, t0, t1, tensors, op)`` for every span in
+    the engine's ring, oldest first, consuming them. ``t0``/``t1`` are
+    CLOCK_MONOTONIC seconds (``time.monotonic()``'s clock), ``seq`` is -1
+    when no collective id applies. Stops when the ring is empty."""
+    lib = loaded()
+    if lib is None:
+        return
+    stride = NATIVE_SPAN_OP_BYTES
+    phases = (ctypes.c_int * batch)()
+    seqs = (ctypes.c_longlong * batch)()
+    t0s = (ctypes.c_double * batch)()
+    t1s = (ctypes.c_double * batch)()
+    tensors = (ctypes.c_int * batch)()
+    ops = ctypes.create_string_buffer(batch * stride)
+    while True:
+        n = lib.hvd_eng_get_spans(batch, phases, seqs, t0s, t1s, tensors,
+                                  ops, stride)
+        if n <= 0:
+            return
+        raw = ops.raw
+        for i in range(n):
+            op = raw[i * stride:(i + 1) * stride].split(b"\0", 1)[0]
+            yield (int(phases[i]), int(seqs[i]), float(t0s[i]),
+                   float(t1s[i]), int(tensors[i]),
+                   op.decode(errors="replace"))
+        if n < batch:
+            return
+
+
 def load() -> Optional[ctypes.CDLL]:
     """Load (building if needed); returns None if the toolchain is absent,
     letting callers fall back to the pure-Python star data plane."""
@@ -331,6 +415,27 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_eng_get_stats.restype = None
         lib.hvd_eng_shutdown.restype = ctypes.c_int
         lib.hvd_eng_last_error.restype = ctypes.c_char_p
+        # Round 14: native telemetry plane — span ring drain, cumulative
+        # counters/histograms, the trace enable flag, the synced
+        # tuned-bucket slot and the span-stamp overhead probe.
+        lib.hvd_eng_active.argtypes = []
+        lib.hvd_eng_active.restype = ctypes.c_int
+        lib.hvd_eng_trace_set.argtypes = [ctypes.c_int, ctypes.c_longlong]
+        lib.hvd_eng_trace_set.restype = None
+        lib.hvd_eng_get_spans.argtypes = [
+            ctypes.c_longlong, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_eng_get_spans.restype = ctypes.c_int
+        lib.hvd_eng_get_counters.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvd_eng_get_counters.restype = ctypes.c_int
+        lib.hvd_eng_set_tuned_bucket.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_set_tuned_bucket.restype = None
+        lib.hvd_eng_span_probe.argtypes = [ctypes.c_longlong]
+        lib.hvd_eng_span_probe.restype = ctypes.c_double
         _lib = lib
         return _lib
 

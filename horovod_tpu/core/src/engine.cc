@@ -169,6 +169,12 @@ struct Entry {
   // error of this tensor's bytes here; controller/native.py carries it
   // into the next allreduce.
   float* residual = nullptr;
+  // Trace stamps (monotonic seconds): user call time, and the moment the
+  // request departed in a tick — taken POST-send like the Python
+  // controller's, so a rank whose sends stall is the rank that looks
+  // late. sent_at < 0 = never departed (cache-bypass ops).
+  double enqueued_at = 0;
+  double sent_at = -1;
 };
 
 struct Tick {
@@ -184,6 +190,14 @@ struct Reply {
   std::vector<uint64_t> bypass_words;
   std::vector<uint64_t> invalid_words;
   ResponseList responses;
+  // Base collective sequence id for this cycle (trace correlation): each
+  // rank derives per-op ids by walking the identical bypass+responses
+  // order, exactly like the Python controller's reply["trace_seq"].
+  long long trace_seq = 0;
+  // Autotuned gradient-bucket size, pushed by rank 0's tune loop and
+  // synced to every rank on the cycle reply (0 = no value yet) — the
+  // token slot the round-13 python-engine tune sync left open.
+  long long bucket_bytes = 0;
 };
 
 void write_tick(Writer& w, const Tick& t) {
@@ -210,6 +224,8 @@ void write_reply(Writer& w, const Reply& rep) {
   w.u8(rep.shutdown ? 1 : 0);
   w.u64vec(rep.bypass_words);
   w.u64vec(rep.invalid_words);
+  w.i64(rep.trace_seq);
+  w.i64(rep.bucket_bytes);
   w.u32((uint32_t)rep.responses.responses.size());
   for (const auto& resp : rep.responses.responses) write_response(w, resp);
 }
@@ -219,6 +235,8 @@ Reply read_reply(Reader& r) {
   rep.shutdown = r.u8() != 0;
   rep.bypass_words = r.u64vec();
   rep.invalid_words = r.u64vec();
+  rep.trace_seq = r.i64();
+  rep.bucket_bytes = r.i64();
   uint32_t n = r.u32();
   for (uint32_t i = 0; i < n && r.ok; i++)
     rep.responses.responses.push_back(read_response(r));
@@ -230,6 +248,93 @@ class EngineError : public std::runtime_error {
  public:
   using std::runtime_error::runtime_error;
 };
+
+// ------------------------------------------------------------- telemetry
+// Native half of the five-layer observability stack (docs/observability.md):
+// per-op trace spans in a fixed-capacity ring behind ONE atomic enabled
+// flag (zero-overhead-off, the r8 cached-boolean contract in C), plus
+// always-on cumulative counters and log-spaced time histograms, all
+// drained over the C ABI (hvd_eng_get_spans / hvd_eng_get_counters) by
+// controller/native.py into the TraceWriter and metrics registry.
+
+// Phase codes: MUST stay index-aligned with trace/tracer.py PHASES
+// ("enqueue", "negotiate", "fuse", "execute", "done") — the Python drain
+// maps code -> PHASES[code] and the vocabulary is lint-frozen.
+enum SpanPhase : int {
+  PH_ENQUEUE = 0,
+  PH_NEGOTIATE = 1,
+  PH_FUSE = 2,
+  PH_EXECUTE = 3,
+  PH_DONE = 4,
+};
+
+constexpr size_t kSpanOpBytes = 64;  // truncated tensor/fused-op name
+
+struct Span {
+  double t0 = 0, t1 = 0;  // CLOCK_MONOTONIC seconds (time.monotonic()'s
+                          // clock — steady_clock on this platform), so the
+                          // Python TraceWriter's monotonic anchor applies.
+  long long seq = -1;     // coordinator-assigned collective seq (-1 none)
+  int32_t phase = 0;      // SpanPhase
+  int32_t tensors = 0;    // fuse spans: entries packed into the fused op
+  char op[kSpanOpBytes] = {0};
+};
+
+// Histogram bucket upper bounds: EXACTLY the registry's
+// DEFAULT_TIME_BUCKETS (metrics/registry.py: 1e-4 * 2^i, i in 0..21) so
+// the Python mirror ingests bucket counts verbatim — no re-binning.
+constexpr int kHistBuckets = 22;
+constexpr int kHistSlots = kHistBuckets + 1;  // + the +Inf overflow slot
+
+struct TimeHist {
+  long long counts[kHistSlots] = {0};
+  long long count = 0;
+  long long sum_us = 0;
+
+  void observe(double seconds) {
+    int i = 0;
+    double edge = 1e-4;
+    while (i < kHistBuckets && seconds > edge) {
+      edge *= 2.0;
+      i++;
+    }
+    counts[i]++;
+    count++;
+    sum_us += (long long)(seconds * 1e6);
+  }
+};
+
+// Counter-slot layout for hvd_eng_get_counters: APPEND-ONLY, mirrored by
+// NATIVE_COUNTER_SLOTS in core/bindings.py (a drift fails the ABI
+// freshness smoke test's slot-count pin).
+enum CounterSlot : int {
+  CTR_CYCLES = 0,
+  CTR_TENSORS = 1,
+  CTR_FUSED_TENSORS = 2,
+  CTR_PROCESSED_BYTES = 3,
+  CTR_FUSION_CAPACITY = 4,
+  CTR_FUSION_FILL = 5,
+  CTR_SPANS = 6,
+  CTR_SPANS_DROPPED = 7,
+  CTR_BUCKET_BYTES = 8,
+  CTR_CACHE_HITS = 9,
+  CTR_CACHE_MISSES = 10,
+  CTR_CYCLE_HIST_COUNT = 11,
+  CTR_CYCLE_HIST_SUM_US = 12,
+  CTR_CYCLE_HIST_BUCKETS = 13,                           // .. +kHistSlots
+  CTR_EXEC_HIST_COUNT = CTR_CYCLE_HIST_BUCKETS + kHistSlots,
+  CTR_EXEC_HIST_SUM_US = CTR_EXEC_HIST_COUNT + 1,
+  CTR_EXEC_HIST_BUCKETS = CTR_EXEC_HIST_SUM_US + 1,      // .. +kHistSlots
+  // Engine generation (bumped per hvd_eng_init): counters restart at
+  // zero with every new engine, so the Python mirror re-baselines when
+  // it sees a new generation instead of clamping on "decreasing" totals.
+  CTR_ENGINE_GEN = CTR_EXEC_HIST_BUCKETS + kHistSlots,
+  N_COUNTER_SLOTS = CTR_ENGINE_GEN + 1,                  // 62
+};
+
+constexpr size_t kSpanRingDefault = 1 << 16;
+constexpr size_t kSpanRingMin = 256;
+constexpr size_t kSpanRingMax = 1 << 20;
 
 // Two-level (hierarchical) data-plane state, populated by hvd_eng_init
 // BEFORE the Engine is constructed (the engine thread starts in the ctor,
@@ -289,6 +394,7 @@ class Engine {
     if (closed_ || shutdown_requested_) return -3;
     if (table_.count(name)) return -2;  // reference IncrementTensorCount dup
     Entry e;
+    e.enqueued_at = mono_s();
     e.residual = (float*)residual;
     e.request.request_rank = rank_;
     e.request.request_type = op;
@@ -367,6 +473,122 @@ class Engine {
     *busy_s = busy_us_.load() / 1e6;
   }
 
+  // --------------------------------------------------- telemetry (any thread)
+
+  void trace_set(bool enabled, long long capacity) {
+    std::lock_guard<std::mutex> g(tele_mu_);
+    if (capacity > 0) {
+      size_t cap = (size_t)std::min<long long>(
+          std::max<long long>(capacity, (long long)kSpanRingMin),
+          (long long)kSpanRingMax);
+      ring_.assign(cap, Span{});
+      ring_head_ = ring_size_ = 0;
+    } else if (ring_.empty()) {
+      ring_.assign(kSpanRingDefault, Span{});
+    }
+    trace_on_.store(enabled, std::memory_order_relaxed);
+  }
+
+  // One complete span into the ring. THE zero-overhead-off contract: with
+  // tracing disabled this is a single relaxed atomic load and a return —
+  // nothing else (pinned by the source guard + measured probe in
+  // tests/test_native_telemetry.py).
+  void stamp_span(int phase, double t0, double t1, long long seq,
+                  int tensors, const char* op) {
+    if (!trace_on_.load(std::memory_order_relaxed)) return;
+    std::lock_guard<std::mutex> g(tele_mu_);
+    if (ring_.empty()) return;
+    size_t cap = ring_.size();
+    size_t pos;
+    if (ring_size_ == cap) {
+      // Full: the NEW span takes the oldest slot (head advances) and the
+      // drop is counted — the engine thread never blocks on a slow
+      // drainer and a record is never torn.
+      pos = ring_head_;
+      ring_head_ = (ring_head_ + 1) % cap;
+      spans_dropped_.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      pos = (ring_head_ + ring_size_) % cap;
+      ring_size_++;
+    }
+    Span& s = ring_[pos];
+    s.t0 = t0;
+    s.t1 = t1;
+    s.seq = seq;
+    s.phase = phase;
+    s.tensors = tensors;
+    std::strncpy(s.op, op ? op : "", kSpanOpBytes - 1);
+    s.op[kSpanOpBytes - 1] = 0;
+    spans_total_.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  // Drain up to `max` spans, oldest first; returns the count consumed.
+  int drain_spans(long long max, int32_t* phases, long long* seqs,
+                  double* t0s, double* t1s, int32_t* tensors, char* ops,
+                  int op_stride) {
+    std::lock_guard<std::mutex> g(tele_mu_);
+    if (ring_.empty() || max <= 0) return 0;
+    long long n = std::min<long long>(max, (long long)ring_size_);
+    for (long long i = 0; i < n; i++) {
+      const Span& s = ring_[(ring_head_ + (size_t)i) % ring_.size()];
+      phases[i] = s.phase;
+      seqs[i] = s.seq;
+      t0s[i] = s.t0;
+      t1s[i] = s.t1;
+      tensors[i] = s.tensors;
+      std::strncpy(ops + (size_t)i * (size_t)op_stride, s.op,
+                   (size_t)op_stride - 1);
+      ops[(size_t)i * (size_t)op_stride + (size_t)op_stride - 1] = 0;
+    }
+    ring_head_ = (ring_head_ + (size_t)n) % ring_.size();
+    ring_size_ -= (size_t)n;
+    return (int)n;
+  }
+
+  void get_counters(long long* out, int n) {
+    long long tmp[N_COUNTER_SLOTS] = {0};
+    tmp[CTR_CYCLES] = cycles_.load(std::memory_order_relaxed);
+    tmp[CTR_TENSORS] = tensors_total_.load(std::memory_order_relaxed);
+    tmp[CTR_FUSED_TENSORS] = fused_tensors_.load(std::memory_order_relaxed);
+    tmp[CTR_PROCESSED_BYTES] =
+        processed_bytes_.load(std::memory_order_relaxed);
+    tmp[CTR_FUSION_CAPACITY] = fusion_cap_.load(std::memory_order_relaxed);
+    tmp[CTR_FUSION_FILL] = fusion_fill_.load(std::memory_order_relaxed);
+    tmp[CTR_SPANS] = spans_total_.load(std::memory_order_relaxed);
+    tmp[CTR_SPANS_DROPPED] =
+        spans_dropped_.load(std::memory_order_relaxed);
+    tmp[CTR_BUCKET_BYTES] = bucket_synced_.load(std::memory_order_relaxed);
+    tmp[CTR_CACHE_HITS] = cache_hits_.load(std::memory_order_relaxed);
+    tmp[CTR_CACHE_MISSES] = cache_misses_.load(std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> g(tele_mu_);
+      tmp[CTR_CYCLE_HIST_COUNT] = cycle_hist_.count;
+      tmp[CTR_CYCLE_HIST_SUM_US] = cycle_hist_.sum_us;
+      for (int i = 0; i < kHistSlots; i++)
+        tmp[CTR_CYCLE_HIST_BUCKETS + i] = cycle_hist_.counts[i];
+      tmp[CTR_EXEC_HIST_COUNT] = exec_hist_.count;
+      tmp[CTR_EXEC_HIST_SUM_US] = exec_hist_.sum_us;
+      for (int i = 0; i < kHistSlots; i++)
+        tmp[CTR_EXEC_HIST_BUCKETS + i] = exec_hist_.counts[i];
+    }
+    for (int i = 0; i < n && i < N_COUNTER_SLOTS; i++) out[i] = tmp[i];
+  }
+
+  // Coordinator-side tuned-bucket slot: the value rides the NEXT cycle
+  // reply to every rank (coordinate() reads it). Harmless on workers.
+  void set_tuned_bucket(long long nbytes) {
+    bucket_push_.store(nbytes, std::memory_order_relaxed);
+  }
+
+  // Micro-bench for the overhead guard: stamp n spans through the real
+  // path (enabled or disabled — whatever trace_set left), return seconds.
+  double span_probe(long long n) {
+    double t0 = mono_s();
+    for (long long i = 0; i < n; i++)
+      stamp_span(PH_EXECUTE, t0, t0, -1, 0, "probe");
+    return mono_s() - t0;
+  }
+
   void request_shutdown() { shutdown_requested_ = true; }
   bool closed() {
     std::lock_guard<std::mutex> g(mu_);
@@ -419,7 +641,12 @@ class Engine {
         double t0 = mono_s();
         if (timeline_) timeline_->mark_cycle_start();
         cycle();
-        busy_us_ += (long long)((mono_s() - t0) * 1e6);
+        double dt = mono_s() - t0;
+        busy_us_ += (long long)(dt * 1e6);
+        {
+          std::lock_guard<std::mutex> g(tele_mu_);
+          cycle_hist_.observe(dt);
+        }
         cycles_++;
       }
     } catch (const std::exception& exc) {
@@ -440,7 +667,7 @@ class Engine {
     if (timeline_) timeline_->close();
   }
 
-  Tick build_tick() {
+  Tick build_tick(std::vector<std::string>* sent_names) {
     std::lock_guard<std::mutex> g(mu_);
     Tick t;
     t.rank = rank_;
@@ -457,6 +684,7 @@ class Engine {
       int stale = cache_.stale_bit(entry.request);
       if (stale >= 0) invalid_mask.set(stale);
       t.requests.push_back(entry.request);
+      sent_names->push_back(name);
     }
     queue_.clear();
     for (const auto& kv : bit_pending_) cache_mask.set(kv.first);
@@ -465,10 +693,25 @@ class Engine {
     return t;
   }
 
+  // Stamp the departure time of this cycle's requests AFTER their tick
+  // left (send-path stalls charge the sender — the Python controller's
+  // POST-send contract). Only runs with tracing on.
+  void mark_sent(const std::vector<std::string>& names) {
+    double now = mono_s();
+    std::lock_guard<std::mutex> g(mu_);
+    for (const auto& name : names) {
+      auto it = table_.find(name);
+      if (it != table_.end()) it->second.sent_at = now;
+    }
+  }
+
   void cycle() {
-    Tick own = build_tick();
+    std::vector<std::string> sent_names;
+    Tick own = build_tick(&sent_names);
+    bool tr = trace_on_.load(std::memory_order_relaxed);
     Reply reply;
     if (size_ == 1) {
+      if (tr && !sent_names.empty()) mark_sent(sent_names);
       reply = coordinate({own});
     } else if (rank_ == 0) {
       // Start the token with our tick; receive it back with everyone's.
@@ -476,6 +719,7 @@ class Engine {
       w.u32(1);
       write_tick(w, own);
       send_frame(w.buf);
+      if (tr && !sent_names.empty()) mark_sent(sent_names);
       std::vector<uint8_t> token = recv_frame();
       Reader r(token.data(), token.size());
       uint32_t n = r.u32();
@@ -499,6 +743,7 @@ class Engine {
       w.buf.insert(w.buf.end(), token.begin() + 4, token.end());
       write_tick(w, own);
       send_frame(w.buf);
+      if (tr && !sent_names.empty()) mark_sent(sent_names);
       // Receive the reply; forward before processing so downstream ranks
       // enter the data phase too.
       std::vector<uint8_t> raw = recv_frame();
@@ -587,6 +832,14 @@ class Engine {
     reply.responses.shutdown = reply.shutdown;
     reply.bypass_words = and_mask.words();
     reply.invalid_words = invalid.words();
+    // One base collective seq id per cycle (the r9 tracer's correlation
+    // key): every rank walks the identical bypass-then-responses order,
+    // so base + index is the same id on every rank's trace row.
+    reply.trace_seq = next_seq_;
+    next_seq_ += (long long)and_mask.bits().size() +
+                 (long long)reply.responses.responses.size();
+    // Synced tuned-bucket push (rank 0's tune loop -> every rank).
+    reply.bucket_bytes = bucket_push_.load(std::memory_order_relaxed);
     return reply;
   }
 
@@ -683,6 +936,9 @@ class Engine {
   // ----------------------------------------------------------- both sides
 
   void process_reply(const Reply& reply) {
+    double reply_at = mono_s();
+    if (reply.bucket_bytes > 0)
+      bucket_synced_.store(reply.bucket_bytes, std::memory_order_relaxed);
     BitMask invalid(reply.invalid_words);
     for (int bit : invalid.bits()) {
       std::lock_guard<std::mutex> g(mu_);
@@ -695,6 +951,10 @@ class Engine {
       }
     }
 
+    // Per-op seq ids: base from the reply, walked over the identical
+    // bypass-then-responses order on every rank (python _process_reply
+    // parity — merged traces correlate across engines on args.seq).
+    long long seq = reply.trace_seq;
     BitMask bypass(reply.bypass_words);
     for (int bit : bypass.bits()) {
       // Cached fast path (reference RunBypass, operations.cc:1166-1215).
@@ -716,11 +976,15 @@ class Engine {
       r.response_type = cached.response_type;
       r.tensor_names.push_back(name);
       r.tensor_sizes = cached.tensor_sizes;
-      execute(r, /*cache_put=*/false);
+      cache_hits_.fetch_add(1, std::memory_order_relaxed);
+      execute(r, /*cache_put=*/false, seq++, reply_at);
     }
 
-    for (const auto& resp : reply.responses.responses)
-      execute(resp, /*cache_put=*/true);
+    for (const auto& resp : reply.responses.responses) {
+      if (resp.response_type != RESP_ERROR)
+        cache_misses_.fetch_add(1, std::memory_order_relaxed);
+      execute(resp, /*cache_put=*/true, seq++, reply_at);
+    }
 
     // Act only on the *circulated* shutdown flag, never the local one: a
     // locally-set flag must first ride a tick so every rank closes on the
@@ -753,7 +1017,8 @@ class Engine {
 
   // ------------------------------------------------------------ data plane
 
-  void execute(const Response& response, bool cache_put) {
+  void execute(const Response& response, bool cache_put, long long seq,
+               double reply_at) {
     if (response.response_type == RESP_ERROR) {
       std::vector<long long> hs;
       {
@@ -785,16 +1050,35 @@ class Engine {
         entries.size() == 1
             ? entries[0]->request.tensor_name
             : "fused[" + std::to_string(entries.size()) + "]";
+    if (trace_on_.load(std::memory_order_relaxed)) {
+      // Retroactive per-tensor spans, now that the fused op's seq is
+      // known (python _execute parity): enqueue = user call -> request
+      // departure; negotiate = departure -> this reply. Cache-bypass ops
+      // never departed — no negotiate span, by design.
+      for (Entry* e : entries) {
+        double dep = e->sent_at >= 0 ? e->sent_at : reply_at;
+        stamp_span(PH_ENQUEUE, e->enqueued_at, dep, seq, 0,
+                   e->request.tensor_name.c_str());
+        if (e->sent_at >= 0)
+          stamp_span(PH_NEGOTIATE, e->sent_at, reply_at, seq, 0,
+                     e->request.tensor_name.c_str());
+      }
+    }
     if (timeline_) timeline_->start(tname, op_name(response.response_type));
 
     long long nbytes = 0;
     if (response.response_type == RESP_ALLREDUCE)
-      nbytes = execute_allreduce(entries, tname);
+      nbytes = execute_allreduce(entries, tname, seq);
     else if (response.response_type == RESP_ALLGATHER)
-      nbytes = execute_allgather(*entries[0], response, tname);
+      nbytes = execute_allgather(*entries[0], response, tname, seq);
     else
-      nbytes = execute_broadcast(*entries[0], tname);
+      nbytes = execute_broadcast(*entries[0], tname, seq);
     processed_bytes_ += nbytes;
+    tensors_total_.fetch_add((long long)entries.size(),
+                             std::memory_order_relaxed);
+    if (entries.size() > 1)
+      fused_tensors_.fetch_add((long long)entries.size(),
+                               std::memory_order_relaxed);
 
     {
       std::lock_guard<std::mutex> g(mu_);
@@ -850,11 +1134,12 @@ class Engine {
   }
 
   long long execute_allreduce(std::vector<Entry*>& entries,
-                              const std::string& tname) {
+                              const std::string& tname, long long seq) {
     uint8_t dtype = entries[0]->request.dtype;
     size_t esz = dtype_size(dtype);
     size_t total_bytes = 0;
     for (Entry* e : entries) total_bytes += e->nbytes;
+    double t_fuse = mono_s();
 
     if (entries.size() == 1) {
       // Unfused: reduce in place directly on the caller's buffer — zero
@@ -862,6 +1147,7 @@ class Engine {
       // place, mpi_operations.cc:40-49).
       Entry* e = entries[0];
       if (timeline_) timeline_->activity_start(tname, allreduce_activity());
+      double t_exec = mono_s();
       if (size_ > 1) {
         if (hier_.allreduce && (hier_.local_ring || hier_.shm)) {
           // Per-link wire dtypes + residual threading: the hier plane
@@ -877,8 +1163,16 @@ class Engine {
       } else if (e->residual) {
         std::memset(e->residual, 0, (total_bytes / esz) * sizeof(float));
       }
+      double t_done = mono_s();
       if (timeline_) timeline_->activity_end(tname);
       complete_in_place(e);
+      observe_exec(t_done - t_exec);
+      if (trace_on_.load(std::memory_order_relaxed)) {
+        double t_end = mono_s();
+        stamp_span(PH_FUSE, t_fuse, t_exec, seq, 1, tname.c_str());
+        stamp_span(PH_EXECUTE, t_exec, t_done, seq, 0, tname.c_str());
+        stamp_span(PH_DONE, t_done, t_end, seq, 0, tname.c_str());
+      }
       return (long long)total_bytes;
     }
 
@@ -892,6 +1186,9 @@ class Engine {
       if (timeline_) timeline_->activity_end(tname);
     }
     fusion_buffer_.resize(total_bytes);
+    fusion_fill_.store((long long)total_bytes, std::memory_order_relaxed);
+    fusion_cap_.store((long long)fusion_buffer_.capacity(),
+                      std::memory_order_relaxed);
 
     if (timeline_) timeline_->activity_start(tname, "MEMCPY_IN_FUSION_BUFFER");
     size_t off = 0;
@@ -903,6 +1200,7 @@ class Engine {
       timeline_->activity_end(tname);
       timeline_->activity_start(tname, allreduce_activity());
     }
+    double t_exec = mono_s();
     // Fused error feedback: the ring records quantization errors for the
     // WHOLE fused buffer into a scratch; each entry's slice is copied out
     // to its own residual after the reduce (entries without one simply
@@ -927,6 +1225,7 @@ class Engine {
                           hvd_ring_last_error());
       }
     }
+    double t_done = mono_s();
     if (timeline_) {
       timeline_->activity_end(tname);
       timeline_->activity_start(tname, "MEMCPY_OUT_FUSION_BUFFER");
@@ -949,6 +1248,14 @@ class Engine {
       complete_in_place(e);
     }
     if (timeline_) timeline_->activity_end(tname);
+    observe_exec(t_done - t_exec);
+    if (trace_on_.load(std::memory_order_relaxed)) {
+      double t_end = mono_s();
+      stamp_span(PH_FUSE, t_fuse, t_exec, seq, (int)entries.size(),
+                 tname.c_str());
+      stamp_span(PH_EXECUTE, t_exec, t_done, seq, 0, tname.c_str());
+      stamp_span(PH_DONE, t_done, t_end, seq, 0, tname.c_str());
+    }
     return (long long)total_bytes;
   }
 
@@ -1023,7 +1330,8 @@ class Engine {
   }
 
   long long execute_allgather(Entry& e, const Response& response,
-                              const std::string& tname) {
+                              const std::string& tname, long long seq) {
+    double t_exec = mono_s();
     uint8_t dtype = e.request.dtype;
     size_t esz = dtype_size(dtype);
     long long trailing = 1;
@@ -1091,16 +1399,21 @@ class Engine {
       std::memcpy(out.data(), e.user, e.nbytes);
     }
     if (timeline_) timeline_->activity_end(tname);
+    double t_done = mono_s();
     std::vector<int64_t> shape = e.request.shape;
     int64_t dim0 = 0;
     for (int64_t s : response.tensor_sizes) dim0 += s;
     shape[0] = dim0;
     long long nbytes = (long long)out.size();
     complete(&e, std::move(shape), std::move(out), response.tensor_sizes);
+    observe_exec(t_done - t_exec);
+    trace_exec_done(seq, tname, t_exec, t_done);
     return nbytes;
   }
 
-  long long execute_broadcast(Entry& e, const std::string& tname) {
+  long long execute_broadcast(Entry& e, const std::string& tname,
+                              long long seq) {
+    double t_exec = mono_s();
     size_t esz = dtype_size(e.request.dtype);
     if (timeline_) timeline_->activity_start(tname, "TCP_COLLECTIVE");
     if (size_ > 1) {
@@ -1112,8 +1425,26 @@ class Engine {
                           hvd_ring_last_error());
     }
     if (timeline_) timeline_->activity_end(tname);
+    double t_done = mono_s();
     complete_in_place(&e);
+    observe_exec(t_done - t_exec);
+    trace_exec_done(seq, tname, t_exec, t_done);
     return (long long)e.nbytes;
+  }
+
+  // execute + done spans for the single-phase ops (allgather/broadcast) —
+  // the Python controller's _trace_exec_done shape.
+  void trace_exec_done(long long seq, const std::string& op, double t0,
+                       double t1) {
+    if (!trace_on_.load(std::memory_order_relaxed)) return;
+    double t2 = mono_s();
+    stamp_span(PH_EXECUTE, t0, t1, seq, 0, op.c_str());
+    stamp_span(PH_DONE, t1, t2, seq, 0, op.c_str());
+  }
+
+  void observe_exec(double seconds) {
+    std::lock_guard<std::mutex> g(tele_mu_);
+    exec_hist_.observe(seconds);
   }
 
   // ------------------------------------------------------------ members
@@ -1158,6 +1489,22 @@ class Engine {
   std::atomic<long long> processed_bytes_{0};
   std::atomic<long long> busy_us_{0};
 
+  // Telemetry plane (span ring + histograms under tele_mu_; counters are
+  // relaxed atomics — always on, a handful of increments per op).
+  std::atomic<bool> trace_on_{false};
+  std::mutex tele_mu_;  // guards ring_/ring_head_/ring_size_/*_hist_
+  std::vector<Span> ring_;
+  size_t ring_head_ = 0, ring_size_ = 0;
+  TimeHist cycle_hist_, exec_hist_;
+  std::atomic<long long> spans_total_{0}, spans_dropped_{0};
+  std::atomic<long long> tensors_total_{0}, fused_tensors_{0};
+  std::atomic<long long> cache_hits_{0}, cache_misses_{0};
+  std::atomic<long long> fusion_fill_{0}, fusion_cap_{0};
+  // Synced tuned-bucket slot: push set on rank 0 via the ABI, synced
+  // adopted from the cycle reply on every rank.
+  std::atomic<long long> bucket_push_{0}, bucket_synced_{0};
+  long long next_seq_ = 0;  // coordinator-only: next collective seq id
+
   std::thread thread_;
 };
 
@@ -1171,6 +1518,7 @@ class Engine {
 Engine* g_engine = nullptr;
 std::mutex g_engine_mu;
 std::string g_last_error;
+long long g_engine_gen = 0;  // bumped per engine init -> CTR_ENGINE_GEN
 
 }  // namespace
 }  // namespace hvd
@@ -1313,6 +1661,7 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
     hvd::g_hier.allreduce = hvd::g_hier.allgather = false;
   }
   // A previous finished engine is leaked deliberately (see g_engine note).
+  hvd::g_engine_gen++;
   hvd::g_engine = new hvd::Engine(
       rank, size, cycle_ms, fusion_threshold, cache_capacity,
       stall_disable != 0, stall_warn_s, stall_shutdown_s,
@@ -1419,6 +1768,54 @@ void hvd_eng_get_stats(long long* cycles, long long* bytes, double* busy_s) {
     *bytes = 0;
     *busy_s = 0;
   }
+}
+
+// 1 when an engine exists in this process (live or finished husk) — lets
+// the Python metrics mirror skip processes whose only native use is the
+// ring data plane (the Python controller also loads this library).
+int hvd_eng_active() { return hvd::g_engine ? 1 : 0; }
+
+// Arm/disarm span tracing. capacity > 0 (re)sizes the span ring (clamped
+// to [256, 2^20]; resets it); capacity <= 0 keeps/creates the default.
+void hvd_eng_trace_set(int enabled, long long capacity) {
+  if (hvd::g_engine) hvd::g_engine->trace_set(enabled != 0, capacity);
+}
+
+// Drain up to `max` spans oldest-first into caller-provided parallel
+// arrays (`ops` holds fixed `op_stride`-byte NUL-terminated name slots);
+// returns the count consumed. Phase codes index trace/tracer.py PHASES.
+int hvd_eng_get_spans(long long max, int* phases, long long* seqs,
+                      double* t0s, double* t1s, int* tensors, char* ops,
+                      int op_stride) {
+  if (!hvd::g_engine) return 0;
+  return hvd::g_engine->drain_spans(max, phases, seqs, t0s, t1s, tensors,
+                                    ops, op_stride);
+}
+
+// Cumulative counters + histogram buckets (slot layout: CounterSlot /
+// bindings.NATIVE_COUNTER_SLOTS). Fills min(n, slot count) entries of
+// `out`; returns the slot count so callers can size-check. Zeros when no
+// engine was ever initialized.
+int hvd_eng_get_counters(long long* out, int n) {
+  if (hvd::g_engine)
+    hvd::g_engine->get_counters(out, n);
+  else
+    for (int i = 0; i < n && i < hvd::N_COUNTER_SLOTS; i++) out[i] = 0;
+  if (n > hvd::CTR_ENGINE_GEN) out[hvd::CTR_ENGINE_GEN] = hvd::g_engine_gen;
+  return hvd::N_COUNTER_SLOTS;
+}
+
+// Rank 0's tune loop pushes the GP-tuned gradient-bucket size here; the
+// value rides the next cycle reply so EVERY rank adopts it together
+// (docs/overlap.md — the token slot the r13 sync left open).
+void hvd_eng_set_tuned_bucket(long long nbytes) {
+  if (hvd::g_engine) hvd::g_engine->set_tuned_bucket(nbytes);
+}
+
+// Overhead micro-bench: stamp n spans through the real path under the
+// current trace_set state; returns elapsed seconds.
+double hvd_eng_span_probe(long long n) {
+  return hvd::g_engine ? hvd::g_engine->span_probe(n) : 0.0;
 }
 
 int hvd_eng_shutdown() {

@@ -228,6 +228,33 @@ def check_clock_sync(ev: Evidence) -> Iterator[Diagnosis]:
                  sorted(ev.straggler_report["clock"].items())}
     if len(clock) < 2:
         return
+    workers = [r for r in sorted(clock) if r != 0]
+    # "No ping plane ran at all" vs "the ping plane is broken": a python
+    # engine job ALWAYS writes a clock_offsets.json (table entries carry
+    # offset_seconds/samples), even when every pong was lost — only the
+    # native engine leaves no table, and the clock evidence then comes
+    # from the merged-trace metadata (applied_offset_seconds-shaped).
+    from_table = any("offset_seconds" in clock[r] or "samples" in clock[r]
+                     for r in workers)
+    if (workers and not from_table
+            and not any(clock[r].get("synced", False) for r in workers)):
+        # No ping-pong plane ran AT ALL — a native-engine traced job:
+        # spans come from the C++ engine's ring, and clock offsets ride
+        # python-side heartbeats only (docs/tracing.md "Native engine").
+        # That is a property of the job, not a broken heartbeat path, so
+        # say so once at info instead of warning per rank.
+        yield Diagnosis(
+            rule="clock_sync_degraded", severity="info", rank=None,
+            summary="no clock-offset table: every worker rank rebases "
+                    "with offset 0 (native-engine jobs run no "
+                    "python-side ping plane)",
+            hint="same-host ranks share one monotonic clock, so the "
+                 "merged timebase and straggler attribution stand; "
+                 "across hosts treat sub-millisecond slacks as clock "
+                 "noise, or run the python engine once to record a "
+                 "clock_offsets.json",
+            evidence={"clock": {str(r): clock[r] for r in workers}})
+        return
     for rank in sorted(clock):
         entry = clock[rank]
         if rank == 0:

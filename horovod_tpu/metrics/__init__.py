@@ -137,6 +137,27 @@ def reset_for_tests() -> None:
         for cache_attr in ("_m", "_em"):
             if isinstance(getattr(mod, cache_attr, None), SimpleNamespace):
                 setattr(mod, cache_attr, None)
+    # Native-mirror baseline: a cleared registry must NOT re-ingest the
+    # process's prior native-engine history on its next refresh (an
+    # engine from an earlier test keeps cumulative counters for the
+    # process lifetime). Baseline the seen-marks at the CURRENT totals;
+    # a subsequently created engine bumps the generation slot, which
+    # refresh_native_engine_metrics treats as a fresh zero baseline.
+    try:
+        from ..core import bindings as _bindings
+
+        current = (_bindings.native_counters()
+                   if _bindings.loaded() is not None else None)
+    except ImportError:
+        current = None
+    with _lock:
+        _native_seen.clear()
+        if current is not None:
+            _native_seen["_gen"] = current["engine_gen"]
+            for key in _bindings.NATIVE_COUNTER_SCALARS:
+                _native_seen[key] = float(current[key])
+            _native_seen["cycle_seconds"] = current["cycle_seconds"]
+            _native_seen["execute_seconds"] = current["execute_seconds"]
 
 
 def default_registry() -> MetricsRegistry:
@@ -158,9 +179,11 @@ def histogram(name: str, help: str = "", labelnames=(),
 
 def snapshot() -> Dict[str, dict]:
     """This rank's registry as a plain dict (JSON/pickle-clean). Mirrors
-    the native ring's wire-traffic counters first, so scrapes and
-    piggybacked pushes always carry the current hvd_ring_* series."""
+    the native ring's wire-traffic counters and the native engine's
+    telemetry counters first, so scrapes and piggybacked pushes always
+    carry the current hvd_ring_* / hvd_native_* series."""
     refresh_ring_wire_metrics()
+    refresh_native_engine_metrics()
     return _registry.snapshot()
 
 
@@ -213,6 +236,144 @@ def refresh_ring_wire_metrics() -> None:
               ).set(stats["chunk_bytes"])
 
 
+# Last-mirrored native engine counters (under _lock): cumulative C totals
+# -> monotone registry increments, the _ring_wire_seen pattern. Histogram
+# keys hold the last {counts, count, sum_seconds} snapshots.
+_native_seen: Dict[str, object] = {}
+
+# Lazy hvd_native_* namespace (the package-wide ``_m`` convention:
+# reset_for_tests drops it with every other module's metric cache).
+_m = None
+
+
+def _native_metrics():
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        from .registry import DEFAULT_TIME_BUCKETS
+
+        _m = SimpleNamespace(
+            cycles=counter(
+                "hvd_native_cycles_total",
+                "Native engine control-token cycles completed"),
+            tensors=counter(
+                "hvd_native_tensors_total",
+                "Tensors the native engine executed collectives for"),
+            fused_tensors=counter(
+                "hvd_native_fused_tensors_total",
+                "Tensors that rode a multi-tensor fusion buffer"),
+            fused_bytes=counter(
+                "hvd_native_fused_bytes_total",
+                "Bytes the native engine's data phases processed"),
+            spans=counter(
+                "hvd_native_spans_total",
+                "Trace spans the native engine stamped into its ring"),
+            spans_dropped=counter(
+                "hvd_native_spans_dropped_total",
+                "Trace spans overwritten (oldest-first) before a drain "
+                "emptied the fixed-capacity span ring"),
+            cache_hits=counter(
+                "hvd_native_cache_hits_total",
+                "Response-cache bypass executions in the native engine"),
+            cache_misses=counter(
+                "hvd_native_cache_misses_total",
+                "Negotiated (uncached) responses the native engine "
+                "executed"),
+            fusion_capacity=gauge(
+                "hvd_native_fusion_buffer_capacity_bytes",
+                "Native fusion buffer reserved capacity"),
+            fusion_fill=gauge(
+                "hvd_native_fusion_buffer_fill_bytes",
+                "Native fusion buffer occupancy at the last fused op"),
+            bucket=gauge(
+                "hvd_native_bucket_bytes",
+                "Autotuned gradient-bucket size synced over the native "
+                "cycle reply (0 = none pushed yet)"),
+            cycle_seconds=histogram(
+                "hvd_native_cycle_seconds",
+                "Native engine cycle duration (token round + data "
+                "phases)", buckets=DEFAULT_TIME_BUCKETS),
+            execute_seconds=histogram(
+                "hvd_native_execute_seconds",
+                "Native engine per-op data-plane execute time",
+                buckets=DEFAULT_TIME_BUCKETS),
+        )
+    return _m
+
+
+def refresh_native_engine_metrics() -> None:
+    """Mirror the native engine's telemetry plane (``hvd_eng_get_counters``,
+    engine.cc) into the registry as ``hvd_native_*`` series: cycle /
+    tensor / fused-byte / span counters, fusion-buffer occupancy gauges,
+    the synced tuned-bucket gauge, and the cycle/execute time histograms
+    (ingested bucket-for-bucket — the C side bins on the registry's
+    DEFAULT_TIME_BUCKETS edges). Never triggers a native build, and a
+    process without an engine (the Python controller merely riding the
+    ring data plane) registers nothing."""
+    if not on():
+        return
+    from ..core import bindings
+
+    if bindings.loaded() is None:
+        return
+    c = bindings.native_counters()
+    if c is None:
+        return
+    with _lock:
+        if _native_seen.get("_gen") != c["engine_gen"]:
+            # A new engine restarted the C counters at zero (one engine
+            # per init; the old husk's totals are dead history): drop the
+            # baseline so the fresh engine's activity mirrors from zero.
+            _native_seen.clear()
+            _native_seen["_gen"] = c["engine_gen"]
+        m = _native_metrics()
+
+        def _ctr(metric, key):
+            val = float(c[key])
+            prev = _native_seen.get(key, 0.0)
+            if val > prev:
+                metric.inc(val - prev)
+                _native_seen[key] = val
+
+        _ctr(m.cycles, "cycles")
+        _ctr(m.tensors, "tensors")
+        _ctr(m.fused_tensors, "fused_tensors")
+        _ctr(m.fused_bytes, "processed_bytes")
+        _ctr(m.spans, "spans")
+        _ctr(m.spans_dropped, "spans_dropped")
+        _ctr(m.cache_hits, "cache_hits")
+        _ctr(m.cache_misses, "cache_misses")
+        m.fusion_capacity.set(c["fusion_capacity"])
+        m.fusion_fill.set(c["fusion_fill"])
+        m.bucket.set(c["bucket_bytes"])
+
+        def _hist(hist, key):
+            cur = c[key]
+            prev = _native_seen.get(key) or {
+                "counts": [0] * len(cur["counts"]), "count": 0,
+                "sum_seconds": 0.0}
+            dcount = cur["count"] - prev["count"]
+            if dcount <= 0:
+                return
+            # Bulk bucket ingest under the metric's own lock: the C side
+            # already binned on the registry's bucket edges, and
+            # observe() has no way to land a count in a chosen bin.
+            child = hist._default()
+            with hist._lock:
+                for i, (a, b) in enumerate(zip(cur["counts"],
+                                               prev["counts"])):
+                    if a > b:
+                        child.counts[i] += a - b
+                child.count += dcount
+                child.sum += max(0.0,
+                                 cur["sum_seconds"] - prev["sum_seconds"])
+            _native_seen[key] = cur
+
+        _hist(m.cycle_seconds, "cycle_seconds")
+        _hist(m.execute_seconds, "execute_seconds")
+
+
 def _local_rank() -> Optional[int]:
     return env_rank()
 
@@ -231,8 +392,11 @@ def remote_snapshots() -> Dict[int, Dict[str, dict]]:
 
 def render_all() -> str:
     """Prometheus exposition of the local registry plus every ingested
-    remote snapshot — what the scrape endpoint serves."""
-    return render_prometheus(_registry.snapshot(), _local_rank(),
+    remote snapshot — what the scrape endpoint serves. Goes through
+    snapshot() so a scrape always carries the freshly mirrored
+    hvd_ring_* / hvd_native_* native counters (under the native engine
+    nothing else calls snapshot() periodically)."""
+    return render_prometheus(snapshot(), _local_rank(),
                              remote_snapshots())
 
 
@@ -329,11 +493,20 @@ def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
     is still present with a 0 value: a well-formed all-zeros dict that
     downstream consumers can index and chart without None-guards."""
     snap = snap if snap is not None else snapshot()
-    hits = _counter_total(snap, "hvd_controller_cache_hits_total") or 0.0
-    misses = _counter_total(snap, "hvd_controller_cache_misses_total") or 0.0
+    # Engine-agnostic: the python controller's series plus the native
+    # engine's hvd_native_* mirror — only one engine runs per process, so
+    # summing is exact, and a native job's health rows stop reading zero.
+    hits = ((_counter_total(snap, "hvd_controller_cache_hits_total") or 0.0)
+            + (_counter_total(snap, "hvd_native_cache_hits_total") or 0.0))
+    misses = ((_counter_total(snap, "hvd_controller_cache_misses_total")
+               or 0.0)
+              + (_counter_total(snap, "hvd_native_cache_misses_total")
+                 or 0.0))
     total = hits + misses
     hit_rate = round(hits / total, 4) if total else 0.0
     cycle = snap.get("hvd_controller_cycle_seconds")
+    if quantile(cycle, 0.5) is None:
+        cycle = snap.get("hvd_native_cycle_seconds")
     p50 = quantile(cycle, 0.5) or 0.0
     p99 = quantile(cycle, 0.99) or 0.0
     # Wire-compression savings straight from the native ring's counters
@@ -369,8 +542,9 @@ def controller_health(snap: Optional[Dict[str, dict]] = None) -> dict:
     return {
         "cycle_seconds_p50": round(p50, 6),
         "cycle_seconds_p99": round(p99, 6),
-        "fused_bytes_total": _counter_total(
-            snap, "hvd_controller_fused_bytes_total") or 0,
+        "fused_bytes_total": (_counter_total(
+            snap, "hvd_controller_fused_bytes_total") or 0)
+        + (_counter_total(snap, "hvd_native_fused_bytes_total") or 0),
         "cache_hit_rate": hit_rate,
         "wire_bytes_total": sum(tx.values()),
         "wire_savings_frac": _savings(tx, logical),

@@ -441,26 +441,20 @@ def _run_attempt(args: argparse.Namespace, restart_epoch: int = 0,
     hosts = parse_hosts(args.hosts, args.np)
     if getattr(args, "trace", None):
         # Cluster tracing (docs/tracing.md): every rank writes spans under
-        # the shared dir; rank 0 merges at shutdown. The span source is
-        # the Python controller, so --trace pins HOROVOD_ENGINE=python
-        # unless the operator chose an engine explicitly.
+        # the shared dir; rank 0 merges at shutdown. BOTH eager engines
+        # emit the same fixed phase vocabulary now — the native C++
+        # engine stamps spans into its C ring and the controller drains
+        # them (round 14) — so --trace no longer pins
+        # HOROVOD_ENGINE=python; traced jobs keep the fast path.
         os.makedirs(args.trace, exist_ok=True)
         os.environ["HOROVOD_TRACE_DIR"] = args.trace
-        if not args.spmd and "HOROVOD_ENGINE" not in os.environ:
-            os.environ["HOROVOD_ENGINE"] = "python"
-            sys.stderr.write(
-                "horovodrun: --trace selects the python controller engine "
-                "(HOROVOD_ENGINE=python) — spans are emitted there; set "
-                "HOROVOD_ENGINE explicitly to override\n")
-        elif args.spmd or config_mod.engine() != "python":
-            # Say so NOW, not via an empty directory at exit: only the
-            # python controller emits spans.
+        if args.spmd:
+            # Say so NOW, not via an empty directory at exit: spans come
+            # from the eager controllers, not the SPMD tier.
             sys.stderr.write(
                 "horovodrun: WARNING --trace has no span source under "
-                + ("--spmd" if args.spmd
-                   else f"HOROVOD_ENGINE={config_mod.engine()}")
-                + " — collective spans come from the python controller "
-                "engine; expect no trace.rank*.json files "
+                "--spmd — collective spans come from the eager controller "
+                "engines; expect no trace.rank*.json files "
                 "(docs/tracing.md)\n")
     size = args.np
     secret = config_mod.secret_key_hex() or make_secret()
@@ -789,9 +783,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--trace", metavar="DIR", default=None,
                         help="cluster-wide distributed tracing: every rank "
                              "writes clock-anchored phase spans under DIR "
-                             "(HOROVOD_TRACE_DIR); rank 0 merges them into "
-                             "DIR/merged_trace.json with a straggler report "
-                             "at shutdown (docs/tracing.md)")
+                             "(HOROVOD_TRACE_DIR) — under either eager "
+                             "engine, native included; rank 0 merges them "
+                             "into DIR/merged_trace.json with a straggler "
+                             "report at shutdown (docs/tracing.md)")
     parser.add_argument("--disable-cache", action="store_true",
                         help="skip the ssh-preflight result cache "
                              "(reference horovodrun --disable-cache)")

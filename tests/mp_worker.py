@@ -1279,6 +1279,43 @@ def scenario_wire_exact(rank, size):
     np.testing.assert_array_equal(tot2, tot)
 
 
+def scenario_native_telemetry(rank, size):
+    # Native-engine telemetry acceptance (tests/test_native_telemetry.py):
+    # under HOROVOD_ENGINE=native with HOROVOD_METRICS=1, steady traffic
+    # must light the hvd_native_* series, make controller_health() stop
+    # reporting zeros, and carry rank 0's tuned-bucket push to EVERY rank
+    # over the synced cycle reply.
+    import json as _json
+
+    from horovod_tpu.controller import bucket_scheduler
+    from horovod_tpu.core import bindings as _bindings
+
+    for i in range(30):
+        out = np.asarray(hvd.allreduce(np.ones(2048, np.float32) * i,
+                                       average=False, name=f"nt.{i}"))
+        np.testing.assert_allclose(out, float(size) * i)
+    # Repeated name: the response cache's bypass path must count hits.
+    for _ in range(5):
+        np.asarray(hvd.allreduce(np.ones(8, np.float32),
+                                 average=False, name="nt.cached"))
+    if rank == 0:
+        # The synced token slot: the value rides the next cycle reply.
+        _bindings.load().hvd_eng_set_tuned_bucket(7 << 20)
+    deadline = time.monotonic() + 30.0
+    while (bucket_scheduler.current_bucket_bytes() != 7 << 20
+           and time.monotonic() < deadline):
+        time.sleep(0.05)  # cycles keep ticking; the telemetry loop applies
+    expect(bucket_scheduler.current_bucket_bytes() == 7 << 20,
+           f"rank {rank}: tuned bucket never arrived over the cycle reply")
+    health = hvd.metrics.controller_health()
+    expect(health["cycle_seconds_p50"] > 0, f"health zeros: {health}")
+    expect(health["fused_bytes_total"] > 0, f"health zeros: {health}")
+    snap = hvd.metrics.snapshot()
+    expect("hvd_native_cycles_total" in snap, sorted(snap))
+    print("HEALTH " + _json.dumps(health), flush=True)
+    print("METRICS_SNAPSHOT " + _json.dumps(snap), flush=True)
+
+
 def scenario_copybench(rank, size):
     # Micro-bench: unfused large-buffer allreduce, value path (1 defensive
     # copy) vs in-place path (0 copies). Prints bytes/sec for the parent
@@ -1387,6 +1424,7 @@ SCENARIOS = {
     "elastic_parked": scenario_elastic_parked,
     "elastic_storm": scenario_elastic_storm,
     "metrics_cluster": scenario_metrics_cluster,
+    "native_telemetry": scenario_native_telemetry,
     "trace": scenario_trace,
     "doctor": scenario_doctor,
     "allreduce": scenario_allreduce,

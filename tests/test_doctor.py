@@ -172,6 +172,38 @@ def test_clock_sync_degraded_unsynced_and_uncertain():
     assert not diagnose(Evidence(clock={0: {"synced": True}}))
 
 
+def test_clock_sync_native_job_without_ping_plane_is_one_info():
+    """NO worker synced = no python-side ping plane ran at all (a
+    native-engine traced job, docs/tracing.md "Native engine"): one
+    info-severity finding explaining the property — not a per-rank
+    broken-heartbeat warning."""
+    ev = Evidence(clock={
+        0: {"applied_offset_seconds": 0.0, "synced": True},
+        1: {"applied_offset_seconds": 0.0, "synced": False,
+            "uncertainty_seconds": None},
+        2: {"applied_offset_seconds": 0.0, "synced": False,
+            "uncertainty_seconds": None},
+    })
+    findings = [f for f in diagnose(ev) if f.rule == "clock_sync_degraded"]
+    assert len(findings) == 1
+    assert findings[0].severity == "info"
+    assert findings[0].rank is None
+    assert "native" in findings[0].summary
+    assert set(findings[0].evidence["clock"]) == {"1", "2"}
+    # A python-engine job ALWAYS writes the offsets TABLE (entries carry
+    # offset_seconds/samples) — all-unsynced THERE is a genuinely broken
+    # ping plane and must stay a per-rank WARNING, never the info branch.
+    broken = Evidence(clock={
+        0: {"offset_seconds": 0.0, "synced": True},
+        1: {"offset_seconds": 0.0, "samples": 0, "synced": False},
+        2: {"offset_seconds": 0.0, "samples": 0, "synced": False},
+    })
+    findings = [f for f in diagnose(broken)
+                if f.rule == "clock_sync_degraded"]
+    assert {f.rank for f in findings} == {1, 2}
+    assert all(f.severity == "warning" for f in findings)
+
+
 def test_recv_wait_skew_names_outlier_rank():
     snaps = {
         0: _hist_snapshot("hvd_wire_recv_wait_seconds",

@@ -130,6 +130,19 @@ def test_known_series_present():
         "hvd_router_replica_departures_total",
         "hvd_router_replica_joins_total",
         "hvd_router_affinity_hits_total",
+        "hvd_native_cycles_total",
+        "hvd_native_tensors_total",
+        "hvd_native_fused_tensors_total",
+        "hvd_native_fused_bytes_total",
+        "hvd_native_cache_hits_total",
+        "hvd_native_cache_misses_total",
+        "hvd_native_spans_total",
+        "hvd_native_spans_dropped_total",
+        "hvd_native_fusion_buffer_capacity_bytes",
+        "hvd_native_fusion_buffer_fill_bytes",
+        "hvd_native_bucket_bytes",
+        "hvd_native_cycle_seconds",
+        "hvd_native_execute_seconds",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
 

@@ -324,15 +324,20 @@ def test_build_freshness_and_abi_matches_bindings():
         if fname.endswith((".cc", ".h")):
             with open(os.path.join(src_dir, fname)) as f:
                 src += f.read()
-    # Flat-ring wire ABI (round 10) AND the hierarchical entry points
+    # Flat-ring wire ABI (round 10), the hierarchical entry points
     # (round 12: per-link wire stats, link tagging, rate cap, the
-    # handle-ring collectives the two-level plane is built from).
+    # handle-ring collectives the two-level plane is built from) AND the
+    # round-14 telemetry plane (span drain, counters, trace flag, synced
+    # bucket slot, overhead probe).
     for func in ("hvd_ring_allreduce_wire", "hvd_ringh_allreduce_wire",
                  "hvd_eng_init", "hvd_eng_enqueue",
                  "hvd_ring_get_wire_stats", "hvd_ring_get_wire_stats_link",
                  "hvd_ringh_set_link", "hvd_ringh_set_rate",
                  "hvd_ringh_allreduce", "hvd_ringh_allgather",
-                 "hvd_ringh_broadcast", "hvd_ringh_create"):
+                 "hvd_ringh_broadcast", "hvd_ringh_create",
+                 "hvd_eng_get_spans", "hvd_eng_get_counters",
+                 "hvd_eng_trace_set", "hvd_eng_set_tuned_bucket",
+                 "hvd_eng_span_probe", "hvd_eng_active"):
         assert hasattr(lib, func)
         declared = len(getattr(lib, func).argtypes)
         in_source = _c_arg_count(src, func)
@@ -341,9 +346,17 @@ def test_build_freshness_and_abi_matches_bindings():
             f"defines {in_source} — the ctypes ABI drifted")
     # The wire-dtype args specifically: hvd_eng_init grew to 14 args in
     # round 10 and to 16 in round 12 (hierarchical local/cross wire
-    # dtypes); enqueue grew to 8 in round 10.
+    # dtypes); enqueue grew to 8 in round 10. Round 14 added telemetry
+    # as NEW entry points, so both stay pinned.
     assert len(lib.hvd_eng_init.argtypes) == 16
     assert len(lib.hvd_eng_enqueue.argtypes) == 8
+    # Telemetry counter-slot layout: the C side's slot count must match
+    # the bindings' mirror (engine.cc CounterSlot <-> NATIVE_COUNTER_*).
+    import ctypes as _ct
+
+    arr = (_ct.c_longlong * bindings.N_NATIVE_COUNTER_SLOTS)()
+    assert (lib.hvd_eng_get_counters(arr, bindings.N_NATIVE_COUNTER_SLOTS)
+            == bindings.N_NATIVE_COUNTER_SLOTS)
 
 
 # ---------------------------------------------------------- hierarchical
