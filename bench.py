@@ -301,6 +301,17 @@ FULL_ROWS = {
         "script": "examples/simcluster_probe.py",
         "args": ["--out", "artifacts/simcluster_r13.json"],
         "json": True},
+    # Elastic-restore flatness row (round 15): State.restore() on a real
+    # 3-rank elastic job at two model sizes 4x apart, p2p (digest-matched
+    # survivors move zero bytes; jax pytrees also copy zero bytes) vs the
+    # re-measured r12 broadcast baseline. Acceptance: p2p ratio <= 1.5
+    # while broadcast scales with the model. Carries the new
+    # hvd_elastic_restore_seconds histogram. Refreshes
+    # artifacts/elastic_restore_r15.json.
+    "elastic_restore_flat_3rank": {
+        "script": "examples/elastic_restore_probe.py",
+        "args": ["--out", "artifacts/elastic_restore_r15.json"],
+        "json": True},
     "resnet50_b128": None,  # runs child_bench (median of 5 windows)
     "vit_s16_224_b64_adamw_spc8": {
         "script": "examples/jax_vit_training.py",

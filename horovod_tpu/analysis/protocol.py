@@ -89,7 +89,8 @@ def epoch_is_stale(seen: int, current: int) -> bool:
 #                    an ack from the future is a violation
 #   ack_matches      worker's outbound ack must equal the assignment epoch
 
-KINDS = ("data", "heartbeat", "abort", "join", "reshape")
+KINDS = ("data", "heartbeat", "abort", "join", "reshape", "shard_fetch",
+         "shard_data")
 
 # Heartbeats are liveness riding a background thread; they are legal in
 # every state, both directions, and never change state. Spelling that
@@ -113,6 +114,10 @@ SPEC = {
                                     "abort frame during a hello"},
                 ("recv", "reshape"): {"violation":
                                       "reshape frame during a hello"},
+                ("recv", "shard_fetch"): {"violation":
+                                          "shard frame during a hello"},
+                ("recv", "shard_data"): {"violation":
+                                         "shard frame during a hello"},
             },
             "steady": {
                 ("recv", "data"): {"next": "steady",
@@ -123,6 +128,12 @@ SPEC = {
                                       "workers never originate reshapes"},
                 ("recv", "join"): {"violation":
                                    "join frame in the data stream"},
+                ("recv", "shard_fetch"): {"next": "steady",
+                                          "note": "shard request to relay "
+                                                  "(or serve, owner 0)"},
+                ("recv", "shard_data"): {"next": "steady",
+                                         "note": "shard reply to relay "
+                                                 "(or consume, req 0)"},
                 ("send", "data"): {"next": "steady",
                                    "note": "cycle reply / tensor payload"},
                 ("send", "abort"): {"next": "dead",
@@ -133,6 +144,13 @@ SPEC = {
                 ("send", "join"): {"violation":
                                    "the coordinator never sends join "
                                    "frames"},
+                ("send", "shard_fetch"): {"next": "steady",
+                                          "note": "relayed shard request "
+                                                  "(rank 0 requester or "
+                                                  "star hop)"},
+                ("send", "shard_data"): {"next": "steady",
+                                         "note": "relayed or locally "
+                                                 "served shard reply"},
             },
             "parked": {
                 # A validated joiner waiting for an epoch boundary. Only
@@ -150,6 +168,18 @@ SPEC = {
                                     "workers never originate aborts"},
                 ("recv", "reshape"): {"violation":
                                       "workers never originate reshapes"},
+                ("recv", "shard_fetch"): {"violation":
+                                          "parked joiner has no shard "
+                                          "plane until admission"},
+                ("recv", "shard_data"): {"violation":
+                                         "parked joiner has no shard "
+                                         "plane until admission"},
+                ("send", "shard_fetch"): {"violation":
+                                          "no shard relay to a parked "
+                                          "joiner"},
+                ("send", "shard_data"): {"violation":
+                                         "no shard relay to a parked "
+                                         "joiner"},
             },
             "draining": {
                 # After send(reshape): drain the member's wire to its ack.
@@ -163,6 +193,12 @@ SPEC = {
                                             "surfaces a remote abort"},
                 ("recv", "reshape"): {"violation":
                                       "workers never originate reshapes"},
+                ("recv", "shard_fetch"): {"next": "draining",
+                                          "note": "dead-epoch shard "
+                                                  "traffic, discarded"},
+                ("recv", "shard_data"): {"next": "draining",
+                                         "note": "dead-epoch shard "
+                                                 "traffic, discarded"},
                 ("send", "reshape"): {"next": "draining",
                                       "guard": "epoch_advances",
                                       "note": "retry at a fresh epoch after "
@@ -170,6 +206,15 @@ SPEC = {
                                               "handshake"},
                 ("send", "abort"): {"next": "dead",
                                     "note": "job failed mid-reshape"},
+                ("send", "shard_fetch"): {"next": "draining",
+                                          "note": "defensive: a relay "
+                                                  "racing the reshape; "
+                                                  "the member's torn "
+                                                  "restore ignores it"},
+                ("send", "shard_data"): {"next": "draining",
+                                         "note": "defensive: a relayed "
+                                                 "reply racing the "
+                                                 "reshape"},
             },
             "dead": {
                 # Terminal: the job is failing; only stray heartbeats may
@@ -197,12 +242,26 @@ SPEC = {
                                       "note": "membership changed"},
                 ("recv", "join"): {"violation":
                                    "join frame in the data stream"},
+                ("recv", "shard_fetch"): {"next": "steady",
+                                          "note": "relayed shard request "
+                                                  "(this rank owns a "
+                                                  "matching copy)"},
+                ("recv", "shard_data"): {"next": "steady",
+                                         "note": "shard reply for this "
+                                                 "rank's restore"},
                 ("send", "abort"): {"violation":
                                     "workers never originate aborts"},
                 ("send", "reshape"): {"violation":
                                       "workers never originate reshapes"},
                 ("send", "join"): {"violation":
                                    "reshape ack without a reshape"},
+                ("send", "shard_fetch"): {"next": "steady",
+                                          "note": "shard request toward "
+                                                  "the coordinator star"},
+                ("send", "shard_data"): {"next": "steady",
+                                         "note": "served shard reply "
+                                                 "(this rank is the "
+                                                 "owner)"},
             },
             "reshaping": {
                 # Between the RESHAPE tearing out of a recv and this
@@ -212,6 +271,20 @@ SPEC = {
                                    "note": "reshape acknowledgement"},
                 ("send", "data"): {"violation":
                                    "data before the reshape was acked"},
+                # The restore thread may race the RESHAPE by a frame: a
+                # fetch (or a served reply) already leaving when the
+                # assignment lands is LATE traffic the coordinator's
+                # drain discards — legal, unlike data, which would
+                # desync the negotiated stream.
+                ("send", "shard_fetch"): {"next": "reshaping",
+                                          "note": "late fetch from a "
+                                                  "restore the reshape "
+                                                  "is tearing; the "
+                                                  "drain discards it"},
+                ("send", "shard_data"): {"next": "reshaping",
+                                         "note": "late served reply; "
+                                                 "the drain discards "
+                                                 "it"},
                 ("recv", "abort"): {"next": "dead",
                                     "note": "job failed mid-reshape"},
                 ("recv", "reshape"): {"next": "reshaping",
@@ -242,8 +315,20 @@ SPEC = {
                                    "instead of an assignment)"},
                 ("recv", "join"): {"violation":
                                    "join frame echoed back"},
+                ("recv", "shard_fetch"): {"violation":
+                                          "parked joiner has no shard "
+                                          "plane until admission"},
+                ("recv", "shard_data"): {"violation":
+                                         "parked joiner has no shard "
+                                         "plane until admission"},
                 ("send", "data"): {"violation":
                                    "parked joiner sent data"},
+                ("send", "shard_fetch"): {"violation":
+                                          "parked joiner sent shard "
+                                          "traffic"},
+                ("send", "shard_data"): {"violation":
+                                         "parked joiner sent shard "
+                                         "traffic"},
             },
             # Admitted: from here on the wire behaves exactly like a
             # worker's (same transitions, stated once via the post-build
@@ -664,6 +749,8 @@ _NON_DISPATCH_ALLOWED = {
         "Wire.send_bytes", "Wire.send_heartbeat", "Wire.send_abort",
         "Wire.send_join", "Wire.send_reshape", "Wire.try_send_heartbeat",
         "Wire.send_clock_ping", "Wire._handle_clock_payload",
+        "Wire.send_shard_fetch", "Wire.send_shard_data",
+        "Wire._handle_shard_frame",
         "Wire._send_frame", "Wire._try_send_frame", "Wire._recv_frame",
         "<module>",  # FRAME_* constant definitions, _KNOWN_KINDS, names
     },
@@ -682,7 +769,8 @@ _NON_DISPATCH_ALLOWED = {
 _KIND_CONST_TO_NAME = {
     "FRAME_DATA": "data", "FRAME_HEARTBEAT": "heartbeat",
     "FRAME_ABORT": "abort", "FRAME_JOIN": "join",
-    "FRAME_RESHAPE": "reshape",
+    "FRAME_RESHAPE": "reshape", "FRAME_SHARD_FETCH": "shard_fetch",
+    "FRAME_SHARD_DATA": "shard_data",
 }
 
 

@@ -433,6 +433,36 @@ def elastic_max_ranks() -> int:
     return max(0, _env_int("HOROVOD_ELASTIC_MAX_RANKS", 0))
 
 
+def elastic_ckpt_dir() -> Optional[str]:
+    """``HOROVOD_CKPT_DIR``: directory for the continuous async sharded
+    checkpoints (docs/sharded-checkpoint.md). When set, every
+    ``hvd.elastic.State.commit()`` also hands this rank's shard of the
+    committed pytree to the background ``hvd-ckpt-writer`` thread; the
+    step loop never blocks on storage. Unset (the default), commits stay
+    purely in-memory and the disk tier is off."""
+    val = env_str("HOROVOD_CKPT_DIR")
+    return val.strip() if val and val.strip() else None
+
+
+def elastic_ckpt_keep() -> int:
+    """``HOROVOD_CKPT_KEEP``: how many complete sharded-checkpoint steps
+    the async writer retains on disk (older steps are pruned after a new
+    one lands whole). Minimum/default 2 — the double buffer that makes a
+    kill at ANY rename point leave a complete previous step visible."""
+    return max(2, _env_int("HOROVOD_CKPT_KEEP", 2))
+
+
+def elastic_restore_mode() -> str:
+    """``HOROVOD_ELASTIC_RESTORE``: how ``hvd.elastic.State.restore()``
+    re-establishes consistent state after a reshape (docs/elastic.md).
+    ``p2p`` (the default under elastic membership) keeps digest-matching
+    survivors' local commits and scatters only the missing shards over
+    surviving owners; ``broadcast`` forces the legacy rank-0 whole-pytree
+    re-broadcast (the bench baseline). Garbage falls back to p2p."""
+    val = (env_str("HOROVOD_ELASTIC_RESTORE") or "").strip().lower()
+    return "broadcast" if val == "broadcast" else "p2p"
+
+
 def serving_max_batch() -> int:
     """``HOROVOD_SERVING_MAX_BATCH``: decode-batch slots in the serving
     engine — the most sequences one continuous-batching decode step

@@ -146,6 +146,7 @@ class CoordinatorService(_HeartbeatMixin):
         # the controller thread.
         self.epoch = 1
         self._wires_lock = make_lock("service.wires")
+        self._shard_cb = None  # p2p checkpoint-shard consumer (elastic)
         self._pending_joins: List[Tuple[Wire, dict]] = []
         self._join_stop: Optional[threading.Event] = None
         self._join_thread: Optional[threading.Thread] = None
@@ -250,6 +251,19 @@ class CoordinatorService(_HeartbeatMixin):
             wires.extend(wire for wire, _ in self._pending_joins)
             return wires
 
+    def set_shard_callback(self, cb) -> None:
+        """Install the p2p checkpoint-shard consumer
+        (docs/sharded-checkpoint.md) on every current wire — parked
+        joiners included — and every wire accepted from now on.
+        ``reform()`` reuses Wire objects, so one installation survives
+        membership epochs."""
+        self._shard_cb = cb
+        with self._wires_lock:
+            wires = [self.wires[r] for r in sorted(self.wires)]
+            wires.extend(wire for wire, _ in self._pending_joins)
+        for wire in wires:
+            wire.set_shard_callback(cb)
+
     # -- elastic membership (docs/elastic.md) -------------------------------
 
     def start_join_listener(self) -> None:
@@ -285,6 +299,8 @@ class CoordinatorService(_HeartbeatMixin):
                     wire.close()
                     continue
                 conn.settimeout(None)
+                if self._shard_cb is not None:
+                    wire.set_shard_callback(self._shard_cb)
                 with self._wires_lock:
                     self._pending_joins.append((wire, hello))
                 logging.info(

@@ -18,26 +18,77 @@ The runtime half lives in the controller (``HOROVOD_ELASTIC=1``): when a
 rank dies or a joiner is admitted, the coordinator re-forms the world at
 a bumped membership epoch and every in-flight collective fails with
 :class:`RanksChangedError`. The ``run`` wrapper catches it, acknowledges
-the reshape, rolls every tracked value back to the last ``commit()``
-synced from rank 0 (``jax.broadcast_parameters`` for array pytrees,
-``broadcast_object`` for everything else), and calls the function again —
-so survivors and joiners alike resume from one consistent point, losing
-at most the work since the last commit.
+the reshape, rolls every tracked value back to the last ``commit()``,
+and calls the function again.
+
+Restore keeps the reference's **rank-0 consistency contract** but not
+its mechanism (docs/sharded-checkpoint.md): rank 0's commit is the
+authority, published as tiny metadata (per-shard content digests over a
+deterministic flat-leaf layout). A survivor whose committed shards hash
+to the authority's digests keeps its LOCAL copy — zero bytes moved, so
+reshape-to-first-step time is flat in model size — and only mismatching
+or missing shards (a joiner's everything) are fetched from surviving
+owners over the existing authenticated wires, with a manifest-validated
+on-disk fallback for shards no live member holds. The legacy rank-0
+whole-pytree re-broadcast remains as the non-elastic path and behind
+``HOROVOD_ELASTIC_RESTORE=broadcast``.
+
+``commit()`` additionally hands this rank's 1/world_size shard of the
+snapshot to the async ``hvd-ckpt-writer`` thread when ``HOROVOD_CKPT_DIR``
+is set (or :meth:`State.enable_sharded_checkpoint` was called) — the
+step loop never blocks on storage.
 """
 
 from __future__ import annotations
 
 import copy
 import functools
-from typing import Any, Dict
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import metrics
 from ..common import basics
+from ..common import config as config_mod
 from ..common import hvd_logging as logging
 from ..common.wire import RanksChangedError  # noqa: F401  (public API)
+from ..utils import checkpoint as ckpt
+from . import shards as shards_mod
 
 __all__ = ["RanksChangedError", "State", "run", "epoch"]
+
+_em = None
+
+
+def _elastic_metrics():
+    """Lazy registration (tests/test_metrics_lint.py): the restore-plane
+    series beside the controller's reshape ones."""
+    global _em
+    if _em is None:
+        from types import SimpleNamespace
+
+        restore_bytes = metrics.counter(
+            "hvd_elastic_restore_bytes_total",
+            "Committed-state bytes materialized per restore, by source "
+            "(local = digest-matched in-memory copy, peer = fetched "
+            "shard, disk = manifest-validated fallback).", ("source",))
+        fetches = metrics.counter(
+            "hvd_elastic_shard_fetches_total",
+            "Checkpoint-shard fetches resolved during restores, by "
+            "source.", ("source",))
+        _em = SimpleNamespace(
+            restore_seconds=metrics.histogram(
+                "hvd_elastic_restore_seconds",
+                "Wall time of one State.restore(): authority metadata "
+                "sync + shard verification + any fetches — the "
+                "reshape-to-consistent-state half of recovery, beside "
+                "hvd_elastic_reshape_seconds."),
+            restore_bytes=restore_bytes,
+            fetches=fetches,
+        )
+    return _em
 
 
 def epoch() -> int:
@@ -61,12 +112,45 @@ def _is_array_tree(value: Any) -> bool:
         for leaf in leaves)
 
 
+def _leaf_is_array(leaf: Any) -> bool:
+    """Shard-plane leaf classification: real arrays shard; Python
+    scalars/objects (a step counter, a config string) ride the tiny
+    authority metadata instead, so their TYPES survive a restore (a
+    joiner's ``step`` stays an int, not a 0-d array)."""
+    return (isinstance(leaf, (np.ndarray, np.generic))
+            or type(leaf).__module__.startswith(("jax", "jaxlib")))
+
+
+def _is_jax_leaf(leaf: Any) -> bool:
+    return type(leaf).__module__.startswith(("jax", "jaxlib"))
+
+
+def _materialize_live(leaf: Any) -> Any:
+    """The live value a restore hands back for one committed leaf.
+    numpy: a buffer copy — np arrays mutate in place, so the live value
+    must own its memory or user writes would corrupt the restore point.
+    jax: the committed array ITSELF — jax arrays are immutable, so the
+    alias is safe and a restore of a jax pytree moves and copies ZERO
+    model bytes. (A donated jit argument deleting the shared buffer
+    breaks the user's own live value just the same; on non-root ranks
+    the digest plane treats the unreadable committed leaf as a mismatch
+    and re-fetches from peers — heals instead of corrupting — while
+    rank 0, the authority, fails loudly in _authority_meta.) Arbitrary
+    objects fall back to deepcopy."""
+    if isinstance(leaf, np.ndarray):
+        return leaf.copy()
+    if _is_jax_leaf(leaf):
+        return leaf
+    return copy.deepcopy(leaf)
+
+
 class State:
     """Tracked training state: every keyword becomes an attribute.
-    ``commit()`` snapshots the current values; ``restore()`` rolls back to
-    the last commit with rank 0's copy winning on every rank — the
-    reference's broadcast-from-root consistency contract, applied at
-    every membership epoch boundary."""
+    ``commit()`` snapshots the current values; ``restore()`` rolls back
+    to the last commit with rank 0's copy authoritative on every rank —
+    the reference's broadcast-from-root consistency contract, applied at
+    every membership epoch boundary (by digest verification + p2p shard
+    fetch under elastic membership; by re-broadcast otherwise)."""
 
     def __init__(self, **objects: Any):
         if not objects:
@@ -77,34 +161,506 @@ class State:
         for name, value in objects.items():
             setattr(self, name, value)
         self._committed: Dict[str, Any] = {}
+        self._commit_id = 0
+        self._commit_world = 1
+        self._flat_cache: Optional[tuple] = None
+        self._writer: Optional[ckpt.AsyncShardWriter] = None
+        self._save_step = 0
+        # Async digest precompute (the hvd-ckpt-digest thread): restore's
+        # shard verification needs the digest table of the LAST commit,
+        # and hashing the whole model inline would put an O(model) pass
+        # back on the recovery path this subsystem exists to flatten.
+        # Commit kicks the worker; restore uses the table when it is
+        # ready for the current commit + layout, else recomputes inline
+        # (pure fallback — same digests either way).
+        self._digest_table: Optional[tuple] = None
+        self._digest_wake = threading.Event()
+        self._digest_stop = threading.Event()
+        self._digest_thread: Optional[threading.Thread] = None
+        ckpt_dir = config_mod.elastic_ckpt_dir()
+        if ckpt_dir:
+            self.enable_sharded_checkpoint(ckpt_dir)
         self.commit()
+        self._install_exchange()
+
+    # ------------------------------------------------------------- storage
+
+    def enable_sharded_checkpoint(self, directory: str,
+                                  keep: Optional[int] = None) -> None:
+        """Turn on the continuous async disk tier: every ``commit()``
+        hands this rank's shard to the ``hvd-ckpt-writer`` thread
+        (rank 0 adds the manifest). Never blocks the step loop."""
+        if self._writer is not None:
+            return
+        self._writer = ckpt.AsyncShardWriter(
+            directory, keep=keep if keep is not None
+            else config_mod.elastic_ckpt_keep())
+        self._save_step = self._writer.next_step()
+
+    def flush_checkpoints(self, timeout: float = 30.0) -> bool:
+        """Wait for the writer to drain (teardown/tests only)."""
+        return self._writer.flush(timeout) if self._writer else True
+
+    @property
+    def checkpoint_dir(self) -> Optional[str]:
+        return self._writer.directory if self._writer else None
+
+    # -------------------------------------------------------------- commit
 
     def commit(self) -> None:
         """Snapshot the current values as the restore point. Purely local
         (no collective): call it at a point every rank reaches in the
-        same iteration, or ranks will restore to different steps."""
+        same iteration, or ranks will restore to different steps. With
+        the disk tier on, also enqueues this rank's shard for the async
+        writer — the snapshot below is the only step-loop cost."""
+        self._commit_world = max(1, self._topology_size())
+        # Ordering contract with _flat_commit's lock-free readers (the
+        # digest thread, the shard provider on a recv thread): the NEW
+        # committed dict must be visible before the NEW commit id, so a
+        # reader that observes the bumped id always flattens the bumped
+        # snapshot. A reader that captures the old id with the new dict
+        # merely caches under a key no one will hit again.
         self._committed = {name: copy.deepcopy(getattr(self, name))
-                           for name in self._names}
+                          for name in self._names}
+        self._commit_id += 1
+        self._flat_cache = None
+        if self._writer is not None:
+            try:
+                self._submit_shards()
+            except Exception as exc:  # storage must never fail the step
+                logging.warning(
+                    "elastic: sharded checkpoint submit skipped: %s", exc)
+        if config_mod.elastic_enabled() \
+                and config_mod.elastic_restore_mode() == "p2p":
+            # The table only ever feeds _restore_p2p; non-elastic /
+            # broadcast-mode jobs must not pay a background full-model
+            # hash per commit for a reader that cannot run. (Restore
+            # recomputes inline when no table is ready — the kick is an
+            # optimization, never a correctness dependency.)
+            self._kick_digests()
+
+    @staticmethod
+    def _topology_size() -> int:
+        """Current world size, 1 when hvd.init() has not run yet —
+        commit() stays purely local and construction-before-init keeps
+        working (the pre-r15 contract)."""
+        try:
+            return basics.state().topology.size
+        except Exception:
+            return 1
+
+    # -- async digest precompute --------------------------------------------
+
+    def _kick_digests(self) -> None:
+        if self._digest_thread is None:
+            self._digest_thread = threading.Thread(
+                target=self._digest_loop, name="hvd-ckpt-digest",
+                daemon=True)
+            self._digest_thread.start()
+        self._digest_wake.set()
+
+    def close(self) -> None:
+        """Release the background workers (digest thread + disk writer).
+        Optional — both are daemons — but a process constructing many
+        States (benches, tests) should not accumulate pinned snapshots."""
+        self._digest_stop.set()
+        self._digest_wake.set()
+        thread = self._digest_thread
+        if thread is not None:
+            thread.join(timeout=10.0)
+        if self._writer is not None:
+            self._writer.close()
+        # Release the shard provider (it closes over this State's whole
+        # committed snapshot) — unless a newer State took it over.
+        shards_mod.exchange().clear_provider(self)
+
+    def _digest_loop(self) -> None:
+        while not self._digest_stop.is_set():
+            if not self._digest_wake.wait(timeout=0.5):
+                continue
+            self._digest_wake.clear()
+            if self._digest_stop.is_set():
+                return
+            try:
+                cid = self._commit_id
+                flat, _td, array_ids, _obj = self._flat_commit()
+                layout = self._layout(flat, array_ids, self._commit_world)
+                digests = self._hash_layout(flat, layout)
+                if self._commit_id == cid:
+                    # Verified unchanged: a commit racing this pass just
+                    # re-kicked the worker; its table lands next round.
+                    self._digest_table = (
+                        cid, tuple(tuple(ids) for ids in layout), digests)
+            except Exception as exc:
+                logging.debug("elastic: digest precompute failed: %s", exc)
+
+    @staticmethod
+    def _hash_layout(flat: List[Any], layout: List[List[int]]
+                     ) -> List[Optional[str]]:
+        """Per-shard digests of this process's committed leaves under an
+        arbitrary (possibly the authority's) layout; None where a shard
+        references leaves this rank cannot hash (index out of range, or
+        an object leaf where the authority has an array)."""
+        out: List[Optional[str]] = []
+        for ids in layout:
+            if any(i >= len(flat) or not _leaf_is_array(flat[i])
+                   for i in ids):
+                out.append(None)
+                continue
+            try:
+                out.append(ckpt.shard_digest(
+                    [np.ascontiguousarray(np.asarray(flat[i]))
+                     for i in ids]))
+            except Exception:
+                # Unreadable leaf (e.g. a jax buffer deleted by a
+                # donated jit): treated as a mismatch — the shard
+                # re-fetches from a peer instead of crashing.
+                out.append(None)
+        return out
+
+    def _digests_for(self, layout: List[List[int]]
+                     ) -> List[Optional[str]]:
+        """The digest table for ``layout`` against the current commit:
+        the precomputed one when it matches, else an inline pass."""
+        table = self._digest_table
+        key = tuple(tuple(ids) for ids in layout)
+        if (table is not None and table[0] == self._commit_id
+                and table[1] == key):
+            return table[2]
+        flat = self._flat_commit()[0]
+        return self._hash_layout(flat, layout)
+
+    def _flat_commit(self) -> tuple:
+        """``(flat, treedef, array_ids, objects)`` of the committed dict
+        — flat leaves in jax tree order, the indices that shard (real
+        arrays), and the object leaves that ride metadata instead.
+        Cached per commit."""
+        cached = self._flat_cache  # snapshot: the provider thread reads
+        # this concurrently with commit() replacing it; a stale snapshot
+        # only yields a digest mismatch, which the fetch plane treats as
+        # "no matching copy here".
+        if cached is not None and cached[0] == self._commit_id:
+            return cached[1]
+        import jax
+
+        # Capture the id FIRST, then ONE reference to the committed dict
+        # (commit() replaces the whole dict, never mutates it, and
+        # publishes it before bumping the id): the flatten below can
+        # never mix leaves of two commits, and a racing capture caches
+        # under a dead id instead of poisoning the current one.
+        cid = self._commit_id
+        committed = self._committed
+        tree = {name: committed[name] for name in self._names}
+        flat, treedef = jax.tree_util.tree_flatten(tree)
+        array_ids = [i for i, leaf in enumerate(flat)
+                     if _leaf_is_array(leaf)]
+        objects = {i: leaf for i, leaf in enumerate(flat)
+                   if not _leaf_is_array(leaf)}
+        out = (flat, treedef, array_ids, objects)
+        self._flat_cache = (cid, out)
+        return out
+
+    def _layout(self, flat: List[Any], array_ids: List[int],
+                world: int) -> List[List[int]]:
+        """Flat-id shard map for this commit: the deterministic
+        lightest-shard walk over array-leaf byte sizes. Sizes come from
+        the leaves' own ``nbytes`` — never np.asarray, which would be a
+        blocking device-to-host copy per jax leaf on the step loop."""
+        nbytes = [int(flat[i].nbytes) for i in array_ids]
+        positions = ckpt.shard_layout(nbytes, world)
+        return [[array_ids[p] for p in shard] for shard in positions]
+
+    def _submit_shards(self) -> None:
+        st = basics.state()
+        rank = st.topology.rank
+        world = self._commit_world
+        if rank >= world:
+            return
+        flat, _treedef, array_ids, objects = self._flat_commit()
+        layout = self._layout(flat, array_ids, world)
+        # RAW leaf references, no conversion: np.asarray on a jax leaf
+        # is a blocking device-to-host copy, and the whole point of the
+        # async tier is that the step loop never pays one. pack_shard /
+        # shard_digest convert on the writer thread; the committed
+        # snapshot is immutable, so the references stay valid.
+        mine = [flat[i] for i in layout[rank]]
+        step = self._save_step
+        self._save_step += 1
+        manifest = None
+        if rank == 0:
+            epoch_now = epoch()
+
+            def build_manifest(flat=flat, layout=layout, objects=objects,
+                               step=step, world=world,
+                               epoch_now=epoch_now):
+                # Materialize + digest the WHOLE commit on the writer
+                # thread — neither the transfer nor the hash ever runs
+                # on the step loop.
+                digests = [ckpt.shard_digest(
+                    [np.ascontiguousarray(np.asarray(flat[i]))
+                     for i in ids]) for ids in layout]
+                return {"step": step, "epoch": epoch_now,
+                        "world_size": world, "layout": layout,
+                        "digests": digests,
+                        "objects_hex": ckpt.pack_objects(objects)}
+
+            manifest = build_manifest
+        self._writer.submit(step, rank, world, mine, manifest=manifest)
+
+    # ------------------------------------------------------------- restore
 
     def restore(self) -> None:
-        """Roll every tracked value back to the last commit, re-synced
-        from rank 0 (reference ``broadcast_parameters`` contract) so all
-        members of the new epoch — joiners included — resume identical."""
+        """Roll every tracked value back to the last commit, consistent
+        with rank 0 on every member of the new epoch — joiners included.
+        Under elastic membership this is the p2p path (digest-matched
+        survivors move zero bytes); otherwise rank 0 re-broadcasts."""
+        t0 = time.monotonic()
         st = basics.state()
+        mon = metrics.on()
+        if st.topology.size <= 1:
+            for name in self._names:
+                setattr(self, name, copy.deepcopy(self._committed[name]))
+        elif (config_mod.elastic_enabled()
+                and config_mod.elastic_restore_mode() == "p2p"
+                and self._p2p_capable(st)):
+            self._restore_p2p(st)
+        else:
+            self._restore_broadcast(st)
+        if mon:
+            _elastic_metrics().restore_seconds.observe(
+                time.monotonic() - t0)
+
+    def _p2p_capable(self, st) -> bool:
+        """The shard plane rides the python engine's TCP star; any other
+        controller shape (native engine, no controller) keeps the
+        broadcast path."""
+        ctl = st.controller
+        return (ctl is not None and hasattr(ctl, "clear_reshape_fence")
+                and (getattr(ctl, "_service", None) is not None
+                     or getattr(ctl, "_client", None) is not None))
+
+    def _install_exchange(self) -> None:
+        try:
+            st = basics.state()
+        except Exception:
+            return  # before hvd.init(): restore installs it later
+        if not self._p2p_capable(st):
+            return
+        ex = shards_mod.exchange()
+        ex.install(st.controller)
+        ex.set_provider(shards_mod.make_memory_provider(
+            lambda: self._flat_commit()[0]), owner=self)
+
+    def _restore_broadcast(self, st) -> None:
+        """Legacy rank-0 whole-pytree re-sync — one materialization per
+        tracked value (the committed snapshot is broadcast as-is and the
+        live attribute is the single fresh copy)."""
+        restored: Dict[str, Any] = {}
         for name in self._names:
             value = self._committed[name]
-            if st.topology.size > 1:
-                if _is_array_tree(value):
-                    from ..jax import broadcast_parameters
+            if _is_array_tree(value):
+                from ..jax import broadcast_parameters
 
-                    value = broadcast_parameters(value, root_rank=0)
-                else:
-                    from ..ops.collective_ops import broadcast_object
+                value = broadcast_parameters(value, root_rank=0)
+            else:
+                from ..ops.collective_ops import broadcast_object
 
-                    value = broadcast_object(
-                        value, root_rank=0, name=f"elastic.state.{name}")
+                value = broadcast_object(
+                    value, root_rank=0, name=f"elastic.state.{name}")
+            restored[name] = value
             setattr(self, name, copy.deepcopy(value))
-        self.commit()
+        # Whole-dict swap (lock-free reader contract), then invalidate
+        # the flat/digest caches exactly like the p2p rebuild does. No
+        # digest kick: the table only feeds _restore_p2p, which this
+        # mode — by definition — never runs (commit() has the same
+        # guard); a later mode flip recomputes inline once.
+        self._committed = restored
+        self._commit_id += 1
+        self._flat_cache = None
+        self._digest_table = None
+        if metrics.on() and st.topology.rank != 0:
+            # Root received nothing — only non-root ranks count the
+            # re-broadcast bytes as transferred. Leaf .nbytes, never
+            # np.asarray: the count must not itself transfer the model.
+            flat = self._flat_commit()[0]
+            nbytes = sum(int(leaf.nbytes) for leaf in flat
+                         if _leaf_is_array(leaf))
+            _elastic_metrics().restore_bytes.labels("peer").inc(nbytes)
+
+    # -- the p2p path -------------------------------------------------------
+
+    def _authority_meta(self) -> dict:
+        """Rank 0's view of its commit, as tiny metadata: layout +
+        per-shard digests + the object leaves. O(model) HASHING, O(1)
+        bytes on the wire."""
+        flat, _treedef, array_ids, objects = self._flat_commit()
+        layout = self._layout(flat, array_ids, self._commit_world)
+        digests: List[Optional[str]] = self._digests_for(layout)
+        if any(d is None for d in digests):
+            # Rank 0 IS the root of truth: an unreadable committed leaf
+            # here (e.g. a jax buffer deleted by a donated jit) leaves
+            # nothing for peers to heal FROM — fail loudly instead of
+            # publishing digests no holder and no manifest can match.
+            raise RuntimeError(
+                "elastic: rank 0's committed state is unreadable (a "
+                "tracked jax buffer was deleted, e.g. by a donated jit "
+                "argument); p2p restore has no authority to serve — "
+                "resume from the disk tier (restore_latest_sharded) or "
+                "re-commit readable values")
+        return {
+            "commit_id": self._commit_id,
+            "world": self._commit_world,
+            "nleaves": len(flat),
+            "layout": layout,
+            "digests": digests,
+            # Writer-step alignment: every member adopts rank 0's next
+            # save step at restore, so a joiner's counter (seeded from
+            # its own disk scan) can't desync the shard/manifest step
+            # namespace and leave every post-join step incomplete.
+            "save_step": self._save_step,
+            "objects_hex": ckpt.pack_objects(objects),
+        }
+
+    def _match_bitmap(self, meta: dict) -> List[bool]:
+        """Which authority shards this rank's committed copy already
+        holds byte-exactly (digest over the authority's layout; the
+        table is usually precomputed by the hvd-ckpt-digest thread, so
+        this is O(shards) on the recovery path, not O(model))."""
+        mine = self._digests_for(meta["layout"])
+        return [m is not None and m == digest
+                for m, digest in zip(mine, meta["digests"])]
+
+    def _restore_p2p(self, st) -> None:
+        from ..ops.collective_ops import allgather_object, broadcast_object
+
+        self._install_exchange()
+        rank = st.topology.rank
+        size = st.topology.size
+        # 1. Authority metadata from rank 0 (tiny), then every member's
+        # per-shard match bitmap (tinier). Both ride the ordinary
+        # negotiated collectives, so a reshape tears them with the same
+        # retryable RanksChangedError as any in-flight work.
+        meta = broadcast_object(
+            self._authority_meta() if rank == 0 else None,
+            root_rank=0, name="elastic.restore.meta")
+        bitmap = self._match_bitmap(meta) if rank != 0 \
+            else [True] * len(meta["layout"])
+        bitmaps = allgather_object(bitmap, name="elastic.restore.holders")
+        holders: List[List[int]] = []
+        for k in range(len(meta["layout"])):
+            holders.append([r for r in range(size)
+                            if k < len(bitmaps[r]) and bitmaps[r][k]])
+        # 2. Fetch what's missing. Owners rotate over the holder set per
+        # shard, so a joiner's pulls spread across survivors instead of
+        # re-serializing on rank 0 (rank 0 is always a holder — it IS
+        # the authority — so every chain is non-empty).
+        flat, treedef, _array_ids, _objects = self._flat_commit()
+        if len(flat) != meta["nleaves"]:
+            raise ValueError(
+                f"elastic: this rank tracks {len(flat)} leaves but rank "
+                f"0's commit has {meta['nleaves']} — State structure must "
+                "match across members")
+        mon = metrics.on()
+        fetched: Dict[int, List[np.ndarray]] = {}
+        missing = [k for k in range(len(meta["layout"])) if not bitmap[k]]
+        chains: Dict[int, List[int]] = {}
+        first: Dict[int, Any] = {}
+        ex = shards_mod.exchange()
+        for k in missing:
+            chain = [holders[k][(k + j) % len(holders[k])]
+                     for j in range(len(holders[k]))]
+            chain = [r for i, r in enumerate(chain)
+                     if r != rank and r not in chain[:i]]
+            chains[k] = chain
+            if chain:
+                # First-choice fetches go out together; stragglers and
+                # fallbacks resolve per shard below.
+                first[k] = ex.fetch_async(k, meta["digests"][k],
+                                          meta["layout"][k], chain[0])
+        local_bytes = 0
+        for k in missing:
+            arrays = None
+            source = "peer"
+            f = first.get(k)
+            if f is not None and ex.wait(f) and f.data:
+                try:
+                    arrays = ckpt.unpack_shard(
+                        f.data, expect_digest=meta["digests"][k])
+                except ValueError:
+                    arrays = None
+            if arrays is None:
+                arrays, source = shards_mod.fetch_shard(
+                    ex, k, meta["digests"][k], meta["layout"][k],
+                    chains[k][1:], disk_dir=self.checkpoint_dir
+                    or config_mod.elastic_ckpt_dir())
+            fetched[k] = arrays
+            if mon:
+                m = _elastic_metrics()
+                m.fetches.labels(source).inc()
+                m.restore_bytes.labels(source).inc(
+                    sum(int(a.nbytes) for a in arrays))
+        # 3. Rebuild: matched shards keep the local committed copy (the
+        # live attribute is the single fresh materialization), fetched
+        # shards replace it, object leaves adopt rank 0's verbatim.
+        committed_flat = list(flat)
+        live_flat: List[Any] = [None] * len(flat)
+        for k, ids in enumerate(meta["layout"]):
+            if bitmap[k]:
+                for i in ids:
+                    if mon:
+                        # Leaf .nbytes, never np.asarray: the zero-copy
+                        # survivor path must not transfer the model just
+                        # to count the bytes it did NOT move.
+                        local_bytes += int(flat[i].nbytes)
+                    live_flat[i] = _materialize_live(flat[i])
+            else:
+                for i, arr in zip(ids, fetched[k]):
+                    if _is_jax_leaf(flat[i]):
+                        import jax.numpy as jnp
+
+                        arr = jnp.asarray(arr)
+                    committed_flat[i] = arr
+                    live_flat[i] = _materialize_live(arr)
+        for i, obj in ckpt.unpack_objects(meta).items():
+            committed_flat[int(i)] = obj
+            live_flat[int(i)] = copy.deepcopy(obj)
+        for i in range(len(flat)):
+            if live_flat[i] is None:  # a leaf in no shard and no blob
+                live_flat[i] = copy.deepcopy(committed_flat[i])
+        import jax
+
+        committed_tree = jax.tree_util.tree_unflatten(
+            treedef, committed_flat)
+        live_tree = jax.tree_util.tree_unflatten(treedef, live_flat)
+        # Whole-dict swap, never in-place mutation: _flat_commit's
+        # lock-free readers rely on any _committed they captured staying
+        # internally consistent.
+        self._committed = {name: committed_tree[name]
+                           for name in self._names}
+        for name in self._names:
+            setattr(self, name, live_tree[name])
+        if int(meta.get("save_step", -1)) >= 0 and self._writer is not None:
+            self._save_step = int(meta["save_step"])
+        if missing or ckpt.pack_objects(_objects) != meta.get(
+                "objects_hex"):
+            # Committed content changed (fetched shards / adopted
+            # objects): this IS a new commit for caching purposes — a
+            # stale digest table surviving here would mis-compare every
+            # fetched shard on the NEXT restore and re-fetch bytes this
+            # rank now holds byte-exactly. The bump also invalidates any
+            # digest-loop pass racing this restore. The all-match case
+            # (every survivor, every reshape) keeps its still-valid
+            # table: the zero-hash recovery path stays zero-hash.
+            self._commit_id += 1
+            self._flat_cache = None
+            self._digest_table = None
+            self._kick_digests()
+        if mon:
+            _elastic_metrics().restore_bytes.labels("local").inc(
+                local_bytes)
+            metrics.record_sampled_event(
+                "elastic_restore", missing=len(missing),
+                shards=len(meta["layout"]), local_bytes=local_bytes)
 
 
 def _acknowledge_reshape() -> None:
@@ -132,8 +688,8 @@ def run(func):
                 return func(state, *args, **kwargs)
             except RanksChangedError as exc:
                 logging.warning(
-                    "elastic: %s; restoring state from rank 0 and "
-                    "resuming the training loop", exc)
+                    "elastic: %s; restoring state and resuming the "
+                    "training loop", exc)
                 continue
 
     return wrapper

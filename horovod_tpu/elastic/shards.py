@@ -1,0 +1,384 @@
+"""Peer-to-peer checkpoint-shard exchange (docs/sharded-checkpoint.md).
+
+The transfer half of fast elastic restore: after a reshape, survivors
+already hold the whole committed pytree in memory, so the only bytes
+that must move are the shards a member is MISSING (a joiner's everything,
+a diverged rank's mismatches). This module moves them over the existing
+authenticated wires using the SHARD_FETCH/SHARD_DATA frame kinds
+(``common/wire.py``), routed through the coordinator star exactly like
+trace collection — requester → coordinator → owner → coordinator →
+requester — so restore needs no connectivity the job doesn't already
+have, and no rank ever re-broadcasts the whole model.
+
+Addressing is by CONTENT DIGEST (``utils/checkpoint.shard_digest``): a
+fetch names the shard id, the digest the authority (rank 0's commit)
+declared, and the flat-leaf indices that make it up; an owner serves the
+shard only if its own committed copy hashes to that exact digest. That
+makes the plane self-validating — a racing commit, a stale reply from a
+torn restore, or a foreign epoch's traffic can never splice wrong bytes
+into a restore; at worst a fetch comes back ``found=False`` and the
+requester walks its fallback chain (next surviving holder, then the
+manifest-validated on-disk shard, then a loud error naming everything it
+tried).
+
+Frames are serviced transparently inside whatever recv loop drains them
+(the controller thread's lockstep reads), so the plane stays invisible
+to the negotiation protocol — the spec in ``analysis/protocol.py``
+declares the kinds legal self-loops in the steady states and protocheck
+verifies every chaos run against it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..analysis.lockorder import make_lock
+from ..common import hvd_logging as logging
+from ..utils.checkpoint import (
+    SHARDED_PREFIX,
+    _sharded_steps,
+    load_shard,
+    manifest_path,
+    pack_shard,
+    read_manifest,
+    shard_digest,
+    shard_path,
+    unpack_shard,
+)
+
+# Per-holder attempt bound: long enough for a big shard to cross the
+# star twice under load, short enough that a dead owner's chain falls
+# through to disk well inside the liveness deadline.
+FETCH_ATTEMPT_TIMEOUT = 15.0
+
+
+class ShardFetchError(RuntimeError):
+    """No source produced the shard: every surviving holder declined or
+    timed out and no manifest-validated on-disk copy matched."""
+
+
+class _Fetch:
+    __slots__ = ("shard", "digest", "nonce", "event", "found", "data")
+
+    def __init__(self, shard: int, digest: str, nonce: int = 0):
+        self.shard = shard
+        self.digest = digest
+        # Per-attempt id: a late reply from a TIMED-OUT earlier attempt
+        # (slow relay) must not fulfill a newer attempt's future — it
+        # would mark a live holder not-found and poison the fallback
+        # chain one-behind all the way to a spurious ShardFetchError.
+        self.nonce = nonce
+        self.event = threading.Event()
+        self.found = False
+        self.data: Optional[bytes] = None
+
+
+class ShardExchange:
+    """One process's half of the shard plane: requester futures, the
+    provider serving this rank's committed copy, and — on rank 0 — the
+    star relay. Installed onto a live Controller's wires; reform() keeps
+    Wire objects, so an installation survives membership epochs."""
+
+    def __init__(self):
+        # Covers the pending-futures table only; never held across a
+        # wire send (lock-graph discipline: shards.pending is a leaf).
+        self._lock = make_lock("shards.pending")
+        self._pending: Dict[Tuple[int, str], _Fetch] = {}
+        self._provider = None
+        self._provider_owner = None
+        self._ctl = None
+        self._nonce = 0
+
+    # ------------------------------------------------------------- install
+
+    def install(self, controller) -> None:
+        """Bind to a controller and hook the shard callback onto its
+        wires (both star sides). Idempotent; re-binding to a NEW
+        controller drops stale futures."""
+        if controller is self._ctl:
+            return
+        with self._lock:
+            self._pending = {}
+        self._ctl = controller
+        service = getattr(controller, "_service", None)
+        client = getattr(controller, "_client", None)
+        if service is not None:
+            service.set_shard_callback(self._on_frame)
+        if client is not None:
+            client.wire.set_shard_callback(self._on_frame)
+
+    def set_provider(self, fn, owner=None) -> None:
+        """``fn(shard_id, digest, leaf_ids) -> Optional[bytes]`` serving
+        this rank's committed copy (None = no matching copy here).
+        ``owner`` tags who installed it, so that owner's teardown can
+        release the closure (and the snapshot it pins) without clobbering
+        a newer installation."""
+        self._provider = fn
+        self._provider_owner = owner
+
+    def clear_provider(self, owner) -> None:
+        """Drop the provider iff ``owner`` still owns it."""
+        if self._provider_owner is owner:
+            self._provider = None
+            self._provider_owner = None
+
+    # ------------------------------------------------------- frame handling
+
+    def _serve(self, info: dict) -> dict:
+        """Build the reply for a fetch this rank owns."""
+        blob = None
+        provider = self._provider
+        if provider is not None:
+            try:
+                blob = provider(int(info["shard"]), info["digest"],
+                                list(info.get("leaves", ())))
+            except Exception as exc:
+                logging.warning("shards: provider failed for shard %s: %s",
+                                info.get("shard"), exc)
+                blob = None
+        return {"shard": int(info["shard"]), "digest": info["digest"],
+                "req": int(info["req"]), "nonce": info.get("nonce"),
+                "found": blob is not None, "data": blob}
+
+    def _fulfill(self, info: dict) -> None:
+        key = (int(info["shard"]), info["digest"])
+        with self._lock:
+            fetch = self._pending.get(key)
+            if fetch is None or fetch.nonce != info.get("nonce"):
+                fetch = None  # superseded/stale attempt's reply: drop
+            else:
+                del self._pending[key]
+        if fetch is None:
+            return
+        fetch.found = bool(info.get("found"))
+        fetch.data = info.get("data")
+        fetch.event.set()
+
+    def _coordinator_wire(self, rank: int):
+        service = getattr(self._ctl, "_service", None)
+        if service is None:
+            return None
+        with service._wires_lock:
+            return service.wires.get(rank)
+
+    def _on_frame(self, event: str, info: dict) -> None:
+        """Per-wire callback (runs on whatever thread drained the frame).
+        Worker side: serve fetches, consume replies. Coordinator side:
+        serve/consume when addressed to rank 0, relay otherwise; a relay
+        target that died answers the requester ``found=False`` at once
+        so its fallback chain advances instead of waiting out a timeout."""
+        ctl = self._ctl
+        if ctl is None:
+            return
+        is_coord = getattr(ctl, "_service", None) is not None
+        if event == "fetch":
+            owner = int(info.get("owner", -1))
+            if not is_coord:
+                self._reply(self._serve(info))
+                return
+            if owner == 0:
+                self._reply(self._serve(info))
+                return
+            wire = self._coordinator_wire(owner)
+            if wire is None:
+                self._reply({"shard": int(info["shard"]),
+                             "digest": info["digest"],
+                             "req": int(info["req"]),
+                             "nonce": info.get("nonce"),
+                             "found": False, "data": None})
+                return
+            try:
+                wire.send_shard_fetch(info)
+            except Exception as exc:
+                logging.debug("shards: relay to owner %d failed (%s)",
+                              owner, exc)
+                self._reply({"shard": int(info["shard"]),
+                             "digest": info["digest"],
+                             "req": int(info["req"]),
+                             "nonce": info.get("nonce"),
+                             "found": False, "data": None})
+        else:  # "data"
+            req = int(info.get("req", -1))
+            if is_coord and req != 0:
+                wire = self._coordinator_wire(req)
+                if wire is None:
+                    return  # requester died: nothing to relay to
+                try:
+                    wire.send_shard_data(info)
+                except Exception as exc:
+                    logging.debug("shards: relay to requester %d failed "
+                                  "(%s)", req, exc)
+                return
+            self._fulfill(info)
+
+    def _reply(self, info: dict) -> None:
+        """Send a SHARD_DATA answer toward the requester: workers hand it
+        to the star; rank 0 sends straight to the requester's wire (or
+        fulfills its own future for a local serve)."""
+        ctl = self._ctl
+        if getattr(ctl, "_service", None) is not None:
+            if int(info["req"]) == 0:
+                self._fulfill(info)
+                return
+            wire = self._coordinator_wire(int(info["req"]))
+            if wire is None:
+                return
+            try:
+                wire.send_shard_data(info)
+            except Exception as exc:
+                logging.debug("shards: reply to requester %d failed (%s)",
+                              info["req"], exc)
+            return
+        client = getattr(ctl, "_client", None)
+        if client is None:
+            return
+        try:
+            client.wire.send_shard_data(info)
+        except Exception as exc:
+            logging.debug("shards: reply send failed (%s)", exc)
+
+    # ------------------------------------------------------------ requester
+
+    def fetch_async(self, shard: int, digest: str,
+                    leaf_ids: Sequence[int], owner: int) -> _Fetch:
+        """Issue one fetch toward ``owner`` (a surviving holder's current
+        rank); returns the future the SHARD_DATA reply fulfills."""
+        fetch = _Fetch(shard, digest)
+        with self._lock:  # call-free region (lock-graph discipline)
+            self._nonce += 1
+            fetch.nonce = self._nonce
+            self._pending[(shard, digest)] = fetch
+        ctl = self._ctl
+        rank = ctl.topo.rank
+        info = {"shard": int(shard), "digest": digest,
+                "leaves": [int(i) for i in leaf_ids],
+                "req": int(rank), "owner": int(owner),
+                "nonce": fetch.nonce}
+        try:
+            if rank == 0:
+                wire = self._coordinator_wire(owner)
+                if wire is None:
+                    raise ConnectionError(f"no wire to owner {owner}")
+                wire.send_shard_fetch(info)
+            else:
+                ctl._client.wire.send_shard_fetch(info)
+        except Exception as exc:
+            logging.debug("shards: fetch send to owner %d failed (%s)",
+                          owner, exc)
+            fetch.found = False
+            fetch.event.set()
+        return fetch
+
+    def wait(self, fetch: _Fetch,
+             timeout: float = FETCH_ATTEMPT_TIMEOUT) -> bool:
+        """Block the (user) restore thread on one fetch, watching for the
+        job tearing underneath it: a reshape fence raises the retryable
+        RanksChangedError so ``hvd.elastic.run`` restarts the restore at
+        the new epoch — the kill-mid-fetch chaos contract."""
+        deadline = time.monotonic() + timeout
+        while not fetch.event.wait(0.02):
+            ctl = self._ctl
+            fence = getattr(ctl, "_reshape_fence", None)
+            if fence is not None:
+                raise fence
+            if ctl is None or ctl._closed.is_set():
+                raise RuntimeError(
+                    "shard fetch aborted: the controller shut down")
+            if time.monotonic() > deadline:
+                with self._lock:
+                    self._pending.pop((fetch.shard, fetch.digest), None)
+                return False
+        return fetch.found
+
+
+def fetch_shard(exchange: ShardExchange, shard: int, digest: str,
+                leaf_ids: Sequence[int], holders: Sequence[int],
+                disk_dir: Optional[str] = None,
+                prefix: str = SHARDED_PREFIX,
+                attempt_timeout: float = FETCH_ATTEMPT_TIMEOUT
+                ) -> Tuple[List[np.ndarray], str]:
+    """Fetch one shard through its fallback chain: each surviving holder
+    in order (peer memory), then the newest on-disk step whose manifest
+    records this exact digest (the dead-owner path), then a loud error
+    naming every source tried. Returns ``(arrays, source)`` with source
+    ``"peer"`` or ``"disk"``."""
+    tried: List[str] = []
+    for owner in holders:
+        fetch = exchange.fetch_async(shard, digest, leaf_ids, owner)
+        if exchange.wait(fetch, timeout=attempt_timeout) and fetch.data:
+            try:
+                return unpack_shard(fetch.data, expect_digest=digest), \
+                    "peer"
+            except ValueError as exc:
+                tried.append(f"rank {owner} (bad payload: {exc})")
+                continue
+        tried.append(f"rank {owner} (no matching copy or timeout)")
+    arrays = _disk_shard(disk_dir, shard, digest, prefix)
+    if arrays is not None:
+        return arrays, "disk"
+    tried.append(f"disk under {disk_dir!r} (no manifest records digest "
+                 f"{digest})")
+    raise ShardFetchError(
+        f"shard {shard} (digest {digest}) unrecoverable; tried: "
+        + "; ".join(tried))
+
+
+def _disk_shard(directory: Optional[str], shard: int, digest: str,
+                prefix: str) -> Optional[List[np.ndarray]]:
+    """Newest on-disk copy of a shard matching ``digest``, manifest-
+    validated — the fallback when every in-memory holder is gone."""
+    if not directory:
+        return None
+    for step in _sharded_steps(directory, prefix):
+        try:
+            manifest = read_manifest(manifest_path(directory, step, prefix))
+            digests = manifest.get("digests", [])
+            if shard >= len(digests) or digests[shard] != digest:
+                continue
+            world = int(manifest["world_size"])
+            return load_shard(shard_path(directory, step, shard, world,
+                                         prefix), expect_digest=digest)
+        except (OSError, ValueError, KeyError):
+            continue  # torn/incomplete step: keep scanning older ones
+    return None
+
+
+def make_memory_provider(get_flat):
+    """Provider over an in-memory committed snapshot: ``get_flat()``
+    returns the current flat leaf list (or None). Serves a shard iff the
+    requested leaves hash to the requested digest — self-validating
+    against racing commits."""
+
+    def provide(shard: int, digest: str,
+                leaf_ids: Sequence[int]) -> Optional[bytes]:
+        flat = get_flat()
+        if flat is None:
+            return None
+        try:
+            arrays = [np.ascontiguousarray(np.asarray(flat[i]))
+                      for i in leaf_ids]
+        except Exception:
+            # Out-of-range leaf, non-array leaf, or an unreadable jax
+            # buffer (deleted by a donated jit): no copy to serve.
+            return None
+        if shard_digest(arrays) != digest:
+            return None
+        return pack_shard(arrays)
+
+    return provide
+
+
+_exchange: Optional[ShardExchange] = None
+
+
+def exchange() -> ShardExchange:
+    """Process-wide exchange (one controller per real process; the sim
+    harness builds its own instances per logical rank)."""
+    global _exchange
+    if _exchange is None:
+        _exchange = ShardExchange()
+    return _exchange

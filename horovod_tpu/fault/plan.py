@@ -16,7 +16,11 @@ Rule fields (JSON object per rule):
     site     "wire_send" | "wire_recv" | "cycle" | "init" (backend
              acquisition) | "init_distributed" (jax.distributed join) —
              the two init paths count separately so a plan's "at"/"times"
-             don't shift with the launch mode
+             don't shift with the launch mode — | "ckpt_save" (inside the
+             async hvd-ckpt-writer thread, before the shard's atomic
+             rename swing: kill/exit/delay tear the write exactly where
+             a preempted rank would; "raise" exercises the writer's
+             never-fail-the-job error path)
     action   "kill"  — SIGKILL this process (a real crash, no cleanup)
              "exit"  — os._exit(1) (a crash that still reports non-zero)
              "delay" — sleep ``seconds`` (± ``jitter`` fraction, seeded)
@@ -68,7 +72,7 @@ import time
 from typing import Dict, List, Optional
 
 VALID_SITES = ("wire_send", "wire_recv", "cycle", "init",
-               "init_distributed")
+               "init_distributed", "ckpt_save")
 _INIT_SITES = ("init", "init_distributed")
 VALID_ACTIONS = ("kill", "exit", "delay", "drop", "raise", "wedge",
                  "join", "leave", "group_kill")

@@ -213,6 +213,43 @@ class SimWorker:
                                     dtype=op.array.dtype)
                 self.results[op.name] = raw.reshape(op.array.shape)
 
+    # ------------------------------------------------------- shard plane
+
+    def enable_shards(self, store: Optional[Dict[str, bytes]] = None
+                      ) -> Dict[str, bytes]:
+        """Arm this logical rank's half of the p2p checkpoint-shard
+        plane (docs/sharded-checkpoint.md): ``store`` maps content
+        digest -> packed shard bytes; relayed SHARD_FETCH frames are
+        served from it (missing digest = ``found: False``) and
+        SHARD_DATA replies land in :attr:`shard_replies` — all
+        transparently, from whatever recv the driver runs next."""
+        self.shard_store: Dict[str, bytes] = store if store is not None \
+            else {}
+        self.shard_replies: Dict[Tuple[int, str], dict] = {}
+
+        def cb(event: str, info: dict) -> None:
+            if event == "fetch":
+                blob = self.shard_store.get(info["digest"])
+                self._client.wire.send_shard_data({
+                    "shard": int(info["shard"]), "digest": info["digest"],
+                    "req": int(info["req"]), "nonce": info.get("nonce"),
+                    "found": blob is not None, "data": blob})
+            else:
+                self.shard_replies[(int(info["shard"]),
+                                    info["digest"])] = info
+
+        self._client.wire.set_shard_callback(cb)
+        return self.shard_store
+
+    def send_shard_fetch(self, shard: int, digest: str,
+                         owner: int) -> None:
+        """Issue one fetch toward ``owner`` through the coordinator
+        star; the reply shows up in :attr:`shard_replies` once the
+        driver has run enough recv phases for the relay round trip."""
+        self._client.wire.send_shard_fetch({
+            "shard": int(shard), "digest": digest, "leaves": [],
+            "req": int(self.rank), "owner": int(owner)})
+
     # ------------------------------------------------------------ membership
 
     def apply_reshape(self, exc: RanksChangedError) -> None:

@@ -1,10 +1,13 @@
-"""Utility layer: checkpoint/resume helpers (orbax-backed, reference
-broadcast-consistency contract)."""
+"""Utility layer: checkpoint/resume helpers — the classic orbax-backed
+rank-0 tier and the async sharded tier (docs/sharded-checkpoint.md)."""
 
 from .checkpoint import (  # noqa: F401
+    AsyncShardWriter,
     latest_checkpoint,
+    latest_sharded_checkpoint,
     restart_epoch,
     restore_checkpoint,
     restore_latest,
+    restore_latest_sharded,
     save_checkpoint,
 )

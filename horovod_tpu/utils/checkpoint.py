@@ -14,10 +14,20 @@ rank that read a stale/partial file cannot diverge.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import pickle
 import shutil
-from typing import Any, Callable, Optional
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
+from .. import fault
+from .. import metrics
+from ..analysis.lockorder import make_lock
 from ..common import basics
 from ..common import hvd_logging as logging
 
@@ -90,8 +100,20 @@ def restore_checkpoint(path: str, like: Optional[Any] = None,
                        root_rank: int = 0, broadcast: bool = True) -> Any:
     """Restore a pytree; with ``broadcast`` (default) and a multi-process
     job, root's restored values are re-broadcast so every rank resumes
-    identically — the reference's consistency contract."""
+    identically — the reference's consistency contract.
+
+    A missing path — or a ``.tmp.`` transient of a save that was killed
+    mid-write — raises FileNotFoundError naming the path AND the nearest
+    complete checkpoint under the same directory, instead of whatever
+    opaque internal error the storage layer would surface."""
     path = os.path.abspath(path)
+    if not os.path.exists(path) or ".tmp." in os.path.basename(path):
+        near = latest_checkpoint(os.path.dirname(path) or ".")
+        state = ("a torn .tmp. transient of an interrupted save"
+                 if os.path.exists(path) else "missing")
+        raise FileNotFoundError(
+            f"checkpoint {path} is {state}; nearest complete checkpoint "
+            f"in its directory: {near if near else 'none'}")
     restored = _checkpointer().restore(path, item=like)
     st = basics.state()
     if broadcast and st.topology.size > 1:
@@ -127,6 +149,436 @@ def restore_latest(directory: str, like: Optional[Any] = None,
     logging.info("resumed from checkpoint %s (restart epoch %d)",
                  path, restart_epoch())
     return path, tree
+
+
+# ---------------------------------------------------------------------------
+# Sharded checkpoints (docs/sharded-checkpoint.md): each rank persists its
+# 1/world_size shard of the committed pytree asynchronously; rank 0 adds a
+# manifest recording (step, membership epoch, world size, shard map,
+# per-shard digests). Every write rides the same _write_atomically rename
+# machinery above, so a kill at ANY rename point leaves the previous
+# complete step visible to restore_latest_sharded.
+
+SHARDED_PREFIX = "sharded_"
+
+_m = None
+
+
+def _ckpt_metrics():
+    """Lazy registration (tests/test_metrics_lint.py: never at import)."""
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            commits=metrics.counter(
+                "hvd_ckpt_commits_total",
+                "Sharded-checkpoint snapshots handed to the async "
+                "hvd-ckpt-writer thread."),
+            dropped=metrics.counter(
+                "hvd_ckpt_dropped_commits_total",
+                "Snapshots superseded in the writer's double buffer "
+                "before reaching storage (commit cadence outran the "
+                "write; the NEWEST snapshot always persists)."),
+            write_seconds=metrics.histogram(
+                "hvd_ckpt_write_seconds",
+                "Wall time of one async shard (+manifest) persist, on "
+                "the writer thread — never on the step loop."),
+            written_bytes=metrics.counter(
+                "hvd_ckpt_written_bytes_total",
+                "Payload bytes persisted by the async shard writer."),
+        )
+    return _m
+
+
+def shard_layout(leaf_nbytes: Sequence[int], world_size: int
+                 ) -> List[List[int]]:
+    """Assign flat-leaf indices to ``world_size`` shards, walking the
+    leaves in flat order and placing each on the currently-lightest
+    shard (ties -> lowest shard id). Pure function of (leaf sizes,
+    world size): every rank computes the identical map with no
+    communication."""
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    shards: List[List[int]] = [[] for _ in range(world_size)]
+    weights = [0] * world_size
+    for idx, nbytes in enumerate(leaf_nbytes):
+        k = min(range(world_size), key=lambda s: (weights[s], s))
+        shards[k].append(idx)
+        weights[k] += int(nbytes)
+    return shards
+
+
+def shard_digest(arrays: Sequence[np.ndarray]) -> str:
+    """Content digest of one shard's leaves: dtype + shape + bytes per
+    leaf, in shard order. The identity key of the whole p2p-restore
+    plane — a peer serves a shard iff its in-memory copy hashes to the
+    digest the requester asked for."""
+    h = hashlib.blake2b(digest_size=16)
+    for arr in arrays:
+        a = np.ascontiguousarray(arr)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def shard_path(directory: str, step: int, shard_id: int, world_size: int,
+               prefix: str = SHARDED_PREFIX) -> str:
+    return os.path.join(directory,
+                        f"{prefix}{step}.shard{shard_id}of{world_size}")
+
+
+def manifest_path(directory: str, step: int,
+                  prefix: str = SHARDED_PREFIX) -> str:
+    return os.path.join(directory, f"{prefix}{step}.manifest")
+
+
+def pack_shard(arrays: Sequence[np.ndarray]) -> bytes:
+    """One shard's leaves as self-describing bytes — the SHARD_DATA wire
+    payload and the on-disk blob share this format, so the disk fallback
+    is byte-identical to a peer fetch."""
+    return pickle.dumps([np.ascontiguousarray(a) for a in arrays],
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack_shard(blob: bytes, expect_digest: Optional[str] = None
+                 ) -> List[np.ndarray]:
+    arrays = [np.asarray(a) for a in pickle.loads(blob)]
+    if expect_digest is not None:
+        got = shard_digest(arrays)
+        if got != expect_digest:
+            raise ValueError(
+                f"shard digest mismatch: expected {expect_digest}, "
+                f"got {got} (torn or foreign shard)")
+    return arrays
+
+
+def save_shard(directory: str, step: int, shard_id: int, world_size: int,
+               arrays: Sequence[np.ndarray],
+               prefix: str = SHARDED_PREFIX) -> str:
+    """Persist one shard torn-proof (atomic rename swing). Returns the
+    final path."""
+    path = shard_path(directory, step, shard_id, world_size, prefix)
+    blob = pack_shard(arrays)
+    digest = shard_digest(arrays)
+
+    def write(tmp: str) -> None:
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "shard.bin"), "wb") as f:
+            f.write(blob)
+        with open(os.path.join(tmp, "meta.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump({"step": step, "shard": shard_id,
+                       "world_size": world_size, "digest": digest,
+                       "nbytes": len(blob)}, f)
+
+    os.makedirs(directory, exist_ok=True)
+    _write_atomically(path, write)
+    return path
+
+
+def load_shard(path: str, expect_digest: Optional[str] = None
+               ) -> List[np.ndarray]:
+    """Read one shard directory back, digest-validated (against its own
+    recorded meta, and against ``expect_digest`` — the manifest's — when
+    given)."""
+    with open(os.path.join(path, "shard.bin"), "rb") as f:
+        blob = f.read()
+    with open(os.path.join(path, "meta.json"), encoding="utf-8") as f:
+        meta = json.load(f)
+    arrays = unpack_shard(blob, expect_digest=meta.get("digest"))
+    if expect_digest is not None and meta.get("digest") != expect_digest:
+        raise ValueError(
+            f"shard {path} holds digest {meta.get('digest')}, manifest "
+            f"expects {expect_digest}")
+    return arrays
+
+
+def write_manifest(directory: str, step: int, manifest: Dict[str, Any],
+                   prefix: str = SHARDED_PREFIX) -> str:
+    path = manifest_path(directory, step, prefix)
+
+    def write(tmp: str) -> None:
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, "manifest.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(manifest, f, sort_keys=True)
+
+    os.makedirs(directory, exist_ok=True)
+    _write_atomically(path, write)
+    return path
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "manifest.json"), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _sharded_steps(directory: str, prefix: str) -> List[int]:
+    """Steps with a (renamed-whole) manifest present, descending."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in sorted(os.listdir(directory)):
+        if (name.startswith(prefix) and name.endswith(".manifest")
+                and ".tmp." not in name):
+            stem = name[len(prefix):-len(".manifest")]
+            try:
+                steps.append(int(stem))
+            except ValueError:
+                continue
+    return sorted(set(steps), reverse=True)
+
+
+def latest_sharded_checkpoint(directory: str, prefix: str = SHARDED_PREFIX
+                              ) -> Optional[Tuple[int, Dict[str, Any]]]:
+    """Newest COMPLETE sharded step: manifest readable and every shard
+    directory it names renamed into place. A step with any shard still
+    missing (its writer was killed before the rename swing) is skipped —
+    the double-buffered retention keeps the previous complete step on
+    disk for exactly this case."""
+    for step in _sharded_steps(directory, prefix):
+        path = manifest_path(directory, step, prefix)
+        try:
+            manifest = read_manifest(path)
+        except (OSError, ValueError):
+            continue  # torn manifest: try the previous step
+        world = int(manifest.get("world_size", 0))
+        if world < 1:
+            continue
+        if all(os.path.isdir(shard_path(directory, step, k, world, prefix))
+               for k in range(world)):
+            return step, manifest
+    return None
+
+
+def restore_latest_sharded(directory: str, like: Any,
+                           prefix: str = SHARDED_PREFIX):
+    """Resume surface for the sharded layout: ``(step, tree)`` of the
+    newest step whose manifest AND every digest-validated shard load
+    whole, or ``(None, None)`` when nothing complete exists. ``like``
+    provides the pytree structure (the shards store flat leaves)."""
+    import jax
+
+    treedef = jax.tree_util.tree_structure(like)
+    for step in _sharded_steps(directory, prefix):
+        path = manifest_path(directory, step, prefix)
+        try:
+            manifest = read_manifest(path)
+            leaves = load_manifest_leaves(directory, manifest, prefix)
+        except (OSError, ValueError, KeyError) as exc:
+            logging.warning(
+                "sharded checkpoint step %s under %s is incomplete or "
+                "torn (%s); trying the previous step", step, directory, exc)
+            continue
+        if treedef.num_leaves != len(leaves):
+            raise ValueError(
+                f"sharded checkpoint {path} holds {len(leaves)} leaves "
+                f"but `like` has {treedef.num_leaves} — structure changed "
+                "between save and resume")
+        return step, jax.tree_util.tree_unflatten(treedef, leaves)
+    return None, None
+
+
+def load_manifest_leaves(directory: str, manifest: Dict[str, Any],
+                         prefix: str = SHARDED_PREFIX) -> List[Any]:
+    """All flat leaves of one manifest's step, read from its shard
+    directories (each digest-validated) with the manifest's object-leaf
+    blob spliced back in."""
+    step = int(manifest["step"])
+    world = int(manifest["world_size"])
+    layout = manifest["layout"]
+    total = sum(len(ids) for ids in layout)
+    objects = unpack_objects(manifest)
+    flat: List[Any] = [None] * (total + len(objects))
+    for shard_id in range(world):
+        arrays = load_shard(
+            shard_path(directory, step, shard_id, world, prefix),
+            expect_digest=manifest["digests"][shard_id])
+        ids = layout[shard_id]
+        if len(arrays) != len(ids):
+            raise ValueError(
+                f"shard {shard_id} of step {step} holds {len(arrays)} "
+                f"leaves, layout expects {len(ids)}")
+        for idx, arr in zip(ids, arrays):
+            flat[idx] = arr
+    for idx, obj in objects.items():
+        flat[int(idx)] = obj
+    if any(v is None for v in flat):
+        raise ValueError(f"step {step}: leaves missing from every shard")
+    return flat
+
+
+def pack_objects(objects: Dict[int, Any]) -> str:
+    """Non-array leaves (rare, tiny) ride the manifest as a hex blob."""
+    return pickle.dumps(objects, protocol=pickle.HIGHEST_PROTOCOL).hex()
+
+
+def unpack_objects(manifest: Dict[str, Any]) -> Dict[int, Any]:
+    blob = manifest.get("objects_hex")
+    if not blob:
+        return {}
+    return pickle.loads(bytes.fromhex(blob))
+
+
+class AsyncShardWriter:
+    """The ``hvd-ckpt-writer`` daemon thread: commits hand it a snapshot
+    and return immediately; it persists double-buffered — a queue slot of
+    depth one, latest-wins, so a commit cadence faster than storage
+    drops intermediate snapshots (counted) and the newest always lands.
+    All file IO is owned by this thread (the static lock-graph
+    discipline: storage never runs under a shutdown closure or the
+    controller's locks)."""
+
+    def __init__(self, directory: str, prefix: str = SHARDED_PREFIX,
+                 keep: int = 2):
+        self.directory = directory
+        self.prefix = prefix
+        self.keep = max(2, int(keep))
+        self.last_error: Optional[BaseException] = None
+        self.written_steps = 0
+        self.dropped = 0  # latest-wins double-buffer overwrites
+        self._pending: Optional[dict] = None
+        # Held only around plain attribute swaps — NO calls run under it
+        # (the static lock graph would union a call's bare name package-
+        # wide and manufacture cycles through unrelated submit/close
+        # methods; see docs/static-analysis.md).
+        self._lock = make_lock("ckpt.writer")
+        self._wake = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-ckpt-writer", daemon=True)
+        self._thread.start()
+
+    def next_step(self) -> int:
+        """First unused step number: past anything already on disk, so a
+        restarted process never shadows an earlier incarnation's steps."""
+        steps = _sharded_steps(self.directory, self.prefix)
+        return (steps[0] + 1) if steps else 1
+
+    def submit(self, step: int, shard_id: int, world_size: int,
+               arrays: Sequence[np.ndarray],
+               manifest: Optional[Any] = None) -> None:
+        """Hand one snapshot to the writer; never blocks on storage.
+        ``manifest`` may be a dict or a zero-arg callable building one —
+        the callable runs on the writer thread (rank 0 defers the
+        full-commit digest pass there)."""
+        snap = {"step": int(step), "shard": int(shard_id),
+                "world": int(world_size), "arrays": list(arrays),
+                "manifest": manifest}
+        self._idle.clear()
+        stopped = False
+        with self._lock:
+            if self._stop:
+                stopped = True
+            else:
+                dropped = self._pending is not None
+                if dropped:
+                    self.dropped += 1
+                self._pending = snap
+        if stopped:
+            # A submit racing close(): nothing was enqueued, so flush()
+            # must not wait on an idle flag the dead thread will never
+            # set again.
+            self._idle.set()
+            return
+        self._wake.set()
+        if metrics.on():
+            m = _ckpt_metrics()
+            m.commits.inc()
+            if dropped:
+                m.dropped.inc()
+
+    def _run(self) -> None:
+        while True:
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            with self._lock:
+                snap = self._pending
+                self._pending = None
+                stop = self._stop
+            if snap is None:
+                self._idle.set()
+                if stop:
+                    return
+                continue
+            try:
+                self._persist(snap)
+            except Exception as exc:  # storage must never fail the job
+                self.last_error = exc
+                logging.error("ckpt-writer: persisting step %s failed: %s",
+                              snap["step"], exc)
+
+    def _persist(self, snap: dict) -> None:
+        fault.hook("ckpt_save")  # chaos seam: kill/delay/raise mid-write
+        t0 = time.monotonic()
+        path = save_shard(self.directory, snap["step"], snap["shard"],
+                          snap["world"], snap["arrays"],
+                          prefix=self.prefix)
+        manifest = snap["manifest"]
+        if callable(manifest):
+            # Rank 0 defers the full-commit digest pass to this thread:
+            # the hash of the whole model never runs on the step loop.
+            manifest = manifest()
+        if manifest is not None:
+            write_manifest(self.directory, snap["step"], manifest,
+                           prefix=self.prefix)
+        self._prune(snap["step"])
+        self.written_steps += 1
+        if metrics.on():
+            m = _ckpt_metrics()
+            m.write_seconds.observe(time.monotonic() - t0)
+            m.written_bytes.inc(
+                sum(int(np.asarray(a).nbytes) for a in snap["arrays"]))
+        logging.debug("ckpt-writer: persisted %s", path)
+
+    def _prune(self, current_step: int) -> None:
+        """Retention: entries older than the ``keep`` newest steps go —
+        but NEVER the newest COMPLETE step or anything after it. The
+        latest-wins buffers drop different steps on different ranks, so
+        raw step-age pruning could delete the one step every rank
+        finished (the invariant this layer exists for); completeness is
+        re-checked here, against the shared directory, on every pass.
+        Only whole (renamed) entries are touched — .tmp. transients
+        belong to _write_atomically's own sweep."""
+        cutoff = current_step - self.keep + 1
+        latest = latest_sharded_checkpoint(self.directory, self.prefix)
+        if latest is None:
+            return  # nothing provably resumable yet: delete nothing
+        cutoff = min(cutoff, int(latest[0]))
+        if not os.path.isdir(self.directory):
+            return
+        for name in sorted(os.listdir(self.directory)):
+            if not name.startswith(self.prefix) or ".tmp." in name:
+                continue
+            stem = name[len(self.prefix):].split(".", 1)[0]
+            try:
+                step = int(stem)
+            except ValueError:
+                continue
+            if step < cutoff:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Wait for the pending snapshot (if any) to reach storage —
+        tests and teardown only; the step loop never calls this."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._pending is None and self._idle.is_set():
+                return True
+            time.sleep(0.005)
+        return False
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._stop = True  # plain write: _run reads it under its lock
+        self._wake.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout)
 
 
 def latest_checkpoint(directory: str, prefix: str = "ckpt_") -> Optional[str]:

@@ -7,20 +7,22 @@ FRAME_HEARTBEAT = 1
 FRAME_ABORT = 2
 FRAME_JOIN = 3
 FRAME_RESHAPE = 4
+FRAME_SHARD_FETCH = 5
+FRAME_SHARD_DATA = 6
 
 
 class Wire:
     def recv_bytes(self):
         return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
-                FRAME_RESHAPE)
+                FRAME_RESHAPE, FRAME_SHARD_FETCH, FRAME_SHARD_DATA)
 
     def recv_hello(self):
         return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
-                FRAME_RESHAPE)
+                FRAME_RESHAPE, FRAME_SHARD_FETCH, FRAME_SHARD_DATA)
 
     def recv_reshape_ack(self, epoch):
         return (FRAME_DATA, FRAME_HEARTBEAT, FRAME_ABORT, FRAME_JOIN,
-                FRAME_RESHAPE)
+                FRAME_RESHAPE, FRAME_SHARD_FETCH, FRAME_SHARD_DATA)
 
     def send_join(self, info):
         return FRAME_JOIN  # sender plumbing: an allowed non-dispatch site

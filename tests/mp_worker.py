@@ -486,6 +486,10 @@ def _elastic_train(target_size, min_epoch=2, settle_steps=10,
                    f"{hvd.elastic.epoch()})")
 
     steps = train(state)
+    # With the disk tier on (HOROVOD_CKPT_DIR), the last committed step
+    # must reach storage before the parent inspects the directory; a
+    # no-op otherwise.
+    state.flush_checkpoints(15.0)
     # Survivors and joiners must agree bit-for-bit on the restored state.
     gathered = hvd.allgather_object(
         (int(steps), state.weights.tolist()), name="el.final")
@@ -555,6 +559,30 @@ def scenario_elastic_storm(rank, size):
     # the boundaries land in, the job must settle back at 3 ranks with a
     # bumped epoch and bit-identical state on every member.
     steps = _elastic_train(target_size=3, min_epoch=2, max_steps=40000)
+    _elastic_summary(steps)
+
+
+def scenario_elastic_ckpt_chaos(rank, size):
+    # ISSUE 15 chaos: the parent sets HOROVOD_CKPT_DIR (the async
+    # sharded disk tier rides every commit) and SIGKILLs rank 2 INSIDE
+    # its hvd-ckpt-writer thread via the ckpt_save fault site. The
+    # survivors must re-form and p2p-restore exactly as for any crash,
+    # and the shared directory must still hold a complete resumable
+    # step.
+    steps = _elastic_train(target_size=2, min_epoch=2)
+    _elastic_summary(steps)
+
+
+def scenario_elastic_ckpt_chaos_storm(rank, size):
+    # Kill+join storm with the disk tier on: reshapes, the joiner's
+    # p2p shard fetches, and delayed async writes all overlap. Fetch
+    # counters are per-process (the joiner's live in ITS registry, which
+    # shares rank 1's stdout), so every member prints its own.
+    steps = _elastic_train(target_size=3, min_epoch=2, max_steps=40000)
+    entry = hvd.metrics.snapshot().get(
+        "hvd_elastic_shard_fetches_total") or {}
+    total = sum(v for _, v in entry.get("values", []))
+    print(f"SHARD_FETCHES {int(total)}", flush=True)
     _elastic_summary(steps)
 
 
@@ -1423,6 +1451,8 @@ SCENARIOS = {
     "elastic_join": scenario_elastic_join,
     "elastic_parked": scenario_elastic_parked,
     "elastic_storm": scenario_elastic_storm,
+    "elastic_ckpt_chaos": scenario_elastic_ckpt_chaos,
+    "elastic_ckpt_chaos_storm": scenario_elastic_ckpt_chaos_storm,
     "metrics_cluster": scenario_metrics_cluster,
     "native_telemetry": scenario_native_telemetry,
     "trace": scenario_trace,

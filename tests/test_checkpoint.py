@@ -26,6 +26,30 @@ def test_save_restore_roundtrip(tmp_path):
                                   np.asarray(tree["opt"]["mu"]))
 
 
+def test_restore_missing_or_torn_path_is_loud(tmp_path):
+    """ISSUE 15 satellite: a missing path (or a .tmp. transient of an
+    interrupted save) raises FileNotFoundError naming the path AND the
+    nearest complete checkpoint — not an opaque storage-layer error."""
+    import os
+
+    import pytest
+
+    hvd.init()
+    save_checkpoint(str(tmp_path / "ckpt_7"), {"x": jnp.ones(2)})
+    missing = str(tmp_path / "ckpt_9")
+    with pytest.raises(FileNotFoundError) as exc_info:
+        restore_checkpoint(missing)
+    msg = str(exc_info.value)
+    assert missing in msg and "ckpt_7" in msg and "missing" in msg
+    torn = str(tmp_path / "ckpt_9.tmp.123")
+    os.makedirs(torn)
+    with pytest.raises(FileNotFoundError, match="torn"):
+        restore_checkpoint(torn)
+    # An empty directory: no candidate, still a curated error.
+    with pytest.raises(FileNotFoundError, match="none"):
+        restore_checkpoint(str(tmp_path / "other" / "ckpt_1"))
+
+
 def test_latest_checkpoint(tmp_path):
     hvd.init()
     assert latest_checkpoint(str(tmp_path)) is None

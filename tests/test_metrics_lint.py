@@ -91,6 +91,13 @@ def test_known_series_present():
         "hvd_membership_transitions_total",
         "hvd_membership_rank_departures_total",
         "hvd_elastic_reshape_seconds",
+        "hvd_elastic_restore_seconds",
+        "hvd_elastic_restore_bytes_total",
+        "hvd_elastic_shard_fetches_total",
+        "hvd_ckpt_commits_total",
+        "hvd_ckpt_dropped_commits_total",
+        "hvd_ckpt_write_seconds",
+        "hvd_ckpt_written_bytes_total",
         "hvd_ring_wire_bytes_total",
         "hvd_ring_compress_seconds",
         "hvd_ring_chunk_bytes",
