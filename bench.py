@@ -282,14 +282,17 @@ FULL_ROWS = {
         "args": ["--hierarchical", "--sizes-mib", "16,64", "--reps", "5",
                  "--out", "artifacts/allreduce_bandwidth_r12.json"],
         "json": True},
-    # Backward-order bucket scheduling row (round 12): gradient
-    # allreduces launch per bucket while the simulated backward still
-    # runs (2-rank native engine); the row's overlap_efficiency field is
-    # the measured fraction of the backward window with a reduction in
-    # flight. Refreshes artifacts/overlap_r12.json.
+    # Backward-order bucket scheduling row (rounds 12+16): gradient
+    # allreduces launch eagerly while the simulated backward still runs
+    # (2-rank native engine, pipelined double-buffered data plane with
+    # the last bucket priority-tagged); the row carries the measured
+    # overlap_efficiency_pipelined, the negotiation-vs-wire stall split
+    # from the calibrated control-plane model, and the step-time delta
+    # vs the serial-engine r12 baseline. Refreshes
+    # artifacts/overlap_r16.json.
     "grad_overlap_bucketed_2rank": {
         "script": "examples/overlap_probe.py",
-        "args": ["--out", "artifacts/overlap_r12.json"],
+        "args": ["--out", "artifacts/overlap_r16.json"],
         "json": True},
     # Control-plane scaling row (round 13): negotiation / reshape /
     # heartbeat-fanout costs measured at 8-64 multiplexed logical ranks

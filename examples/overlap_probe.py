@@ -1,4 +1,4 @@
-"""Backward-order bucket-scheduler overlap probe (round 12, ROADMAP item 3).
+"""Backward-order bucket-scheduler overlap probe (rounds 12+16).
 
 Spawns a real 2-rank native-engine job on this host and drives a
 simulated backward pass — N gradient tensors produced one by one with a
@@ -7,21 +7,28 @@ fixed compute delay between productions — through two paths:
 * **unbucketed**: wait for the full gradient set, then allreduce
   everything (the no-overlap baseline every naive data-parallel step
   implements);
-* **bucketed**: ``hvd.BucketScheduler`` — each size-bounded bucket's
-  allreduce launches the moment its producers complete, riding the
-  engine's background thread concurrently with the remaining "backward"
-  compute (the reference's fusion-buffer cycle, docs/overlap.md).
+* **bucketed**: ``hvd.BucketScheduler`` — with the round-16 pipelined
+  engine the scheduler launches each gradient's allreduce eagerly as it
+  is produced (the double-buffered wire thread keeps fused groups
+  moving while later gradients are still packed), and the last backward
+  bucket carries launch priority 1 so the optimizer-critical reduction
+  jumps the queue (docs/overlap.md).
 
 Reports the measured ``overlap_efficiency`` (fraction of the backward
 window with at least one reduction in flight — the union formula shared
-with ``utils.scaling_model``), both paths' step times, and the scaling
-model's PREDICTED overlap for the same schedule fed with the measured
-per-bucket communication times — the model-vs-measured validation
-ROADMAP item 4 builds on. Results are bit-identical across paths (pinned
-by tests/test_wire_compression.py's mp acceptance test); this probe is
-about WHEN collectives launch, never what they compute.
+with ``utils.scaling_model``), both paths' step times, the scaling
+model's PREDICTED overlap for the same schedule, the negotiation-vs-wire
+stall split from the r13-calibrated control-plane model, and the
+step-time delta vs the r12 serial-engine baseline artifact. Results are
+bit-identical across paths (pinned by tests/test_wire_compression.py's
+mp acceptance test); this probe is about WHEN collectives launch, never
+what they compute.
 
-Writes ``artifacts/overlap_r12.json`` via ``--out``; the last stdout
+A/B flags: ``--no-pipeline`` forces the serial engine
+(``HOROVOD_PIPELINE=0`` — the r12 behavior), ``--no-priority`` drops the
+last-bucket priority tag.
+
+Writes ``artifacts/overlap_r16.json`` via ``--out``; the last stdout
 line is a JSON summary for the ``bench.py --full`` row.
 """
 
@@ -53,6 +60,14 @@ def _parse_args(argv=None):
                    help="simulated backward compute per produced gradient")
     p.add_argument("--bucket-mib", type=float, default=8.0)
     p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--priority", dest="priority", action="store_true",
+                   default=True,
+                   help="tag the last backward bucket with launch "
+                        "priority 1 (default)")
+    p.add_argument("--no-priority", dest="priority", action="store_false")
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="HOROVOD_PIPELINE=0 in the children: serial "
+                        "fill->wire->copy-out engine, the r12 baseline")
     p.add_argument("--out", default=None, help="artifact JSON path")
     p.add_argument("--child", type=int, default=None, help=argparse.SUPPRESS)
     p.add_argument("--addrs", default=None, help=argparse.SUPPRESS)
@@ -62,9 +77,14 @@ def _parse_args(argv=None):
 def child_main(args):
     os.environ["HOROVOD_RING_ADDRS"] = args.addrs
     os.environ.setdefault("HOROVOD_CYCLE_TIME", "1")
+    if args.no_pipeline:
+        os.environ["HOROVOD_PIPELINE"] = "0"
     from horovod_tpu.common.config import Config
     from horovod_tpu.common.topology import Topology
-    from horovod_tpu.controller.bucket_scheduler import BucketScheduler
+    from horovod_tpu.controller.bucket_scheduler import (
+        BucketScheduler,
+        partition_buckets,
+    )
     from horovod_tpu.controller.native import NativeController
 
     rank, size = args.child, 2
@@ -76,6 +96,15 @@ def child_main(args):
              for i in range(args.tensors)]
     compute_s = args.compute_ms / 1e3
     bucket_bytes = int(args.bucket_mib * (1 << 20))
+    # The last backward bucket — first needed by the optimizer — is known
+    # ahead of time from the static plan; its members carry priority 1.
+    priority_names = []
+    if args.priority:
+        plan = partition_buckets(
+            [(f"grad.{i}", g.nbytes) for i, g in enumerate(grads)],
+            bucket_bytes)
+        if plan:
+            priority_names = plan[-1].names
 
     def produce():
         # The simulated backward pass: one gradient materializes per
@@ -95,7 +124,8 @@ def child_main(args):
 
     def run_bucketed():
         t0 = time.monotonic()
-        sched = BucketScheduler(ctl, bucket_bytes=bucket_bytes)
+        sched = BucketScheduler(ctl, bucket_bytes=bucket_bytes,
+                                priority_names=priority_names)
         sched.backward_started()
         for name, g in produce():
             sched.grad_ready(name, g)
@@ -118,6 +148,7 @@ def child_main(args):
         print("OVERLAP " + json.dumps({
             "unbucketed_step_ms": round(median(un_times) * 1e3, 2),
             "bucketed_step_ms": round(median(bu_times) * 1e3, 2),
+            "pipeline": bool(ctl.pipeline_enabled),
             "report": rep,
         }), flush=True)
     ctl.shutdown()
@@ -137,6 +168,10 @@ def main(argv=None):
                    str(args.tensor_mib), "--compute-ms",
                    str(args.compute_ms), "--bucket-mib",
                    str(args.bucket_mib), "--steps", str(args.steps)]
+    if args.no_pipeline:
+        passthrough.append("--no-pipeline")
+    if not args.priority:
+        passthrough.append("--no-priority")
     procs = [subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child", str(r),
          "--addrs", addrs] + passthrough,
@@ -164,26 +199,49 @@ def main(argv=None):
         raise SystemExit("rank 0 produced no OVERLAP record")
 
     report = payload["report"]
-    # Model-vs-measured (ROADMAP item 4 prep): rebuild the model's event
+    pipelined = bool(payload.get("pipeline"))
+    # Model-vs-measured (ROADMAP item 4): rebuild the model's event
     # timeline from the measured schedule and compare its overlap
     # efficiency through the SAME union formula — the shared recipe in
-    # scaling_model (the test suite pins the same path).
+    # scaling_model (the test suite pins the same path). The pipelined
+    # engine gets the pipelined event model (launches no longer
+    # serialized behind the previous bucket's copy-out).
     from horovod_tpu.utils.scaling_model import (
         BucketEvent,
         modeled_events_from_measured,
         overlap_efficiency_from_events,
+        pipelined_modeled_events,
+        stall_split_report,
     )
 
     window = report["compute_window_s"]
-    events = [BucketEvent(e["launch_s"], e["complete_s"])
-              for e in report["events"]]
-    modeled = modeled_events_from_measured(events, window)
+    if report.get("eager"):
+        modeled = pipelined_modeled_events(report["events"], window)
+    else:
+        events = [BucketEvent(e["launch_s"], e["complete_s"])
+                  for e in report["events"]]
+        modeled = modeled_events_from_measured(events, window)
     predicted = overlap_efficiency_from_events(modeled, 0.0, window)
+
+    # Negotiation-vs-wire stall split from the r13-calibrated control
+    # plane (884us/rank-class negotiation, artifacts/simcluster_r13.json)
+    # — names the owner of whatever overlap gap remains.
+    stall_split = None
+    cal_path = os.path.join(REPO, "artifacts", "simcluster_r13.json")
+    if os.path.exists(cal_path):
+        from horovod_tpu.utils.scaling_model import control_plane_from_artifact
+
+        with open(cal_path) as f:
+            cal = control_plane_from_artifact(json.load(f))
+        stall_split = stall_split_report(report["events"], cal, n=2)
+
     summary = {
         "tensors": args.tensors,
         "tensor_mib": args.tensor_mib,
         "bucket_mib": args.bucket_mib,
         "compute_ms_per_tensor": args.compute_ms,
+        "pipeline": pipelined,
+        "priority": bool(args.priority),
         "unbucketed_step_ms": payload["unbucketed_step_ms"],
         "bucketed_step_ms": payload["bucketed_step_ms"],
         "speedup_bucketed": round(
@@ -195,16 +253,41 @@ def main(argv=None):
         "model_vs_measured_abs_diff": round(
             abs(predicted - report["overlap_efficiency"]), 4),
     }
+    if pipelined:
+        summary["overlap_efficiency_pipelined"] = \
+            report["overlap_efficiency"]
+    if stall_split is not None:
+        summary["stall_split"] = stall_split
+    # Step-time delta vs the serial-engine r12 baseline artifact, when a
+    # comparable run (same workload knobs) is on disk.
+    r12_path = os.path.join(REPO, "artifacts", "overlap_r12.json")
+    if os.path.exists(r12_path):
+        with open(r12_path) as f:
+            r12 = json.load(f)
+        if all(r12.get(k) == summary[k] for k in
+               ("tensors", "tensor_mib", "bucket_mib",
+                "compute_ms_per_tensor")):
+            summary["r12_baseline"] = {
+                "bucketed_step_ms": r12["bucketed_step_ms"],
+                "overlap_efficiency": r12["overlap_efficiency"],
+            }
+            summary["step_time_delta_ms_vs_r12"] = round(
+                r12["bucketed_step_ms"] - payload["bucketed_step_ms"], 2)
     if args.out:
         artifact = {
-            "what": ("Round-12 backward-order bucket scheduling: gradient "
-                     "allreduces launch per size-bounded bucket while the "
-                     "simulated backward pass still runs (2-rank native "
-                     "engine, loopback). overlap_efficiency = fraction of "
-                     "the backward window with >=1 reduction in flight "
+            "what": ("Round-16 pipelined overlap: gradient allreduces "
+                     "launch eagerly while the simulated backward pass "
+                     "still runs, against the native engine's double-"
+                     "buffered data plane with the last bucket priority-"
+                     "tagged (2-rank, loopback). overlap_efficiency = "
+                     "fraction of the backward window with >=1 reduction "
+                     "in flight "
                      "(utils.scaling_model.overlap_efficiency_from_events "
-                     "— model and measurement share the formula)."),
-            "round": 12,
+                     "— model and measurement share the formula); "
+                     "stall_split attributes complete-after-ready time to "
+                     "negotiation vs wire via the r13-calibrated control-"
+                     "plane model."),
+            "round": 16,
             "cmd": "python examples/overlap_probe.py",
             "substrate": {
                 "transport": "loopback TCP, shared cores",

@@ -192,7 +192,8 @@ class ParameterManager:
                  hierarchical: bool = False,
                  straggler_weight: float = 0.0,
                  ring_chunk_bytes: Optional[int] = None,
-                 bucket_bytes: Optional[int] = None):
+                 bucket_bytes: Optional[int] = None,
+                 overlap_weight: float = 0.0):
         # Legacy spelling (round-3 callers/tests): hierarchical allreduce
         # only, tuned iff tune_hierarchical.
         if categoricals is None:
@@ -239,6 +240,13 @@ class ParameterManager:
         self.straggler_weight = max(0.0, float(straggler_weight))
         self._slack_fracs: List[float] = []
         self._wait_fracs: List[float] = []
+        # Overlap-aware scoring (round 16, docs/overlap.md): when the
+        # bucket scheduler publishes a measured backward/comm overlap
+        # efficiency, the blend rewards it — the tuner then optimizes
+        # step time, not just wire bandwidth. 0 (the default, and every
+        # pre-r16 caller) keeps the objective bit-identical.
+        self.overlap_weight = max(0.0, float(overlap_weight))
+        self._overlaps: List[float] = []
         self._bo_steps = 0
         self._completed = False
         self._log_path = log_path
@@ -309,24 +317,36 @@ class ParameterManager:
 
     @staticmethod
     def blend(throughput: float, slack_frac: float, wait_frac: float,
-              weight: float) -> float:
+              weight: float, overlap: Optional[float] = None,
+              overlap_weight: float = 0.0) -> float:
         """The straggler-aware objective: throughput discounted by the
         fraction of each cycle spent waiting on stragglers. Strictly
         decreasing in both penalty fractions at fixed throughput, so two
-        configurations with identical bytes/sec rank by their slack."""
-        return throughput / (1.0 + weight * max(0.0, slack_frac)
-                             + weight * max(0.0, wait_frac))
+        configurations with identical bytes/sec rank by their slack.
+        When an ``overlap`` sample exists (the bucket scheduler's measured
+        overlap efficiency in [0, 1]), the score is additionally
+        multiplied by ``1 + overlap_weight * overlap`` — strictly
+        increasing in overlap, and a no-op (bit-identical) when no sample
+        arrived."""
+        score = throughput / (1.0 + weight * max(0.0, slack_frac)
+                              + weight * max(0.0, wait_frac))
+        if overlap is not None:
+            score *= 1.0 + overlap_weight * max(0.0, min(1.0, overlap))
+        return score
 
     def record(self, nbytes: int, seconds: float,
                slack_seconds: float = 0.0,
-               recv_wait_seconds: float = 0.0
+               recv_wait_seconds: float = 0.0,
+               overlap: Optional[float] = None
                ) -> Optional[Tuple[int, float, dict]]:
         """Feed one cycle's totals; returns new (fusion_threshold, cycle_ms,
         categoricals) when the manager moves to a new configuration, else
         None. ``slack_seconds``/``recv_wait_seconds`` are the coordinator's
         per-cycle straggler observations (worst rank's tick lateness /
         total excess tick wait); both default to 0, which reduces the
-        objective to the reference's pure bytes/sec."""
+        objective to the reference's pure bytes/sec. ``overlap`` is the
+        bucket scheduler's most recent measured overlap efficiency, when
+        one exists — sampled per window alongside the throughput."""
         if nbytes <= 0 or seconds <= 0 or not self.tunable:
             return None
         if self._warmup_left > 0:
@@ -336,6 +356,8 @@ class ParameterManager:
         if self.straggler_weight > 0:
             self._slack_fracs.append(max(0.0, slack_seconds) / seconds)
             self._wait_fracs.append(max(0.0, recv_wait_seconds) / seconds)
+        if self.overlap_weight > 0 and overlap is not None:
+            self._overlaps.append(max(0.0, min(1.0, float(overlap))))
         if len(self._scores) < self.SAMPLES_PER_STEP:
             return None
 
@@ -351,11 +373,17 @@ class ParameterManager:
                       if self._slack_fracs else 0.0)
         wait_frac = (float(np.median(self._wait_fracs))
                      if self._wait_fracs else 0.0)
-        score = self.blend(throughput, slack_frac, wait_frac, w)
+        overlap_med = (float(np.median(self._overlaps))
+                       if self._overlaps else None)
+        score = self.blend(throughput, slack_frac, wait_frac, w,
+                           overlap=overlap_med,
+                           overlap_weight=self.overlap_weight)
         self.last_objective = {
             "throughput_bytes_per_sec": throughput,
             "slack_penalty": w * slack_frac,
             "recv_wait_penalty": w * wait_frac,
+            "overlap_bonus": (self.overlap_weight * overlap_med
+                              if overlap_med is not None else 0.0),
             "score": score,
         }
         params = [np.log2(self.fusion_threshold), self.cycle_time_ms]
@@ -378,6 +406,11 @@ class ParameterManager:
                 else ""
             bucket_col = f",{self.bucket_bytes}" if self._tune_bucket \
                 else ""
+            # The overlap column joins only when the term is live; it
+            # sits BEFORE the throughput/penalty/score tail so the
+            # score-is-last-column contract (r3) survives.
+            ob = self.last_objective["overlap_bonus"]
+            overlap_col = f",{ob:.6f}" if self.overlap_weight > 0 else ""
             with open(self._log_path, "a") as f:
                 if self._log_header_due:
                     # Self-describing: the column set varies with the
@@ -389,9 +422,12 @@ class ParameterManager:
                                      if self._tune_chunk else "")
                         chunk_hdr += (",bucket_bytes"
                                       if self._tune_bucket else "")
+                        overlap_hdr = (",overlap_bonus"
+                                       if self.overlap_weight > 0 else "")
                         f.write("time,fusion_threshold,cycle_time_ms"
                                 + chunk_hdr + ","
                                 + ",".join(k for k, _ in cat_items)
+                                + overlap_hdr
                                 + ",throughput_bytes_per_sec,"
                                 "slack_penalty,recv_wait_penalty,"
                                 "score_bytes_per_sec\n")
@@ -401,7 +437,7 @@ class ParameterManager:
                 # duration math. hvdlint: disable=HVD004
                 f.write(f"{time.time():.3f},{self.fusion_threshold},"
                         f"{self.cycle_time_ms:.3f}{chunk_col}{bucket_col},"
-                        f"{cats},"
+                        f"{cats}{overlap_col},"
                         f"{throughput:.1f},{w * slack_frac:.6f},"
                         f"{w * wait_frac:.6f},{score:.1f}\n")
 
@@ -446,6 +482,7 @@ class ParameterManager:
         self._scores = []
         self._slack_fracs = []
         self._wait_fracs = []
+        self._overlaps = []
         self._warmup_left = self.WARMUP_SAMPLES
         return (self.fusion_threshold, self.cycle_time_ms,
                 dict(self.categoricals))
@@ -479,6 +516,7 @@ class ParameterManager:
                                   if self.best_bucket_bytes is not None
                                   else None),
             "straggler_weight": self.straggler_weight,
+            "overlap_weight": self.overlap_weight,
             "last_objective": self.last_objective,
             "best_objective": self.best_objective,
         }

@@ -389,6 +389,29 @@ def autotune_straggler_weight() -> float:
     return val if val >= 0 else 1.0
 
 
+def pipeline_enabled() -> bool:
+    """``HOROVOD_PIPELINE``: the native engine's double-buffered data
+    plane (docs/overlap.md) — a dedicated wire thread runs group N on
+    the ring while the engine thread packs N+1 and copies out N-1.
+    Default on; ``HOROVOD_PIPELINE=0`` is the escape hatch back to the
+    serial fill->wire->copy-out stream (byte-identical results either
+    way — the knob trades step time only). The engine also falls back
+    to serial on the hierarchical allreduce plane, whose cross-hop
+    scratch is shared."""
+    return _env_bool("HOROVOD_PIPELINE", True)
+
+
+def autotune_overlap_weight() -> float:
+    """``HOROVOD_AUTOTUNE_OVERLAP_WEIGHT``: how strongly the autotuner's
+    objective rewards measured backward/comm overlap (docs/autotune.md,
+    docs/overlap.md). The blend multiplies the throughput score by
+    ``1 + w * overlap_efficiency`` whenever the bucket scheduler has
+    published a fresh overlap sample; 0 removes the term. Negative or
+    garbage values clamp to the default 1.0."""
+    val = _env_float("HOROVOD_AUTOTUNE_OVERLAP_WEIGHT", 1.0)
+    return val if val >= 0 else 1.0
+
+
 def doctor_cycles() -> int:
     """``HOROVOD_DOCTOR_CYCLES``: coordinator cycles between periodic
     cluster-doctor sweeps (the rank-0 log line + hvd_doctor_* gauges;

@@ -47,6 +47,12 @@ class Request:
     tensor_dtype: str  # numpy dtype string, e.g. "float32"
     tensor_shape: Tuple[int, ...]
     root_rank: int = -1  # broadcast only
+    # Launch priority (0 = none; docs/overlap.md): the coordinator
+    # stable-sorts each cycle's fused responses by the tagged priority so
+    # the optimizer-critical bucket launches first on every rank. Must
+    # agree across ranks for a given tensor (like dtype); NOT part of the
+    # validation matrix — a mismatch reorders, it doesn't error.
+    priority: int = 0
 
 
 @dataclasses.dataclass

@@ -18,7 +18,8 @@ import os
 
 from .. import metrics
 from ..common.autotune import ParameterManager
-from ..common.config import Config, autotune_straggler_weight
+from ..common.config import (Config, autotune_overlap_weight,
+                             autotune_straggler_weight)
 
 # knob name -> env var whose presence fixes it (reference env surface).
 _FIXING_ENV = {
@@ -85,6 +86,7 @@ def make_parameter_manager(config: Config,
         },
         fixed=fixed,
         straggler_weight=autotune_straggler_weight(),
+        overlap_weight=autotune_overlap_weight(),
         ring_chunk_bytes=ring_chunk,
         bucket_bytes=bucket,
     )
@@ -129,7 +131,7 @@ def _autotune_metrics():
                 "hvd_autotune_objective",
                 "Blended-objective components of the most recently scored "
                 "configuration (docs/autotune.md): throughput_bytes_per_sec,"
-                " slack_penalty, recv_wait_penalty, score.",
+                " slack_penalty, recv_wait_penalty, overlap_bonus, score.",
                 ("component",)),
             best_objective=metrics.gauge(
                 "hvd_autotune_best_objective",
@@ -156,8 +158,8 @@ def publish_tuner_gauges(pm: ParameterManager) -> None:
     last = state["last_objective"]
     if last is not None:
         for component in ("throughput_bytes_per_sec", "slack_penalty",
-                          "recv_wait_penalty", "score"):
-            m.objective.labels(component).set(last[component])
+                          "recv_wait_penalty", "overlap_bonus", "score"):
+            m.objective.labels(component).set(last.get(component, 0.0))
     best = state["best_objective"]
     if best is not None:
         m.best_objective.set(best["score"])

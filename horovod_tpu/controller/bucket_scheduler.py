@@ -35,14 +35,30 @@ buckets launch *compressed* allreduces when the wire dtype says so, and
 the per-name error-feedback residuals keep working because bucket
 launches preserve the caller's stable gradient names.
 
+Round 16 (docs/overlap.md): when the controller's data plane is
+pipelined (``NativeController.pipeline_enabled``), the scheduler
+switches to EAGER launch — each gradient's allreduce is enqueued the
+moment it is produced (the engine's Tensor Fusion still packs per
+cycle, and the double-buffered wire thread keeps groups moving while
+later gradients are still being packed), which is what actually lets
+wire time hide under backward. Buckets remain the *reporting* unit:
+each event spans [first member enqueued, all members complete], with
+``ready_s`` (last member produced) recorded so the stall split can
+attribute complete-after-ready time to negotiation vs wire. Priority
+tags (``priority_names``, plus the finish()-tail bucket under batched
+launch) ride down to the engine so the optimizer-critical bucket jumps
+the launch queue.
+
 Knobs: ``HOROVOD_BUCKET_BYTES`` (0 = auto, joins the GP autotuner —
 docs/autotune.md); metrics: ``hvd_overlap_buckets_total``,
-``hvd_overlap_efficiency`` (docs/overlap.md).
+``hvd_overlap_efficiency``, ``hvd_overlap_priority_jumps_total``
+(docs/overlap.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -83,6 +99,18 @@ def current_bucket_bytes() -> int:
     if _autotuned_bucket_bytes is not None:
         return _autotuned_bucket_bytes
     return resolved_bucket_bytes()
+
+
+# Most recent measured overlap_efficiency (any scheduler's finish() on
+# this process). The native tune loop samples it into the GP objective
+# (docs/autotune.md) — None until a first step finishes.
+_last_overlap: Optional[float] = None
+
+
+def last_overlap_efficiency() -> Optional[float]:
+    """The last finished step's measured ``overlap_efficiency``, or None
+    before any step completed. Feeds the autotuner's overlap term."""
+    return _last_overlap
 
 
 @dataclasses.dataclass
@@ -216,6 +244,12 @@ def _overlap_metrics():
                 "Measured fraction of the last backward window during "
                 "which at least one bucket reduction was in flight "
                 "(docs/overlap.md)."),
+            priority_jumps=metrics.counter(
+                "hvd_overlap_priority_jumps_total",
+                "Cycles whose fused-launch order was changed by a "
+                "priority tag — python controller reorders counted "
+                "here directly, native-engine reorders mirrored from "
+                "its priority_jumps counter (docs/overlap.md)."),
         )
     return _m
 
@@ -247,7 +281,9 @@ class BucketScheduler:
 
     def __init__(self, controller: Optional[Any] = None,
                  bucket_bytes: Optional[int] = None,
-                 average: bool = True):
+                 average: bool = True,
+                 eager: Optional[bool] = None,
+                 priority_names: Optional[Sequence[str]] = None):
         if controller is None:
             # The running job's controller — the surface a user script
             # reaches for as hvd.BucketScheduler(). state() itself
@@ -271,14 +307,41 @@ class BucketScheduler:
         self.bucket_bytes = int(bucket_bytes) if bucket_bytes \
             else current_bucket_bytes()
         self._average = average
+        # Eager per-tensor launch (round 16): enqueue each gradient the
+        # moment it is produced instead of holding a bucket's worth —
+        # the pipelined engine keeps earlier groups on the wire while
+        # later ones are still being packed, so batching at THIS layer
+        # would only serialize what the engine can overlap. Auto-on when
+        # the controller advertises a pipelined data plane.
+        if eager is None:
+            eager = bool(getattr(controller, "pipeline_enabled", False))
+        self.eager = bool(eager)
+        # Names to tag with launch priority 1 (the optimizer-critical
+        # bucket — typically the LAST backward bucket, known ahead of
+        # time from the plan). Under batched launch the finish() tail
+        # bucket is additionally tagged; eager launches can only honor
+        # an up-front set (a tensor already on the wire can't jump).
+        self._priority_names = frozenset(
+            str(n) for n in (priority_names or ()))
+        try:
+            self._supports_priority = "priority" in inspect.signature(
+                controller.allreduce_async).parameters
+        except (TypeError, ValueError):
+            self._supports_priority = False
         self.reset()
 
     def reset(self) -> None:
         self._pending: List[Tuple[str, Any]] = []
         self._pending_bytes = 0
+        self._pending_ready_s: Optional[float] = None
         # In-flight buckets: list of dicts {handles: [(name, handle)],
-        # launch_s, complete_s (None until observed)}.
+        # launch_s, ready_s (last member produced), complete_s (None
+        # until observed)}.
         self._inflight: List[dict] = []
+        # Eager mode: the bucket currently accepting members (an entry
+        # of _inflight), with its accumulated payload bytes.
+        self._open: Optional[dict] = None
+        self._open_bytes = 0
         self._results: Dict[str, Any] = {}
         self._t_backward_start: Optional[float] = None
         self._t_last_ready: Optional[float] = None
@@ -295,36 +358,75 @@ class BucketScheduler:
 
     def grad_ready(self, name: str, array: Any) -> None:
         """Feed one produced gradient (call in backward production
-        order). Closes and launches the current bucket when adding this
-        tensor would exceed the size bound — so the reduction of earlier
-        gradients rides concurrently with the production of later
-        ones."""
+        order). Batched mode: closes and launches the current bucket
+        when adding this tensor would exceed the size bound — so the
+        reduction of earlier gradients rides concurrently with the
+        production of later ones. Eager mode: enqueues the tensor
+        immediately and only tracks bucket boundaries for reporting."""
         now = time.monotonic()
         if self._t_backward_start is None:
             self._t_backward_start = now
         self._t_last_ready = now
         self._poll_inflight(now)
         arr = np.asarray(array)
+        if self.eager:
+            self._launch_eager(str(name), arr, now)
+            return
         if self._pending and \
                 self._pending_bytes + arr.nbytes > self.bucket_bytes:
             self._launch()
         self._pending.append((str(name), arr))
         self._pending_bytes += arr.nbytes
+        self._pending_ready_s = now
         if self._pending_bytes >= self.bucket_bytes:
             self._launch()
 
-    def _launch(self) -> None:
+    def _allreduce(self, name: str, arr, priority: int):
+        if priority and self._supports_priority:
+            return self._ctl.allreduce_async(
+                arr, average=self._average, name=name, priority=priority)
+        return self._ctl.allreduce_async(
+            arr, average=self._average, name=name)
+
+    def _launch_eager(self, name: str, arr, now: float) -> None:
+        # The tensor goes straight to the engine; the open reporting
+        # bucket closes by the same would-exceed rule partition_buckets
+        # applies, so eager and batched report comparable event counts.
+        if self._open is not None and \
+                self._open_bytes + arr.nbytes > self.bucket_bytes:
+            self._open = None
+        if self._open is None:
+            self._open = {"handles": [], "launch_s": now, "ready_s": now,
+                          "complete_s": None}
+            self._open_bytes = 0
+            self._inflight.append(self._open)
+            self._buckets_launched += 1
+            if metrics.on():
+                _overlap_metrics().buckets.inc()
+        prio = 1 if name in self._priority_names else 0
+        self._open["handles"].append((name, self._allreduce(name, arr, prio)))
+        self._open["ready_s"] = now
+        self._open_bytes += arr.nbytes
+        if self._open_bytes >= self.bucket_bytes:
+            self._open = None
+
+    def _launch(self, priority: int = 0) -> None:
         if not self._pending:
             return
         launch_s = time.monotonic()
-        handles = [(name, self._ctl.allreduce_async(
-            arr, average=self._average, name=name))
+        handles = [(name, self._allreduce(
+            name, arr,
+            max(priority, 1 if name in self._priority_names else 0)))
             for name, arr in self._pending]
         self._inflight.append(
-            {"handles": handles, "launch_s": launch_s, "complete_s": None})
+            {"handles": handles, "launch_s": launch_s,
+             "ready_s": (self._pending_ready_s
+                         if self._pending_ready_s is not None else launch_s),
+             "complete_s": None})
         self._buckets_launched += 1
         self._pending = []
         self._pending_bytes = 0
+        self._pending_ready_s = None
         if metrics.on():
             _overlap_metrics().buckets.inc()
 
@@ -332,9 +434,11 @@ class BucketScheduler:
         # Opportunistic completion stamping: the engine resolves handles
         # on its background thread; observing done() here (between
         # gradient productions) bounds the recorded complete time without
-        # blocking the backward pass.
+        # blocking the backward pass. The OPEN eager bucket is excluded —
+        # it will still grow, so "all current handles done" is not
+        # "bucket complete".
         for b in self._inflight:
-            if b["complete_s"] is None and \
+            if b is not self._open and b["complete_s"] is None and \
                     all(h.done() for _, h in b["handles"]):
                 b["complete_s"] = now
 
@@ -344,26 +448,38 @@ class BucketScheduler:
         """Flush the tail bucket, wait for every reduction, and return
         ``(results, report)``: reduced arrays by name, and the measured
         overlap report (``overlap_efficiency`` et al, the shape the
-        bench row embeds). Also mirrors ``hvd_overlap_efficiency``."""
-        self._launch()
+        bench row embeds). Also mirrors ``hvd_overlap_efficiency`` and
+        publishes the sample for the autotuner's overlap term.
+
+        The tail bucket — the LAST backward bucket, first needed by the
+        optimizer — launches with priority 1, so under batched launch it
+        jumps the engine's negotiation queue (docs/overlap.md)."""
+        self._launch(priority=1)
+        self._open = None
         t_compute_end = (self._t_last_ready
                          if self._t_last_ready is not None
                          else time.monotonic())
         events: List[BucketEvent] = []
+        ready_offsets: List[float] = []
         for b in self._inflight:
             for name, h in b["handles"]:
                 self._results[name] = h.wait()
             if b["complete_s"] is None:
                 b["complete_s"] = time.monotonic()
             events.append(BucketEvent(b["launch_s"], b["complete_s"]))
+            ready_offsets.append(b.get("ready_s", b["launch_s"]))
         start = (self._t_backward_start
                  if self._t_backward_start is not None else t_compute_end)
         report = measured_overlap_report(events, start, t_compute_end)
         report["bucket_bytes"] = self.bucket_bytes
+        report["eager"] = self.eager
         report["events"] = [
             {"launch_s": round(e.launch_s - start, 6),
+             "ready_s": round(r - start, 6),
              "complete_s": round(e.complete_s - start, 6)}
-            for e in events]
+            for e, r in zip(events, ready_offsets)]
+        global _last_overlap
+        _last_overlap = report["overlap_efficiency"]
         if metrics.on():
             _overlap_metrics().efficiency.set(report["overlap_efficiency"])
         results = dict(self._results)

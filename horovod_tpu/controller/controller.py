@@ -479,7 +479,8 @@ class Controller:
     def _enqueue(self, kind: str, name: Optional[str], array: np.ndarray,
                  request_type: RequestType, average: bool = False,
                  root_rank: int = -1,
-                 postprocess: Optional[Callable] = None) -> Handle:
+                 postprocess: Optional[Callable] = None,
+                 priority: int = 0) -> Handle:
         name = self._autoname(kind, name)
         array = np.asarray(array)
         if not array.flags.c_contiguous:
@@ -488,7 +489,8 @@ class Controller:
         req = Request(
             request_rank=self.topo.rank, request_type=request_type,
             tensor_name=name, tensor_dtype=str(array.dtype),
-            tensor_shape=tuple(array.shape), root_rank=root_rank)
+            tensor_shape=tuple(array.shape), root_rank=root_rank,
+            priority=int(priority))
         if metrics.on():
             m = _ctl_metrics()
             dtype = str(array.dtype)
@@ -532,12 +534,19 @@ class Controller:
     def allreduce_async(self, tensor, average: bool = True,
                         name: Optional[str] = None, compression=None,
                         wrap: Optional[Callable] = None,
-                        inplace: bool = False) -> Handle:
+                        inplace: bool = False,
+                        priority: int = 0) -> Handle:
         """``inplace=True``: the result is written back into ``tensor``'s
         memory and ``tensor`` is the resolved value. The star transport
         inherently stages through pickled messages, so this is emulated
         with one final copy (the native engine does it with zero copies —
-        same API either way)."""
+        same API either way).
+
+        ``priority``: launch priority (docs/overlap.md) — the engine
+        parity of the native controller's knob: nonzero moves this
+        cycle's highest-priority fused group to the front of the launch
+        order on every rank. Never changes results, only completion
+        order."""
         array = np.asarray(tensor)
         if inplace and (not array.flags.writeable
                         or not array.flags.c_contiguous):
@@ -568,7 +577,8 @@ class Controller:
 
         return self._enqueue("allreduce", name, array_in,
                              RequestType.ALLREDUCE,
-                             average=average, postprocess=post)
+                             average=average, postprocess=post,
+                             priority=priority)
 
     def allgather_async(self, tensor, name: Optional[str] = None,
                         wrap: Optional[Callable] = None) -> Handle:
@@ -861,10 +871,13 @@ class Controller:
             # identical collectives. hvdlint: disable=HVD001
             nbytes = self._process_reply(reply)
             if self._param_manager is not None:
+                from .bucket_scheduler import last_overlap_efficiency
+
                 tuned = self._param_manager.record(
                     nbytes, time.monotonic() - t0,
                     slack_seconds=self._cycle_slack,
-                    recv_wait_seconds=self._cycle_excess_wait)
+                    recv_wait_seconds=self._cycle_excess_wait,
+                    overlap=last_overlap_efficiency())
                 if tuned is not None:
                     # Continuous knobs apply immediately (coordinator-only
                     # effects); the hierarchical flag is applied ONLY via
@@ -994,7 +1007,7 @@ class Controller:
                         name, _OP_NAMES[requests[0].request_type])
 
         self._check_stalls(now)
-        responses = self._fuse_responses(ready)
+        responses = self._prioritize_responses(self._fuse_responses(ready))
         reply = {
             "bypass_bits": bypass_bits,
             "invalid_mask": invalid_mask,
@@ -1044,6 +1057,37 @@ class Controller:
                 i += 1  # look-ahead (reference operations.cc:483-499)
             out.append(fused)
         return out
+
+    def _prioritize_responses(
+            self, responses: List[Response]) -> List[Response]:
+        """Priority launch ordering (docs/overlap.md), the python parity
+        of the native engine's coordinator sort: stable-sort the cycle's
+        responses by each one's max member priority, descending, so the
+        optimizer-critical fused group launches first. Runs on the
+        coordinator only and the sorted order rides the reply — every
+        rank therefore launches in the identical order, which is what
+        keeps the ring's call pairing intact. A no-op (and no counter
+        tick) when no tensor this cycle carries a priority."""
+        if len(responses) <= 1:
+            return responses
+        prios = []
+        for r in responses:
+            p = 0
+            for n in r.tensor_names:
+                entry = self._table.get(n)
+                if entry is not None:
+                    p = max(p, getattr(entry.request, "priority", 0))
+            prios.append(p)
+        if not any(p > 0 for p in prios):
+            return responses
+        order = sorted(range(len(responses)), key=lambda i: -prios[i])
+        if order == list(range(len(responses))):
+            return responses
+        if metrics.on():
+            from .bucket_scheduler import _overlap_metrics
+
+            _overlap_metrics().priority_jumps.inc()
+        return [responses[i] for i in order]
 
     def _response_dtype(self, response: Response) -> str:
         return self._table[response.tensor_names[0]].request.tensor_dtype

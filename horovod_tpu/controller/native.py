@@ -207,13 +207,20 @@ class NativeController:
             ring_wire_dtype_cross()]
         self._residuals: Dict[str, np.ndarray] = {}
         self._warned_unnamed_int8 = False
+        # Pipelined data plane (docs/overlap.md): double-buffered fusion
+        # + wire thread. The BucketScheduler keys its eager per-tensor
+        # launch mode off this attribute.
+        from ..common.config import pipeline_enabled
+
+        self.pipeline_enabled = pipeline_enabled()
         rc = lib.hvd_eng_init(
             topology.rank, topology.size, ring_addrs.encode(), key,
             len(secret), config.cycle_time_ms, config.fusion_threshold_bytes,
             config.cache_capacity, 1 if config.stall_check_disable else 0,
             config.stall_check_seconds, config.stall_shutdown_seconds,
             timeline.encode(), 1 if config.timeline_mark_cycles else 0,
-            self._wire_code, self._wire_local_code, self._wire_cross_code)
+            self._wire_code, self._wire_local_code, self._wire_cross_code,
+            1 if self.pipeline_enabled else 0)
         if rc != 0:
             raise RuntimeError(
                 "native engine init failed: "
@@ -345,7 +352,8 @@ class NativeController:
                  root_rank: int = -1,
                  postprocess: Optional[Callable] = None,
                  inplace: bool = False,
-                 residual: Optional[np.ndarray] = None) -> NativeHandle:
+                 residual: Optional[np.ndarray] = None,
+                 priority: int = 0) -> NativeHandle:
         """Zero-copy enqueue: the engine reads — and for allreduce /
         broadcast WRITES the result — directly in ``array``'s memory; the
         handle pins the array until completion.
@@ -380,7 +388,7 @@ class NativeController:
         h = self._lib.hvd_eng_enqueue(
             _OP_CODES[kind], name.encode(),
             array.ctypes.data_as(ctypes.c_void_p), shape, array.ndim, code,
-            root_rank, res_ptr)
+            root_rank, res_ptr, int(priority))
         if h == -2:
             return NativeHandle.failed(RuntimeError(
                 f"Duplicate tensor name {name!r}: a collective with this "
@@ -400,11 +408,18 @@ class NativeController:
     def allreduce_async(self, tensor, average: bool = True,
                         name: Optional[str] = None, compression=None,
                         wrap: Optional[Callable] = None,
-                        inplace: bool = False) -> NativeHandle:
+                        inplace: bool = False,
+                        priority: int = 0) -> NativeHandle:
         """``inplace=True``: ``tensor`` must be a writable C-contiguous
         numpy array (or a view of framework memory, e.g. a torch CPU
         tensor's ``.numpy()`` view); the reduced — and averaged — result
-        lands in that memory with zero copies."""
+        lands in that memory with zero copies.
+
+        ``priority``: launch priority (docs/overlap.md). Nonzero tags
+        the request so the coordinator launches this cycle's highest-
+        priority fused group first on every rank; must agree across
+        ranks for a given tensor name. Never changes results — only
+        completion order."""
         orig = np.asarray(tensor)
         ctx = None
         if compression is not None:
@@ -491,7 +506,8 @@ class NativeController:
             return wrap(out) if wrap is not None else out
 
         handle = self._enqueue("allreduce", name, array, postprocess=post,
-                               inplace=enqueue_inplace, residual=residual)
+                               inplace=enqueue_inplace, residual=residual,
+                               priority=priority)
         if residual is not None:
             if handle._error is None:
                 # Enqueue accepted: this buffer (fresh or reused) is now
@@ -569,7 +585,15 @@ class NativeController:
             last_bytes, last_busy = nbytes.value, busy.value
             if delta_bytes <= 0 or delta_busy <= 0:
                 continue
-            tuned = self._param_manager.record(delta_bytes, delta_busy)
+            # Measured backward/comm overlap from the bucket scheduler's
+            # most recent finished step (None until one lands): joins the
+            # GP objective so the tuner optimizes step time, not just
+            # wire bandwidth (docs/overlap.md).
+            from .bucket_scheduler import last_overlap_efficiency
+
+            tuned = self._param_manager.record(
+                delta_bytes, delta_busy,
+                overlap=last_overlap_efficiency())
             if tuned is not None:
                 threshold, cycle_ms = tuned[:2]
                 self._lib.hvd_eng_set_params(int(threshold), float(cycle_ms))

@@ -53,13 +53,17 @@ NATIVE_HIST_SLOTS = NATIVE_HIST_BUCKETS + 1  # + the +Inf overflow slot
 NATIVE_COUNTER_SCALARS = (
     "cycles", "tensors", "fused_tensors", "processed_bytes",
     "fusion_capacity", "fusion_fill", "spans", "spans_dropped",
-    "bucket_bytes", "cache_hits", "cache_misses")
-_NATIVE_CYCLE_HIST_BASE = len(NATIVE_COUNTER_SCALARS)            # 11
+    "bucket_bytes", "cache_hits", "cache_misses",
+    # Round 16 pipelined data plane: high-water wire-queue depth,
+    # cumulative µs the engine thread spent blocked on the wire thread,
+    # and cycles whose launch order was changed by a priority tag.
+    "pipeline_depth", "pipeline_stall_us", "priority_jumps")
+_NATIVE_CYCLE_HIST_BASE = len(NATIVE_COUNTER_SCALARS)            # 14
 _NATIVE_EXEC_HIST_BASE = _NATIVE_CYCLE_HIST_BASE + 2 + NATIVE_HIST_SLOTS
 # Trailing slot: engine generation (bumped per init — lets the metrics
 # mirror re-baseline when a new engine restarts the counters at zero).
-_NATIVE_ENGINE_GEN = _NATIVE_EXEC_HIST_BASE + 2 + NATIVE_HIST_SLOTS  # 61
-N_NATIVE_COUNTER_SLOTS = _NATIVE_ENGINE_GEN + 1                      # 62
+_NATIVE_ENGINE_GEN = _NATIVE_EXEC_HIST_BASE + 2 + NATIVE_HIST_SLOTS  # 64
+N_NATIVE_COUNTER_SLOTS = _NATIVE_ENGINE_GEN + 1                      # 65
 
 # Must match enum SpanPhase in engine.cc: codes index the tracer's fixed
 # PHASES vocabulary ("enqueue", "negotiate", "fuse", "execute", "done").
@@ -365,17 +369,19 @@ def load() -> Optional[ctypes.CDLL]:
         lib.hvd_ringh_destroy.restype = None
         # Native eager-tier engine (engine.cc; reference C ABI shape at
         # horovod/common/operations.cc:1595-1650).
+        # Round 16: trailing pipeline-enable flag (double-buffered fusion
+        # + wire thread) on init, trailing launch priority on enqueue.
         lib.hvd_eng_init.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_double,
             ctypes.c_longlong, ctypes.c_int, ctypes.c_int, ctypes.c_double,
             ctypes.c_double, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int]
+            ctypes.c_int, ctypes.c_int, ctypes.c_int]
         lib.hvd_eng_init.restype = ctypes.c_int
         lib.hvd_eng_enqueue.argtypes = [
             ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p,
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_void_p]
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int]
         lib.hvd_eng_enqueue.restype = ctypes.c_longlong
         lib.hvd_eng_poll.argtypes = [ctypes.c_longlong]
         lib.hvd_eng_poll.restype = ctypes.c_int

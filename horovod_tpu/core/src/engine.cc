@@ -319,9 +319,16 @@ enum CounterSlot : int {
   CTR_BUCKET_BYTES = 8,
   CTR_CACHE_HITS = 9,
   CTR_CACHE_MISSES = 10,
-  CTR_CYCLE_HIST_COUNT = 11,
-  CTR_CYCLE_HIST_SUM_US = 12,
-  CTR_CYCLE_HIST_BUCKETS = 13,                           // .. +kHistSlots
+  // Pipelined data plane (round 16): high-water count of fused groups
+  // outstanding on the wire thread, cumulative µs the engine thread spent
+  // blocked on the wire (no free fusion slot / draining before a control
+  // frame), and cycles whose response order was changed by a priority tag.
+  CTR_PIPELINE_DEPTH = 11,
+  CTR_PIPELINE_STALL_US = 12,
+  CTR_PRIORITY_JUMPS = 13,
+  CTR_CYCLE_HIST_COUNT = 14,
+  CTR_CYCLE_HIST_SUM_US = 15,
+  CTR_CYCLE_HIST_BUCKETS = 16,                           // .. +kHistSlots
   CTR_EXEC_HIST_COUNT = CTR_CYCLE_HIST_BUCKETS + kHistSlots,
   CTR_EXEC_HIST_SUM_US = CTR_EXEC_HIST_COUNT + 1,
   CTR_EXEC_HIST_BUCKETS = CTR_EXEC_HIST_SUM_US + 1,      // .. +kHistSlots
@@ -329,7 +336,7 @@ enum CounterSlot : int {
   // zero with every new engine, so the Python mirror re-baselines when
   // it sees a new generation instead of clamping on "decreasing" totals.
   CTR_ENGINE_GEN = CTR_EXEC_HIST_BUCKETS + kHistSlots,
-  N_COUNTER_SLOTS = CTR_ENGINE_GEN + 1,                  // 62
+  N_COUNTER_SLOTS = CTR_ENGINE_GEN + 1,                  // 65
 };
 
 constexpr size_t kSpanRingDefault = 1 << 16;
@@ -361,7 +368,7 @@ class Engine {
   Engine(int rank, int size, double cycle_ms, long long fusion_threshold,
          int cache_capacity, bool stall_disable, double stall_warn_s,
          double stall_shutdown_s, const std::string& timeline_path,
-         bool timeline_mark_cycles, int wire_dtype)
+         bool timeline_mark_cycles, int wire_dtype, bool pipeline)
       : rank_(rank),
         size_(size),
         cycle_ms_(cycle_ms),
@@ -372,9 +379,19 @@ class Engine {
         wire_dtype_(wire_dtype),
         cache_(cache_capacity),
         hier_(g_hier) {
+    // Pipelining covers the flat ring's allreduce path only: the two-level
+    // plane's shared cross-hop scratch and multi-ring calls stay serial
+    // (allgather/broadcast always drain first — see execute()).
+    pipeline_ =
+        pipeline && !(hier_.allreduce && (hier_.local_ring || hier_.shm));
+    // Test-only determinism hook: per-job wire-thread sleep so a size-1
+    // fake ring exhibits measurable fill-while-on-wire overlap.
+    const char* delay = getenv("HOROVOD_PIPELINE_TEST_DELAY_US");
+    if (delay && *delay) test_delay_us_ = atoll(delay);
     if (!timeline_path.empty() && rank == 0)
       timeline_ = std::make_unique<Timeline>(timeline_path,
                                              timeline_mark_cycles);
+    if (pipeline_) wire_thread_ = std::thread([this] { wire_loop(); });
     thread_ = std::thread([this] { run_loop(); });
   }
 
@@ -389,7 +406,7 @@ class Engine {
   // Returns handle >= 0; -2 duplicate name; -3 shut down.
   long long enqueue(uint8_t op, const std::string& name, void* data,
                     const int64_t* shape, int ndim, uint8_t dtype,
-                    int32_t root_rank, void* residual) {
+                    int32_t root_rank, void* residual, int32_t priority) {
     std::lock_guard<std::mutex> g(mu_);
     if (closed_ || shutdown_requested_) return -3;
     if (table_.count(name)) return -2;  // reference IncrementTensorCount dup
@@ -400,6 +417,7 @@ class Engine {
     e.request.request_type = op;
     e.request.dtype = dtype;
     e.request.root_rank = root_rank;
+    e.request.priority = priority;
     e.request.shape.assign(shape, shape + ndim);
     e.request.tensor_name = name;
     size_t count = 1;
@@ -560,6 +578,12 @@ class Engine {
     tmp[CTR_BUCKET_BYTES] = bucket_synced_.load(std::memory_order_relaxed);
     tmp[CTR_CACHE_HITS] = cache_hits_.load(std::memory_order_relaxed);
     tmp[CTR_CACHE_MISSES] = cache_misses_.load(std::memory_order_relaxed);
+    tmp[CTR_PIPELINE_DEPTH] =
+        pipeline_depth_.load(std::memory_order_relaxed);
+    tmp[CTR_PIPELINE_STALL_US] =
+        pipeline_stall_us_.load(std::memory_order_relaxed);
+    tmp[CTR_PRIORITY_JUMPS] =
+        priority_jumps_.load(std::memory_order_relaxed);
     {
       std::lock_guard<std::mutex> g(tele_mu_);
       tmp[CTR_CYCLE_HIST_COUNT] = cycle_hist_.count;
@@ -606,6 +630,12 @@ class Engine {
     std::lock_guard<std::mutex> g(mu_);
     fusion_buffer_.clear();
     fusion_buffer_.shrink_to_fit();
+    for (FusionSlot& s : slots_) {
+      s.buf.clear();
+      s.buf.shrink_to_fit();
+      s.residual.clear();
+      s.residual.shrink_to_fit();
+    }
     finished_ = true;
   }
 
@@ -634,9 +664,17 @@ class Engine {
         if (rank_ == 0) {
           // The coordinator paces the token (reference sleeps cycle_time in
           // every rank's loop, operations.cc:1250-1255; workers here are
-          // paced by token arrival instead).
-          std::this_thread::sleep_for(
-              std::chrono::duration<double, std::milli>(cycle_ms_));
+          // paced by token arrival instead). With pipelining the pacing
+          // window runs CONCURRENTLY with the wire drain: last cycle's
+          // fused groups keep moving on the wire thread while this thread
+          // reaps/copies out, and only drain time past the pacing deadline
+          // counts as a pipeline stall.
+          double deadline = mono_s() + cycle_ms_.load() / 1000.0;
+          if (pipeline_) reap_wire(/*wait_all=*/true, deadline);
+          double remain = deadline - mono_s();
+          if (remain > 0)
+            std::this_thread::sleep_for(
+                std::chrono::duration<double>(remain));
         }
         double t0 = mono_s();
         if (timeline_) timeline_->mark_cycle_start();
@@ -706,6 +744,13 @@ class Engine {
   }
 
   void cycle() {
+    // The wire thread shares the ring sockets with the control plane: a
+    // rank must fully drain its wire queue before reading a control frame
+    // (interleaved reads would corrupt both streams). All ranks drain in
+    // the identical FIFO order, so every queued collective's peer traffic
+    // is guaranteed to flow before anyone touches control I/O. Rank 0
+    // drained inside the pacing window (run_loop) instead.
+    if (pipeline_ && rank_ != 0) reap_wire(/*wait_all=*/true);
     std::vector<std::string> sent_names;
     Tick own = build_tick(&sent_names);
     bool tr = trace_on_.load(std::memory_order_relaxed);
@@ -829,6 +874,7 @@ class Engine {
 
     check_stalls(now);
     reply.responses.responses = fuse_responses(std::move(ready));
+    prioritize_responses(reply.responses.responses);
     reply.responses.shutdown = reply.shutdown;
     reply.bypass_words = and_mask.words();
     reply.invalid_words = invalid.words();
@@ -897,6 +943,44 @@ class Engine {
     return out;
   }
 
+  // Priority scheduling: the optimizer-critical bucket (tagged by the
+  // BucketScheduler, carried on Request.priority) jumps the launch queue
+  // HERE, at coordination — the one place with a global view — so every
+  // rank executes the identical reordered sequence and the wire FIFO
+  // stays rank-consistent (a per-rank local jump would desynchronize the
+  // ring call pairing). Stable sort: equal priorities keep negotiation
+  // order, so untagged jobs are bit-for-bit unaffected.
+  void prioritize_responses(std::vector<Response>& responses) {
+    if (responses.size() < 2) return;
+    std::vector<int32_t> prio(responses.size(), 0);
+    bool any = false;
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (size_t i = 0; i < responses.size(); i++) {
+        for (const auto& name : responses[i].tensor_names) {
+          auto it = table_.find(name);
+          if (it != table_.end() && it->second.request.priority > prio[i])
+            prio[i] = it->second.request.priority;
+        }
+        if (prio[i] > 0) any = true;
+      }
+    }
+    if (!any) return;
+    std::vector<size_t> order(responses.size());
+    for (size_t i = 0; i < order.size(); i++) order[i] = i;
+    std::stable_sort(order.begin(), order.end(),
+                     [&](size_t a, size_t b) { return prio[a] > prio[b]; });
+    bool moved = false;
+    for (size_t i = 0; i < order.size(); i++)
+      moved = moved || order[i] != i;
+    if (!moved) return;
+    std::vector<Response> sorted;
+    sorted.reserve(responses.size());
+    for (size_t i : order) sorted.push_back(std::move(responses[i]));
+    responses = std::move(sorted);
+    priority_jumps_.fetch_add(1, std::memory_order_relaxed);
+  }
+
   // Reference CheckForStalledTensors (operations.cc:688-769).
   void check_stalls(double now) {
     if (stall_disable_) return;
@@ -956,7 +1040,24 @@ class Engine {
     // parity — merged traces correlate across engines on args.seq).
     long long seq = reply.trace_seq;
     BitMask bypass(reply.bypass_words);
-    for (int bit : bypass.bits()) {
+    std::vector<int> bypass_bits = bypass.bits();
+    // Cache-bypass ops never reach the coordinator's priority sort (they
+    // skip negotiation), so the walk order applies the same key locally:
+    // priority desc, bit index asc. Priorities are rank-consistent by
+    // contract (like dtype agreement), so every rank walks — and stamps
+    // seq ids over — the identical order.
+    if (bypass_bits.size() > 1) {
+      std::lock_guard<std::mutex> g(mu_);
+      auto bit_prio = [&](int bit) -> int32_t {
+        auto it = bit_pending_.find(bit);
+        if (it == bit_pending_.end()) return 0;
+        auto te = table_.find(it->second);
+        return te == table_.end() ? 0 : te->second.request.priority;
+      };
+      std::stable_sort(bypass_bits.begin(), bypass_bits.end(),
+                       [&](int a, int b) { return bit_prio(a) > bit_prio(b); });
+    }
+    for (int bit : bypass_bits) {
       // Cached fast path (reference RunBypass, operations.cc:1166-1215).
       std::string cached_name;
       Response cached;
@@ -990,7 +1091,13 @@ class Engine {
     // locally-set flag must first ride a tick so every rank closes on the
     // same cycle (otherwise this rank would drop out of the token chain
     // while peers still expect its hops).
-    if (reply.shutdown) fail_all_and_close(kShutdownMsg);
+    if (reply.shutdown) {
+      // Final-cycle collectives still complete successfully (serial-engine
+      // parity): drain the wire queue while the sockets are healthy —
+      // every rank holds the same queue, so the drain is symmetric.
+      if (pipeline_) reap_wire(/*wait_all=*/true);
+      fail_all_and_close(kShutdownMsg);
+    }
   }
 
   // Fail every pending op and close — in ONE critical section, so an
@@ -998,6 +1105,12 @@ class Engine {
   // observes closed_ and returns the shutdown error; no handle can slip
   // into the table after the sweep and hang its waiter.
   void fail_all_and_close(const std::string& msg) {
+    // Stop the wire thread FIRST: queued WireJobs hold Entry pointers into
+    // table_, which the sweep below clears. On the clean path the queue was
+    // already drained (process_reply); on error paths the sockets are
+    // closed so in-flight ring calls fail promptly instead of hanging on a
+    // dead peer. The failed jobs' handles are swept below like any other.
+    teardown_wire_thread();
     {
       std::lock_guard<std::mutex> g(mu_);
       for (auto& kv : table_) {
@@ -1019,6 +1132,12 @@ class Engine {
 
   void execute(const Response& response, bool cache_put, long long seq,
                double reply_at) {
+    // Only the allreduce path is pipelined. Everything else (allgather,
+    // broadcast, errors) runs serially on this thread and — because it
+    // touches the shared ring sockets — must wait for every in-flight
+    // wire job first, preserving the serial engine's execution order.
+    if (pipeline_ && response.response_type != RESP_ALLREDUCE)
+      reap_wire(/*wait_all=*/true);
     if (response.response_type == RESP_ERROR) {
       std::vector<long long> hs;
       {
@@ -1065,6 +1184,15 @@ class Engine {
       }
     }
     if (timeline_) timeline_->start(tname, op_name(response.response_type));
+
+    if (pipeline_ && response.response_type == RESP_ALLREDUCE) {
+      // Double-buffered path: pack into a free fusion slot and hand the
+      // ring call to the wire thread; copy-out, EF residual slices, cache
+      // insert and handle completion happen at reap — in FIFO submit
+      // order, so results and completion order match the serial engine.
+      submit_allreduce(entries, response, cache_put, seq, tname);
+      return;
+    }
 
     long long nbytes = 0;
     if (response.response_type == RESP_ALLREDUCE)
@@ -1447,6 +1575,324 @@ class Engine {
     exec_hist_.observe(seconds);
   }
 
+  // ------------------------------------------- pipelined data plane (r16)
+  //
+  // Double-buffered fusion: the engine thread packs fused group N+1 into
+  // one FusionSlot and copies group N-1 out of the other while the wire
+  // thread keeps group N's ring call moving — the r10 CompressCursor
+  // send-ahead pattern lifted one level up, from chunks within a
+  // collective to whole fused groups within a cycle. Jobs flow through a
+  // strict FIFO (reply order, identical on every rank): the wire thread
+  // runs them front-to-back and the engine thread reaps them
+  // front-to-back, so ring-call pairing, results, completion order and
+  // the EF residual stream are bit-for-bit the serial engine's. The wire
+  // thread's residual writes are scoped to its ONE in-flight group; the
+  // engine thread slices them out per entry only after the job is done.
+
+  struct FusionSlot {
+    std::vector<uint8_t> buf;
+    std::vector<float> residual;  // fused EF staging for this slot
+    bool busy = false;            // guarded by wire_mu_
+  };
+
+  struct WireJob {
+    int slot = -1;  // fusion slot index; -1 = in-place single entry
+    std::vector<Entry*> entries;
+    Response response;  // for cache insertion at reap
+    bool cache_put = false;
+    long long seq = 0;
+    std::string tname;
+    uint8_t dtype = 0;
+    size_t total_bytes = 0;
+    void* wire_buf = nullptr;   // slot buffer or the entry's user buffer
+    float* residual = nullptr;  // slot scratch or the entry's residual
+    double t_exec = 0, t_done = 0;  // wire window (wire thread)
+    bool started = false, done = false;  // guarded by wire_mu_
+    std::string error;  // non-empty: the ring call failed
+  };
+
+  void wire_loop() {
+    std::unique_lock<std::mutex> lk(wire_mu_);
+    for (;;) {
+      WireJob* job = nullptr;
+      wire_cv_.wait(lk, [&] {
+        for (auto& j : wire_queue_)
+          if (!j->started) return true;
+        return wire_stop_;
+      });
+      for (auto& j : wire_queue_)
+        if (!j->started) {
+          job = j.get();
+          break;
+        }
+      if (!job) return;  // stop requested and nothing left to run
+      job->started = true;
+      lk.unlock();
+      double t_exec = mono_s();
+      try {
+        run_wire_job(job);
+      } catch (const std::exception& exc) {
+        job->error = exc.what();
+      }
+      double t_done = mono_s();
+      job->t_exec = t_exec;
+      job->t_done = t_done;
+      stamp_span(PH_EXECUTE, t_exec, t_done, job->seq, 0,
+                 job->tname.c_str());
+      lk.lock();
+      job->done = true;
+      wire_done_cv_.notify_all();
+    }
+  }
+
+  // The ring call — the ONLY work the wire thread does. Residual writes
+  // target this job's buffers exclusively (the in-flight group), so error
+  // feedback telescopes exactly as on the serial path.
+  void run_wire_job(WireJob* job) {
+    if (test_delay_us_ > 0)
+      std::this_thread::sleep_for(
+          std::chrono::microseconds(test_delay_us_));
+    long count = (long)(job->total_bytes / dtype_size(job->dtype));
+    if (size_ > 1) {
+      if (hvd_ring_allreduce_wire(job->wire_buf, count, job->dtype, 0,
+                                  wire_dtype_, job->residual) != 0)
+        throw EngineError(std::string("ring allreduce failed: ") +
+                          hvd_ring_last_error());
+    } else if (job->residual) {
+      std::memset(job->residual, 0, (size_t)count * sizeof(float));
+    }
+  }
+
+  // Engine thread: pack the group and hand it to the wire thread.
+  void submit_allreduce(std::vector<Entry*>& entries,
+                        const Response& response, bool cache_put,
+                        long long seq, const std::string& tname) {
+    uint8_t dtype = entries[0]->request.dtype;
+    size_t esz = dtype_size(dtype);
+    size_t total_bytes = 0;
+    for (Entry* e : entries) total_bytes += e->nbytes;
+    double t_fuse = mono_s();
+
+    auto job = std::make_unique<WireJob>();
+    job->entries = entries;
+    job->response = response;
+    job->cache_put = cache_put;
+    job->seq = seq;
+    job->tname = tname;
+    job->dtype = dtype;
+    job->total_bytes = total_bytes;
+
+    if (entries.size() == 1) {
+      // Unfused: in place on the caller's pinned buffer, no slot burned.
+      // The wire thread owns the entry's residual until reap.
+      job->wire_buf = entries[0]->user;
+      job->residual = size_ > 1 ? entries[0]->residual : nullptr;
+    } else {
+      int si = acquire_slot();
+      FusionSlot& slot = slots_[si];
+      if (slot.buf.capacity() < total_bytes) {
+        if (timeline_)
+          timeline_->activity_start(tname, "INIT_FUSION_BUFFER");
+        slot.buf.reserve(std::max(
+            total_bytes, (size_t)std::min<long long>(fusion_threshold_,
+                                                     64ll << 20)));
+        if (timeline_) timeline_->activity_end(tname);
+      }
+      slot.buf.resize(total_bytes);
+      fusion_fill_.store((long long)total_bytes, std::memory_order_relaxed);
+      fusion_cap_.store((long long)slot.buf.capacity(),
+                        std::memory_order_relaxed);
+      if (timeline_)
+        timeline_->activity_start(tname, "MEMCPY_IN_FUSION_BUFFER");
+      size_t off = 0;
+      for (Entry* e : entries) {
+        std::memcpy(slot.buf.data() + off, e->user, e->nbytes);
+        off += e->nbytes;
+      }
+      if (timeline_) timeline_->activity_end(tname);
+      bool any_residual = false;
+      for (Entry* e : entries) any_residual = any_residual || e->residual;
+      job->wire_buf = slot.buf.data();
+      if (any_residual && dtype == 0 /* DT_F32 */) {
+        slot.residual.resize(total_bytes / esz);
+        job->residual = slot.residual.data();
+      }
+      job->slot = si;
+    }
+    stamp_span(PH_FUSE, t_fuse, mono_s(), seq, (int)entries.size(),
+               tname.c_str());
+    {
+      std::lock_guard<std::mutex> g(wire_mu_);
+      wire_queue_.push_back(std::move(job));
+      long long depth = (long long)wire_queue_.size();
+      if (depth > pipeline_depth_.load(std::memory_order_relaxed))
+        pipeline_depth_.store(depth, std::memory_order_relaxed);
+    }
+    wire_cv_.notify_one();
+  }
+
+  // Free fusion slot, reaping opportunistically: with two slots at most
+  // two fused groups are outstanding — N on the wire while N+1 packs,
+  // because N-1 gets copied out right here.
+  int acquire_slot() {
+    for (;;) {
+      reap_wire(/*wait_all=*/false);
+      {
+        std::lock_guard<std::mutex> g(wire_mu_);
+        for (int i = 0; i < 2; i++)
+          if (!slots_[i].busy) {
+            slots_[i].busy = true;
+            return i;
+          }
+      }
+      // Both slots in flight: block until the oldest job lands (counted
+      // as a pipeline stall inside reap_wire's wait).
+      reap_wire_front();
+    }
+  }
+
+  // Reap completed jobs oldest-first. wait_all=true drains the whole
+  // queue — required before ANY control-frame I/O, because the wire
+  // thread shares the ring sockets. Engine-thread time spent blocked in
+  // the wait (beyond `stall_after`, used by rank 0 to exclude its pacing
+  // window) is charged to CTR_PIPELINE_STALL_US.
+  void reap_wire(bool wait_all, double stall_after = 0.0) {
+    for (;;) {
+      std::unique_ptr<WireJob> job;
+      {
+        std::unique_lock<std::mutex> lk(wire_mu_);
+        if (wire_queue_.empty()) return;
+        if (!wire_queue_.front()->done) {
+          if (!wait_all) return;
+          double t0 = mono_s();
+          wire_done_cv_.wait(
+              lk, [&] { return wire_queue_.front()->done; });
+          double stalled = mono_s() - std::max(t0, stall_after);
+          if (stalled > 0)
+            pipeline_stall_us_.fetch_add((long long)(stalled * 1e6),
+                                         std::memory_order_relaxed);
+        }
+        job = std::move(wire_queue_.front());
+        wire_queue_.pop_front();
+      }
+      finish_job(*job);
+    }
+  }
+
+  // Block until the oldest in-flight job completes and reap it.
+  void reap_wire_front() {
+    std::unique_ptr<WireJob> job;
+    {
+      std::unique_lock<std::mutex> lk(wire_mu_);
+      if (wire_queue_.empty()) return;
+      if (!wire_queue_.front()->done) {
+        double t0 = mono_s();
+        wire_done_cv_.wait(lk,
+                           [&] { return wire_queue_.front()->done; });
+        pipeline_stall_us_.fetch_add(
+            (long long)((mono_s() - t0) * 1e6),
+            std::memory_order_relaxed);
+      }
+      job = std::move(wire_queue_.front());
+      wire_queue_.pop_front();
+    }
+    finish_job(*job);
+  }
+
+  // Reap one job on the engine thread: copy-out + per-entry EF residual
+  // slices, cache insert, handle completion, accounting and spans —
+  // everything the serial execute_allreduce tail does, in the same order.
+  void finish_job(WireJob& job) {
+    if (!job.error.empty()) {
+      release_slot(job.slot);
+      throw EngineError(job.error);
+    }
+    size_t esz = dtype_size(job.dtype);
+    if (job.slot >= 0) {
+      FusionSlot& slot = slots_[job.slot];
+      if (timeline_)
+        timeline_->activity_start(job.tname, "MEMCPY_OUT_FUSION_BUFFER");
+      size_t off = 0;
+      for (Entry* e : job.entries) {
+        std::memcpy(e->user, slot.buf.data() + off, e->nbytes);
+        if (e->residual) {
+          // Both outcomes fully write the entry's residual: the wire
+          // thread's fused scratch slice, or zeros (size-1 / non-f32).
+          if (job.residual && size_ > 1)
+            std::memcpy(e->residual, job.residual + off / esz,
+                        (e->nbytes / esz) * sizeof(float));
+          else
+            std::memset(e->residual, 0,
+                        (e->nbytes / esz) * sizeof(float));
+        }
+        off += e->nbytes;
+      }
+      if (timeline_) timeline_->activity_end(job.tname);
+      release_slot(job.slot);
+    } else if (job.entries.size() == 1 && size_ == 1 &&
+               job.entries[0]->residual) {
+      std::memset(job.entries[0]->residual, 0,
+                  (job.entries[0]->nbytes / esz) * sizeof(float));
+    }
+    processed_bytes_ += (long long)job.total_bytes;
+    tensors_total_.fetch_add((long long)job.entries.size(),
+                             std::memory_order_relaxed);
+    if (job.entries.size() > 1)
+      fused_tensors_.fetch_add((long long)job.entries.size(),
+                               std::memory_order_relaxed);
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      for (Entry* e : job.entries) {
+        if (job.cache_put) {
+          Response single;
+          single.response_type = job.response.response_type;
+          single.tensor_names.push_back(e->request.tensor_name);
+          single.tensor_sizes = job.response.tensor_sizes;
+          cache_.put(e->request, single);
+        }
+        auto it = handles_.find(e->handle);
+        if (it != handles_.end()) {
+          it->second.status = 1;
+          it->second.dtype = e->request.dtype;
+          it->second.shape = e->request.shape;
+          it->second.in_place = true;
+        }
+        table_.erase(e->request.tensor_name);
+      }
+    }
+    observe_exec(job.t_done - job.t_exec);
+    stamp_span(PH_DONE, job.t_done, mono_s(), job.seq, 0,
+               job.tname.c_str());
+    if (timeline_) timeline_->end(job.tname);
+    handle_cv_.notify_all();
+  }
+
+  void release_slot(int si) {
+    if (si < 0) return;
+    std::lock_guard<std::mutex> g(wire_mu_);
+    slots_[si].busy = false;
+  }
+
+  // Stop + join the wire thread (idempotent). Queued-but-unstarted jobs
+  // still run — on the error path the sockets are closed first so they
+  // fail fast instead of hanging on a dead peer; their entries are left
+  // for the caller's table sweep.
+  void teardown_wire_thread() {
+    if (!wire_thread_.joinable()) return;
+    bool inflight;
+    {
+      std::lock_guard<std::mutex> g(wire_mu_);
+      wire_stop_ = true;
+      inflight = !wire_queue_.empty();
+    }
+    wire_cv_.notify_all();
+    if (inflight && size_ > 1) hvd_ring_shutdown();  // idempotent
+    wire_thread_.join();
+    std::lock_guard<std::mutex> g(wire_mu_);
+    wire_queue_.clear();
+    slots_[0].busy = slots_[1].busy = false;
+  }
+
   // ------------------------------------------------------------ members
 
   int rank_, size_;
@@ -1505,6 +1951,24 @@ class Engine {
   std::atomic<long long> bucket_push_{0}, bucket_synced_{0};
   long long next_seq_ = 0;  // coordinator-only: next collective seq id
 
+  // Pipelined data plane (r16). wire_mu_ guards wire_queue_ /
+  // wire_stop_ / the slots' busy flags; it is never held across mu_ or
+  // tele_mu_ (the static lock graph stays acyclic). Only the engine
+  // thread pushes/pops the queue; the wire thread just flips
+  // started/done on the front-most unstarted job.
+  bool pipeline_ = false;
+  long long test_delay_us_ = 0;  // HOROVOD_PIPELINE_TEST_DELAY_US hook
+  std::mutex wire_mu_;
+  std::condition_variable wire_cv_;       // wakes the wire thread
+  std::condition_variable wire_done_cv_;  // wakes the engine thread
+  std::deque<std::unique_ptr<WireJob>> wire_queue_;
+  bool wire_stop_ = false;
+  FusionSlot slots_[2];
+  std::atomic<long long> pipeline_depth_{0};     // high-water outstanding
+  std::atomic<long long> pipeline_stall_us_{0};  // engine blocked on wire
+  std::atomic<long long> priority_jumps_{0};     // reordered cycles
+  std::thread wire_thread_;
+
   std::thread thread_;
 };
 
@@ -1536,7 +2000,7 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
                  int stall_disable, double stall_warn_s,
                  double stall_shutdown_s, const char* timeline_path,
                  int timeline_mark_cycles, int wire_dtype,
-                 int wire_dtype_local, int wire_dtype_cross) {
+                 int wire_dtype_local, int wire_dtype_cross, int pipeline) {
   std::lock_guard<std::mutex> g(hvd::g_engine_mu);
   if (hvd::g_engine && !hvd::g_engine->finished()) {
     hvd::g_last_error = "engine already initialized";
@@ -1666,20 +2130,20 @@ int hvd_eng_init(int rank, int size, const char* ring_addrs,
       rank, size, cycle_ms, fusion_threshold, cache_capacity,
       stall_disable != 0, stall_warn_s, stall_shutdown_s,
       timeline_path ? timeline_path : "", timeline_mark_cycles != 0,
-      wire_dtype);
+      wire_dtype, pipeline != 0);
   return 0;
 }
 
 long long hvd_eng_enqueue(int op, const char* name, void* data,
                           const long long* shape, int ndim, int dtype,
-                          int root_rank, void* residual) {
+                          int root_rank, void* residual, int priority) {
   if (!hvd::g_engine) {
     hvd::g_last_error = "engine not initialized";
     return -1;
   }
   return hvd::g_engine->enqueue((uint8_t)op, name, data,
                                 (const int64_t*)shape, ndim, (uint8_t)dtype,
-                                root_rank, residual);
+                                root_rank, residual, (int32_t)priority);
 }
 
 int hvd_eng_poll(long long h) {

@@ -45,6 +45,12 @@ struct Request {  // reference message.h:40-120
   uint8_t request_type = REQ_ALLREDUCE;
   uint8_t dtype = 0;  // ring.cc DType code
   int32_t root_rank = -1;
+  // Launch priority (0 = none). The coordinator stable-sorts each cycle's
+  // fused responses by the tagged priority so the optimizer-critical
+  // bucket jumps the launch queue on EVERY rank identically. Like dtype,
+  // the value must agree across ranks for a given tensor; it is NOT part
+  // of same_params — a priority mismatch reorders, it doesn't error.
+  int32_t priority = 0;
   std::vector<int64_t> shape;
   std::string tensor_name;
 
@@ -166,6 +172,7 @@ inline void write_request(Writer& w, const Request& r) {
   w.u8(r.request_type);
   w.u8(r.dtype);
   w.i32(r.root_rank);
+  w.i32(r.priority);
   w.i64vec(r.shape);
   w.str(r.tensor_name);
 }
@@ -176,6 +183,7 @@ inline Request read_request(Reader& r) {
   q.request_type = r.u8();
   q.dtype = r.u8();
   q.root_rank = r.i32();
+  q.priority = r.i32();
   q.shape = r.i64vec();
   q.tensor_name = r.str();
   return q;

@@ -290,6 +290,16 @@ def _native_metrics():
                 "hvd_native_bucket_bytes",
                 "Autotuned gradient-bucket size synced over the native "
                 "cycle reply (0 = none pushed yet)"),
+            pipeline_depth=gauge(
+                "hvd_native_pipeline_depth",
+                "High-water count of fused groups simultaneously in "
+                "flight through the engine's double-buffered data plane "
+                "(1 = no overlap, 2 = pack/wire/copy-out pipelined)"),
+            pipeline_stall=counter(
+                "hvd_native_pipeline_stall_seconds",
+                "Cumulative time the engine thread spent blocked on the "
+                "wire thread (slot-acquire and reap stalls; docs/"
+                "overlap.md splits this against negotiation)"),
             cycle_seconds=histogram(
                 "hvd_native_cycle_seconds",
                 "Native engine cycle duration (token round + data "
@@ -347,6 +357,27 @@ def refresh_native_engine_metrics() -> None:
         m.fusion_capacity.set(c["fusion_capacity"])
         m.fusion_fill.set(c["fusion_fill"])
         m.bucket.set(c["bucket_bytes"])
+        m.pipeline_depth.set(c["pipeline_depth"])
+        # C side counts stall time in integer microseconds (atomics);
+        # mirror as seconds to match the registry's time-unit convention.
+        # Baselines live under the raw scalar keys so reset_for_tests's
+        # NATIVE_COUNTER_SCALARS sweep re-baselines these too.
+        stall_us = float(c["pipeline_stall_us"])
+        prev_stall = _native_seen.get("pipeline_stall_us", 0.0)
+        if stall_us > prev_stall:
+            m.pipeline_stall.inc((stall_us - prev_stall) / 1e6)
+            _native_seen["pipeline_stall_us"] = stall_us
+        # hvd_overlap_priority_jumps_total is owned by the bucket
+        # scheduler (one-metric-owner rule); the native coordinator's
+        # jump count rides the same series via the owner's accessor so
+        # python-controller jumps and C-coordinator jumps read as one.
+        jumps = float(c["priority_jumps"])
+        prev_jumps = _native_seen.get("priority_jumps", 0.0)
+        if jumps > prev_jumps:
+            from ..controller.bucket_scheduler import _overlap_metrics
+
+            _overlap_metrics().priority_jumps.inc(jumps - prev_jumps)
+            _native_seen["priority_jumps"] = jumps
 
         def _hist(hist, key):
             cur = c[key]

@@ -59,7 +59,7 @@ struct CoreApi {
   // core's 8-arg ABI or the callee reads a garbage residual off the
   // stack.
   long long (*enqueue)(int, const char*, void*, const long long*, int, int,
-                       int, void*) = nullptr;
+                       int, void*, int) = nullptr;
   int (*wait)(long long) = nullptr;
   int (*result_ndim)(long long) = nullptr;
   void (*result_shape)(long long, long long*) = nullptr;
@@ -224,7 +224,7 @@ long long EnqueueOrFail(OpKernelContext* ctx,
   std::vector<long long> dims(std::max(ndim, 1), 0);
   for (int i = 0; i < ndim; i++) dims[i] = shaped_like.dim_size(i);
   long long h = api->enqueue(op, name.c_str(), data, dims.data(), ndim, code,
-                             root_rank, nullptr);
+                             root_rank, nullptr, /*priority=*/0);
   if (h == -2) {
     ctx->SetStatus(InvalidArgument(
         "Duplicate tensor name '", name,

@@ -374,6 +374,58 @@ def control_plane_from_artifact(data: dict) -> ControlPlaneCalibration:
         measured, source=data.get("substrate", "artifact"))
 
 
+def pipelined_modeled_events(event_dicts: Sequence[dict],
+                             window_s: float) -> List[BucketEvent]:
+    """Pipelined-engine analogue of :func:`modeled_events_from_measured`
+    (round 16, docs/overlap.md): with the double-buffered wire thread,
+    a bucket's launch is no longer serialized behind the previous
+    bucket's copy-out — the model assumes bucket *i* of *nb* enters the
+    engine as its members are produced (uniformly across the backward
+    window) and drains one median post-ready tail later, concurrent
+    with its successors' packing. Takes the measured report's event
+    dicts (``launch_s``/``ready_s``/``complete_s`` offsets — ``ready_s``
+    is when the bucket's last member was produced) so the tail excludes
+    the bucket's own production time."""
+    if not event_dicts or window_s <= 0:
+        return []
+    nb = len(event_dicts)
+    tails = sorted(
+        max(0.0, e["complete_s"] - e.get("ready_s", e["launch_s"]))
+        for e in event_dicts)
+    t_tail = tails[nb // 2]
+    return [BucketEvent(window_s * i / nb, window_s * (i + 1) / nb + t_tail)
+            for i in range(nb)]
+
+
+def stall_split_report(event_dicts: Sequence[dict],
+                       calibration: ControlPlaneCalibration,
+                       n: int) -> dict:
+    """Split each bucket's post-ready stall (``complete_s - ready_s`` —
+    time the finished gradients sat waiting on comms) into negotiation
+    vs wire using the calibrated control-plane model (round 13,
+    ``artifacts/simcluster_r13.json``): up to one calibrated negotiation
+    round per bucket is control-plane cost, the remainder is wire
+    occupancy. JSON-ready — the overlap probe embeds this so the
+    remaining gap names its owner (docs/overlap.md reading guide)."""
+    neg_budget = max(0.0, calibration.negotiation_seconds(n))
+    neg_total = 0.0
+    wire_total = 0.0
+    for e in event_dicts:
+        stall = max(0.0, e["complete_s"] - e.get("ready_s", e["launch_s"]))
+        neg = min(stall, neg_budget)
+        neg_total += neg
+        wire_total += stall - neg
+    total = neg_total + wire_total
+    return {
+        "buckets": len(event_dicts),
+        "negotiation_stall_s": round(neg_total, 6),
+        "wire_stall_s": round(wire_total, 6),
+        "negotiation_frac": (round(neg_total / total, 4) if total else 0.0),
+        "negotiation_budget_per_bucket_s": round(neg_budget, 6),
+        "calibration_source": calibration.source,
+    }
+
+
 def measured_overlap_report(events: Sequence[BucketEvent],
                             compute_start_s: float,
                             compute_end_s: float) -> dict:

@@ -103,6 +103,7 @@ def test_known_series_present():
         "hvd_ring_chunk_bytes",
         "hvd_overlap_buckets_total",
         "hvd_overlap_efficiency",
+        "hvd_overlap_priority_jumps_total",
         "hvd_autotune_active",
         "hvd_autotune_steps_completed",
         "hvd_autotune_steps_remaining",
@@ -148,6 +149,8 @@ def test_known_series_present():
         "hvd_native_fusion_buffer_capacity_bytes",
         "hvd_native_fusion_buffer_fill_bytes",
         "hvd_native_bucket_bytes",
+        "hvd_native_pipeline_depth",
+        "hvd_native_pipeline_stall_seconds",
         "hvd_native_cycle_seconds",
         "hvd_native_execute_seconds",
     ):

@@ -115,7 +115,7 @@ def _fresh_engine(cycle_ms=2.0):
     lib.hvd_eng_shutdown()  # turn any previous test's engine into a husk
     key = (ctypes.c_uint8 * 4)(1, 2, 3, 4)
     rc = lib.hvd_eng_init(0, 1, b"", key, 4, float(cycle_ms), 1 << 20, 256,
-                          0, 60.0, 0.0, b"", 0, 0, 0, 0)
+                          0, 60.0, 0.0, b"", 0, 0, 0, 0, 1)
     assert rc == 0, lib.hvd_eng_last_error()
     return lib
 
@@ -126,7 +126,7 @@ def _run_ops(lib, n, count=64, prefix="op"):
         shape = (ctypes.c_longlong * 1)(count)
         h = lib.hvd_eng_enqueue(
             0, f"{prefix}.{i}".encode(),
-            a.ctypes.data_as(ctypes.c_void_p), shape, 1, 0, -1, None)
+            a.ctypes.data_as(ctypes.c_void_p), shape, 1, 0, -1, None, 0)
         assert h >= 0, h
         assert lib.hvd_eng_wait(h) == 0
         lib.hvd_eng_release(h)
@@ -240,7 +240,7 @@ def test_counters_zero_without_engine_and_slot_pin():
     lib = bindings.load()
     arr = (ctypes.c_longlong * bindings.N_NATIVE_COUNTER_SLOTS)()
     n = lib.hvd_eng_get_counters(arr, bindings.N_NATIVE_COUNTER_SLOTS)
-    assert n == bindings.N_NATIVE_COUNTER_SLOTS == 62
+    assert n == bindings.N_NATIVE_COUNTER_SLOTS == 65
 
 
 # ---------------------------------------------------------------------------

@@ -27,7 +27,7 @@ def engine():
     secret = b"\x01" * 32
     key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
     rc = lib.hvd_eng_init(0, 1, b"", key, len(secret), 1.0, 1 << 20, 64,
-                          1, 60.0, -1.0, b"", 0, 0, 0, 0)
+                          1, 60.0, -1.0, b"", 0, 0, 0, 0, 1)
     assert rc == 0, lib.hvd_eng_last_error().decode()
     yield lib
     lib.hvd_eng_shutdown()
@@ -135,5 +135,5 @@ def test_enqueue_after_shutdown_raises_cleanly(engine):
         secret = b"\x01" * 32
         key = (ctypes.c_uint8 * len(secret)).from_buffer_copy(secret)
         rc = engine.hvd_eng_init(0, 1, b"", key, len(secret), 1.0, 1 << 20,
-                                 64, 1, 60.0, -1.0, b"", 0, 0, 0, 0)
+                                 64, 1, 60.0, -1.0, b"", 0, 0, 0, 0, 1)
         assert rc == 0, engine.hvd_eng_last_error().decode()

@@ -345,13 +345,18 @@ def test_build_freshness_and_abi_matches_bindings():
             f"{func}: bindings.py declares {declared} args, native source "
             f"defines {in_source} — the ctypes ABI drifted")
     # The wire-dtype args specifically: hvd_eng_init grew to 14 args in
-    # round 10 and to 16 in round 12 (hierarchical local/cross wire
-    # dtypes); enqueue grew to 8 in round 10. Round 14 added telemetry
-    # as NEW entry points, so both stay pinned.
-    assert len(lib.hvd_eng_init.argtypes) == 16
-    assert len(lib.hvd_eng_enqueue.argtypes) == 8
+    # round 10, to 16 in round 12 (hierarchical local/cross wire dtypes)
+    # and to 17 in round 16 (trailing pipeline-enable flag); enqueue grew
+    # to 8 in round 10 and to 9 in round 16 (trailing launch priority).
+    # Round 14 added telemetry as NEW entry points, so both stay pinned.
+    assert len(lib.hvd_eng_init.argtypes) == 17
+    assert len(lib.hvd_eng_enqueue.argtypes) == 9
     # Telemetry counter-slot layout: the C side's slot count must match
     # the bindings' mirror (engine.cc CounterSlot <-> NATIVE_COUNTER_*).
+    # Round 16 grew the block by three scalars (pipeline depth/stall,
+    # priority jumps) — 65 slots; re-pinned on BOTH sides so a one-sided
+    # edit fails here, not as silently shifted histogram bins.
+    assert bindings.N_NATIVE_COUNTER_SLOTS == 65
     import ctypes as _ct
 
     arr = (_ct.c_longlong * bindings.N_NATIVE_COUNTER_SLOTS)()
