@@ -304,6 +304,17 @@ FULL_ROWS = {
         "script": "examples/simcluster_probe.py",
         "args": ["--out", "artifacts/simcluster_r13.json"],
         "json": True},
+    # Capacity-planner calibration row (round 17): the r13 curves
+    # re-measured up to 512 logical ranks on the threaded sim driver
+    # (protocheck armed at every size, median-of-repeats rows,
+    # rel-err-weighted fit), with the planner's forward plan at 4096
+    # ranks embedded. The summary's max_rel_err_by_size is the gate:
+    # ≤0.10 at every recorded size for the negotiation curve the
+    # planner extrapolates from. Refreshes artifacts/capacity_r17.json.
+    "capacity_plan_vs_measured": {
+        "script": "examples/capacity_probe.py",
+        "args": ["--out", "artifacts/capacity_r17.json"],
+        "json": True},
     # Elastic-restore flatness row (round 15): State.restore() on a real
     # 3-rank elastic job at two model sizes 4x apart, p2p (digest-matched
     # survivors move zero bytes; jax pytrees also copy zero bytes) vs the

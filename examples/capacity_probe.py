@@ -1,0 +1,146 @@
+"""Capacity-planner calibration probe (round 17, docs/capacity.md).
+
+Re-measures the r13 control-plane curves with the threaded sim driver
+in the loop: the serial sizes (8–64 logical ranks) plus threaded-driver
+sizes (default 128/256/512 ranks across 8 named shard threads,
+wire-conformance monitor armed — the summed zero-violation verdict is
+recorded in each threaded row). Every size is measured ``--repeats``
+times in round-robin order and the committed row is the median across
+repeats: this substrate's machine speed swings tens of percent over
+minutes, and interleaving spreads that drift over every size instead
+of whichever one was measured at the wrong moment. The fitted
+calibration (rel-err-weighted — the gate is a relative bound at every
+size), per-size model residuals, and the planner's own forward plan at
+``--plan-ranks`` are written to ``--out``
+(``artifacts/capacity_r17.json``), which then serves as the preferred
+calibration source for ``python -m horovod_tpu.tools.capacity`` and
+the ``capacity_headroom`` doctor rule.
+
+Substrate honesty: loopback TCP, one shared GIL — these calibrate the
+coordinator's per-rank walk costs (recv + HMAC + dispatch per wire),
+not NIC latency; the record says so.
+
+Usage::
+
+    python examples/capacity_probe.py --out artifacts/capacity_r17.json
+    python examples/capacity_probe.py --sizes 8,16 --threaded-sizes '' \\
+        --cycles 10 --repeats 2  # quick, serial only
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--sizes", default="8,16,32,64",
+                        help="comma-separated serial-driver world sizes")
+    parser.add_argument("--threaded-sizes", default="128,256,512",
+                        help="extra world sizes run on the threaded "
+                             "driver with protocheck armed ('' to skip)")
+    parser.add_argument("--driver-threads", type=int, default=8,
+                        help="shard threads for the threaded sizes")
+    parser.add_argument("--cycles", type=int, default=15,
+                        help="measured steps per world size per repeat")
+    parser.add_argument("--repeats", type=int, default=7,
+                        help="round-robin sweep repeats (each row is the "
+                             "median across repeats — drift insurance on "
+                             "a timeshared substrate)")
+    parser.add_argument("--plan-ranks", type=int, default=4096,
+                        help="world size for the embedded forward plan")
+    parser.add_argument("--model-bytes", type=int, default=1 << 30,
+                        help="model size for the plan's restore plane")
+    parser.add_argument("--out", default=None,
+                        help="write the full JSON record here")
+    args = parser.parse_args()
+
+    from horovod_tpu.sim.measure import measure_control_plane
+    from horovod_tpu.utils.scaling_model import capacity_plan
+
+    sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+    threads = {}
+    protocheck_sizes = []
+    for s in args.threaded_sizes.split(","):
+        if s.strip():
+            n = int(s)
+            sizes.append(n)
+            threads[n] = args.driver_threads
+            protocheck_sizes.append(n)
+
+    # Protocheck armed at EVERY size, not just the threaded ones: the
+    # conformance proof then covers the whole curve, and any per-frame
+    # monitor overhead is uniform across sizes instead of a systematic
+    # serial-vs-threaded bias in the fit.
+    record = measure_control_plane(
+        sizes, cycles=args.cycles, driver_threads=threads,
+        protocheck_sizes=sizes, repeats=args.repeats,
+        relative_fit=True)
+    record["substrate"] = (
+        "simcluster: in-process loopback TCP, multiplexed logical ranks, "
+        "shared GIL — calibrates coordinator per-rank walk costs, not "
+        "NIC latency (docs/simcluster.md)")
+
+    # The probe's own artifact is the planner's calibration input; embed
+    # the forward plan it implies so the record is self-describing.
+    def _load(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    restore = _load(os.path.join(here, "artifacts",
+                                 "elastic_restore_r15.json"))
+    overlap = _load(os.path.join(here, "artifacts", "overlap_r16.json"))
+    record["plan"] = capacity_plan(
+        ranks=args.plan_ranks, model_bytes=args.model_bytes,
+        control_plane_data=record, restore_data=restore,
+        overlap_data=overlap)
+
+    if args.out:
+        out_dir = os.path.dirname(args.out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    cal = record["calibration"]
+    rel_errs = {
+        str(n): max(row["rel_err"] for _, row in sorted(
+            record["model_vs_measured"][str(n)].items())
+            if row.get("rel_err") is not None)
+        for n in record["world_sizes"]}
+    threaded_rows = {
+        str(n): {"protocheck_violations":
+                 record["control_plane"][str(n)].get(
+                     "protocheck_violations"),
+                 "driver_threads":
+                 record["control_plane"][str(n)]["driver_threads"]}
+        for n in sorted(threads)}
+    bottleneck = record["plan"]["first_bottleneck"]
+    summary = {
+        "unit": "seconds",
+        "world_sizes": record["world_sizes"],
+        "negotiate_per_rank_us": round(
+            cal["negotiation_per_rank_s"] * 1e6, 2),
+        "max_rel_err_by_size": rel_errs,
+        "threaded": threaded_rows,
+        "first_bottleneck_at_plan_ranks": (
+            bottleneck["plane"] if bottleneck else None),
+        "artifact": args.out,
+    }
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -486,6 +486,31 @@ def elastic_restore_mode() -> str:
     return "broadcast" if val == "broadcast" else "p2p"
 
 
+def autotune_priors() -> str:
+    """``HOROVOD_AUTOTUNE_PRIORS``: where the GP autotuner's initial
+    bucket/chunk configuration comes from (docs/autotune.md, round 17).
+    ``capacity`` seeds the first probed configuration from the capacity
+    planner's calibrated recommendation for this world size
+    (``utils.scaling_model.recommend_autotune_seeds`` over the artifact
+    named by :func:`capacity_calibration_path`); anything else — the
+    default ``off`` — keeps the resolver defaults. Explicit env pins
+    (HOROVOD_BUCKET_BYTES / HOROVOD_RING_CHUNK_BYTES) always win over
+    the prior."""
+    val = (env_str("HOROVOD_AUTOTUNE_PRIORS") or "").strip().lower()
+    return "capacity" if val == "capacity" else "off"
+
+
+def capacity_calibration_path() -> Optional[str]:
+    """``HOROVOD_CAPACITY_CALIBRATION``: path to a control-plane
+    calibration artifact (the ``control_plane`` + ``model_vs_measured``
+    JSON shape the sim measurement rig writes). Arms the
+    ``capacity_headroom`` doctor rule and the ``capacity`` autotune
+    priors; unset (default) both stand down — a fleet without a
+    calibrated model has nothing honest to compare against."""
+    val = env_str("HOROVOD_CAPACITY_CALIBRATION")
+    return val.strip() if val and val.strip() else None
+
+
 def serving_max_batch() -> int:
     """``HOROVOD_SERVING_MAX_BATCH``: decode-batch slots in the serving
     engine — the most sequences one continuous-batching decode step

@@ -33,11 +33,46 @@ _FIXING_ENV = {
 }
 
 
+def _capacity_priors(world_size) -> "dict | None":
+    """Planner-predicted warm-start seeds (HOROVOD_AUTOTUNE_PRIORS=capacity,
+    docs/capacity.md): re-fit the calibration artifact named by
+    HOROVOD_CAPACITY_CALIBRATION and scale the default bucket/ring-chunk
+    knobs by the predicted negotiation-cost ratio at this world size.
+    None (no priors) whenever the mode is off, the artifact is missing or
+    unreadable, or it carries no measured points — the search then starts
+    from the resolved defaults exactly as before."""
+    from ..common.config import autotune_priors, capacity_calibration_path
+
+    if autotune_priors() != "capacity":
+        return None
+    path = capacity_calibration_path()
+    if not path:
+        return None
+    import json
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not data.get("control_plane"):
+        return None
+    from ..utils.scaling_model import (control_plane_from_artifact,
+                                       recommend_autotune_seeds)
+
+    try:
+        cal = control_plane_from_artifact(data)
+    except (KeyError, TypeError, ValueError):
+        return None
+    return recommend_autotune_seeds(cal, max(1, int(world_size or 1)))
+
+
 def make_parameter_manager(config: Config,
                            tune_hierarchical: bool = False,
                            tune_cache: bool = False,
                            tune_ring_chunk: bool = False,
-                           tune_bucket: bool = False) -> ParameterManager:
+                           tune_bucket: bool = False,
+                           world_size: int = 0) -> ParameterManager:
     fixed = {knob for knob, env in sorted(_FIXING_ENV.items())
              if env in os.environ}
     if not tune_hierarchical:
@@ -75,6 +110,15 @@ def make_parameter_manager(config: Config,
         bucket = resolved_bucket_bytes()
         if bucket_bytes_env() == 0:
             fixed.discard("bucket_bytes")
+    # Capacity priors re-seed only knobs that are actually searchable —
+    # an explicit env pin (membership in ``fixed``) always wins, exactly
+    # as it does against the resolved defaults.
+    priors = _capacity_priors(world_size)
+    if priors:
+        if tune_bucket and "bucket_bytes" not in fixed:
+            bucket = priors["bucket_bytes"]
+        if tune_ring_chunk and "ring_chunk" not in fixed:
+            ring_chunk = priors["ring_chunk_bytes"]
     return ParameterManager(
         fusion_threshold=config.fusion_threshold_bytes,
         cycle_time_ms=config.cycle_time_ms,

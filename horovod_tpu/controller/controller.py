@@ -117,8 +117,11 @@ _em = None
 
 
 def _elastic_metrics():
-    """Membership series (docs/elastic.md), registered lazily and only
-    once an elastic job actually exists — static jobs never expose them."""
+    """Membership series (docs/elastic.md), registered lazily. Every
+    metrics-enabled multi-rank job publishes the epoch/size gauges (the
+    size gauge is the capacity_headroom rule's abscissa, r17); the
+    transition/reshape/departure series still only move on elastic
+    jobs. Single-process jobs expose none of them."""
     global _em
     if _em is None:
         from types import SimpleNamespace
@@ -128,6 +131,11 @@ def _elastic_metrics():
                 "hvd_membership_epoch",
                 "Current membership epoch (1 at rendezvous; bumped by "
                 "every elastic reshape)."),
+            size=metrics.gauge(
+                "hvd_membership_size",
+                "Current world size as adopted by this rank — the live "
+                "abscissa the capacity_headroom doctor rule feeds into "
+                "the calibrated control-plane curves."),
             transitions=metrics.counter(
                 "hvd_membership_transitions_total",
                 "Elastic membership transitions, by direction.", ("kind",)),
@@ -376,7 +384,8 @@ class Controller:
             # its C++ reply token slot (docs/overlap.md).
             self._param_manager = make_parameter_manager(
                 config, tune_hierarchical=self._local_ring is not None,
-                tune_cache=True, tune_bucket=True)
+                tune_cache=True, tune_bucket=True,
+                world_size=topology.size)
             self._publish_tuner = publish_tuner_gauges
 
         addr = config_mod.controller_addr()
@@ -398,7 +407,9 @@ class Controller:
             if self._elastic:
                 self._service.start_join_listener()
                 if metrics.on():
-                    _elastic_metrics().epoch.set(self._epoch)
+                    em = _elastic_metrics()
+                    em.epoch.set(self._epoch)
+                    em.size.set(topology.size)
             self._service.start_heartbeats(config.heartbeat_interval_seconds)
         else:
             self._service = None
@@ -418,8 +429,18 @@ class Controller:
                     "rank %d of %d", assignment.epoch, assignment.rank,
                     assignment.size)
                 if metrics.on():
-                    _elastic_metrics().epoch.set(self._epoch)
+                    em = _elastic_metrics()
+                    em.epoch.set(self._epoch)
+                    em.size.set(assignment.size)
             self._client.start_heartbeats(config.heartbeat_interval_seconds)
+
+        if metrics.on():
+            # The size gauge is the capacity_headroom doctor rule's
+            # abscissa — publish it for every metrics-enabled job, not
+            # just elastic ones (reshapes keep it current from there).
+            em = _elastic_metrics()
+            em.epoch.set(self._epoch)
+            em.size.set(self.topo.size)
 
         # Cluster tracing (docs/tracing.md): per-rank clock-anchored span
         # writer, a coordinator-assigned sequence id per fused op carried
@@ -1447,6 +1468,7 @@ class Controller:
         if metrics.on():
             em = _elastic_metrics()
             em.epoch.set(res.epoch)
+            em.size.set(res.size)
             if res.lost:
                 em.transitions.labels("shrink").inc()
                 for rank in res.lost:
@@ -1470,7 +1492,9 @@ class Controller:
             "elastic: membership epoch %d: this process is now rank %d "
             "of %d", exc.epoch, exc.rank, exc.size)
         if metrics.on():
-            _elastic_metrics().epoch.set(exc.epoch)
+            em = _elastic_metrics()
+            em.epoch.set(exc.epoch)
+            em.size.set(exc.size)
             metrics.record_event("reshape", epoch=exc.epoch,
                                  rank=exc.rank, size=exc.size)
 

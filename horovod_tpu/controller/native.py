@@ -225,6 +225,16 @@ class NativeController:
             raise RuntimeError(
                 "native engine init failed: "
                 + lib.hvd_eng_last_error().decode(errors="replace"))
+        from .. import metrics
+        if metrics.on():
+            # The size gauge is the capacity_headroom doctor rule's
+            # abscissa; the native ring is fixed-membership, so one
+            # stamp at init covers the job's whole life.
+            from .controller import _elastic_metrics
+
+            em = _elastic_metrics()
+            em.epoch.set(1)
+            em.size.set(topology.size)
         # Error feedback is live when int8 rides whichever plane this
         # job's ALLREDUCES actually take: the hierarchical local/cross
         # hops when the two-level plane is up AND routing allreduces
@@ -302,7 +312,7 @@ class NativeController:
             # loop).
             self._param_manager = make_parameter_manager(
                 config, tune_ring_chunk=topology.size > 1,
-                tune_bucket=True)
+                tune_bucket=True, world_size=topology.size)
             self._tuner = threading.Thread(
                 target=self._tune_loop, name="hvd-native-autotune",
                 daemon=True)

@@ -43,6 +43,12 @@ class Evidence:
     postmortems: List[List[dict]] = dataclasses.field(default_factory=list)
     # HOROVOD_RESTART_EPOCH (live) / launcher_restart count (artifacts).
     restart_epoch: int = 0
+    # Control-plane calibration artifact (scaling_model shape: a
+    # "control_plane" dict of measured sizes) for the capacity_headroom
+    # rule. Live jobs opt in via HOROVOD_CAPACITY_CALIBRATION; offline
+    # runs pick up a committed capacity/simcluster artifact beside the
+    # traces when one exists.
+    capacity_calibration: Optional[dict] = None
     # "live" or "artifacts:<dir>" — recorded in the report for operators.
     source: str = "live"
 
@@ -51,14 +57,22 @@ class Evidence:
         """This process's registry + the piggybacked worker snapshots.
         On rank 0 that is the whole job; on a worker it is one rank."""
         from .. import metrics
-        from ..common.config import env_rank, restart_epoch
+        from ..common.config import (
+            capacity_calibration_path,
+            env_rank,
+            restart_epoch,
+        )
 
         local = env_rank() or 0
         snapshots = {local: metrics.snapshot()}
         for rank, snap in sorted(metrics.remote_snapshots().items()):
             snapshots.setdefault(int(rank), snap)
+        calibration = None
+        cal_path = capacity_calibration_path()
+        if cal_path:
+            calibration = _load_json(cal_path)
         return cls(snapshots=snapshots, restart_epoch=restart_epoch(),
-                   source="live")
+                   capacity_calibration=calibration, source="live")
 
     @classmethod
     def from_artifacts(cls, path: str) -> "Evidence":
@@ -104,8 +118,15 @@ class Evidence:
         restarts = sum(
             1 for events in postmortems for ev in events
             if ev.get("kind") == "launcher_restart")
+        calibration = None
+        for name in ("capacity_r17.json", "simcluster_r13.json"):
+            loaded = _load_json(os.path.join(path, name))
+            if loaded and loaded.get("control_plane"):
+                calibration = loaded
+                break
         return cls(straggler_report=report, clock=clock,
                    postmortems=postmortems, restart_epoch=restarts,
+                   capacity_calibration=calibration,
                    source=f"artifacts:{path}")
 
     def ranks_observed(self) -> List[int]:

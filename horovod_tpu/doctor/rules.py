@@ -62,6 +62,13 @@ ROUTER_FLAPPING_MIN = 2                # replica departures before warning
 ROUTER_FLAPPING_CRITICAL = 5
 PREFIX_CACHE_MIN_TRAFFIC = 200         # whole pages judged before verdict
 PREFIX_CACHE_COLLAPSE_RATE = 0.2
+# -- capacity headroom -------------------------------------------------------
+CAPACITY_HEADROOM_FACTOR = 2.0     # measured p99 vs the modeled curve
+CAPACITY_MIN_CYCLES = 20           # cycle observations before judging
+CAPACITY_MIN_RESHAPES = 3          # reshape observations before judging
+# Below this modeled cost the controller's cycle pacer, not the control
+# plane, sets the floor — small worlds would otherwise trip on pacing.
+CAPACITY_MODELED_FLOOR = 0.005     # seconds
 
 
 @dataclasses.dataclass
@@ -662,6 +669,75 @@ def check_router_replica_flapping(ev: Evidence) -> Iterator[Diagnosis]:
                                    if epoch is not None else None)})
 
 
+def check_capacity_headroom(ev: Evidence) -> Iterator[Diagnosis]:
+    """The job's live control-plane latencies have left the calibrated
+    capacity envelope: negotiation or reshape p99 for the CURRENT world
+    size runs ≥2x what the committed scaling curves predict
+    (docs/capacity.md). That gap means the planner's forward
+    extrapolations understate this job — re-plan before trusting a
+    scale-up. Needs a calibration artifact
+    (HOROVOD_CAPACITY_CALIBRATION live, or a capacity/simcluster
+    artifact beside the traces offline) and the ``hvd_membership_size``
+    abscissa."""
+    data = ev.capacity_calibration
+    if not data or not data.get("control_plane"):
+        return
+    world = _gauge(ev.snapshots, "hvd_membership_size")
+    if world is None or world < 1:
+        return
+    from ..utils.scaling_model import control_plane_from_artifact
+    try:
+        cal = control_plane_from_artifact(data)
+    except (KeyError, TypeError, ValueError):
+        return
+    world = int(world)
+    planes = (
+        ("negotiation", "hvd_controller_cycle_seconds",
+         CAPACITY_MIN_CYCLES, cal.negotiation_seconds(world)),
+        ("reshape", "hvd_elastic_reshape_seconds",
+         CAPACITY_MIN_RESHAPES, cal.reshape_seconds(world)),
+    )
+    for plane, series, min_samples, modeled in planes:
+        # The coordinator owns both series; take the worst qualifying
+        # rank in case a worker echoes a stale (smaller) copy.
+        worst: Optional[Tuple[float, int]] = None
+        for rank in sorted(ev.snapshots):
+            p99, count = _hist_quantile_and_count(
+                ev.snapshots[rank], series, 0.99)
+            if p99 is not None and count >= min_samples:
+                if worst is None or p99 > worst[0]:
+                    worst = (p99, count)
+        if worst is None:
+            continue
+        p99, count = worst
+        floor = max(modeled, CAPACITY_MODELED_FLOOR)
+        if p99 >= CAPACITY_HEADROOM_FACTOR * floor:
+            yield Diagnosis(
+                rule="capacity_headroom", severity="warning",
+                summary=(f"{plane} p99 {_ms(p99)} at world size {world} "
+                         f"vs modeled {_ms(modeled)} "
+                         f"({p99 / max(modeled, 1e-9):.1f}x the "
+                         "calibrated curve)"),
+                hint=(f"the {plane} plane runs "
+                      f"{p99 / max(modeled, 1e-9):.1f}x its calibrated "
+                      "cost for this world size, so capacity-planner "
+                      "extrapolations understate this job; find what "
+                      "changed since calibration (slower hosts, a "
+                      "straggler, congested control path — see the other "
+                      "findings), or re-run examples/capacity_probe.py "
+                      "on this substrate and point "
+                      "HOROVOD_CAPACITY_CALIBRATION at the fresh "
+                      "artifact"),
+                evidence={"plane": plane,
+                          "measured_p99_seconds": p99,
+                          "modeled_seconds": modeled,
+                          "world_size": world,
+                          "factor": round(p99 / max(modeled, 1e-9), 2),
+                          "samples": count,
+                          "calibration_source": data.get(
+                              "substrate", "artifact")})
+
+
 ALL_RULES = (
     check_persistent_straggler,
     check_clock_sync,
@@ -673,6 +749,7 @@ ALL_RULES = (
     check_autotune_search,
     check_serving_pressure,
     check_router_replica_flapping,
+    check_capacity_headroom,
 )
 
 # Every rule slug the catalog can emit — the hvd_doctor_findings gauge
@@ -690,6 +767,7 @@ RULE_SLUGS = (
     "serving_queue_saturation",
     "serving_block_exhaustion",
     "router_replica_flapping",
+    "capacity_headroom",
 )
 
 

@@ -23,20 +23,33 @@ Driving model — strict lockstep re-created by phases:
   step exactly as it tears real in-flight work: the driver acks the
   RESHAPE per worker, services joiner admissions, clears the reshape
   fence, and retries — the ``hvd.elastic.run`` loop, inlined.
+* ``driver_threads > 1`` lifts the single-thread multiplexing ceiling
+  for thousand-rank worlds: each lockstep *phase* (tick fanout, reply
+  fanout, each response's send half, then its recv half) is sharded
+  across a small named pool (``hvd-sim-shard-N``) with a barrier
+  between phases, so the global phase order — the thing the protocol
+  monitors check — is preserved while the O(ranks) per-phase walk
+  parallelizes. Any given wire is touched by exactly one thread at a
+  time (a rank stays on its shard for the whole phase), so per-wire
+  protocheck/HMAC state needs no extra locking.
 
 Environment: the harness owns the process env for its lifetime (the
 controller reads ``HOROVOD_*`` at init and during reshapes) and restores
-every key it touched at :meth:`stop`. The one deliberate fidelity
-carve-out is ``HOROVOD_CACHE_CAPACITY=0``: sim workers do not replicate
-the response-cache bitmask machinery, so the cache is pinned off and
-every cycle takes the full negotiation path — which is the very path
-this harness exists to measure (docs/simcluster.md lists all caveats).
+every key it touched at :meth:`stop`. Since r17 the response cache is
+ON by default (``cache_capacity``): sim workers replicate the bitmask
+machinery (``sim/worker.py``), so cache-on negotiation simulates
+faithfully; pass ``cache_capacity=0`` to force every cycle down the
+full negotiation path when that is the path being measured
+(``sim/measure.py`` uses unique tensor names instead, so its rows
+exercise full negotiation either way — docs/simcluster.md lists the
+remaining caveats).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+import queue
 import socket
 import threading
 import time
@@ -47,7 +60,8 @@ import numpy as np
 from .. import fault
 from .. import metrics
 from ..analysis import protocol
-from ..common.config import Config
+from ..analysis.lockorder import make_lock
+from ..common.config import DEFAULT_CACHE_CAPACITY, Config
 from ..common.topology import Topology
 from ..common.wire import RanksChangedError
 from ..controller.controller import Controller
@@ -71,6 +85,91 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+# Lazy per-module metric namespace (the package convention;
+# metrics.reset_for_tests drops it between clusters).
+_m = None
+
+
+def _sim_metrics():
+    global _m
+    if _m is None:
+        from types import SimpleNamespace
+
+        _m = SimpleNamespace(
+            logical_ranks=metrics.gauge(
+                "hvd_sim_logical_ranks",
+                "Logical world size this simcluster multiplexes"),
+            driver_threads=metrics.gauge(
+                "hvd_sim_driver_threads",
+                "Shard threads the lockstep driver fans phases across"))
+    return _m
+
+
+class _DriverPool:
+    """The shard pool behind ``driver_threads``: one task queue per named
+    worker thread plus a shared completion queue. :meth:`run_phase` is a
+    barrier — it returns (re-raising the first shard failure) only after
+    every shard finished, which is exactly the lockstep guarantee the
+    single-threaded driver gave for free. The pool is created and fed by
+    ONE driver thread, so the only shared mutable state is the closed
+    flag (guarded by a tracked lock, docs/locking.md)."""
+
+    def __init__(self, threads: int):
+        self.threads = threads
+        self._lock = make_lock("sim.driver_pool")
+        self._closed = False
+        self._tasks: List[queue.Queue] = [queue.Queue()
+                                          for _ in range(threads)]
+        self._done: queue.Queue = queue.Queue()
+        self._threads = []
+        for i in range(threads):
+            t = threading.Thread(target=self._run, args=(i,),
+                                 name=f"hvd-sim-shard-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _run(self, i: int) -> None:
+        while True:
+            fn = self._tasks[i].get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except BaseException as exc:  # relayed to the driver thread
+                self._done.put(exc)
+            else:
+                self._done.put(None)
+
+    def run_phase(self, fns: Sequence[Callable[[], None]]) -> None:
+        """Run one lockstep phase: every callable executes on its shard
+        thread; block until all completed (the phase barrier); re-raise
+        the first failure after the barrier so a dead logical rank
+        surfaces exactly like it does on the serial driver."""
+        with self._lock:
+            closed = self._closed
+        if closed:
+            raise RuntimeError("simcluster driver pool is closed")
+        for i, fn in enumerate(fns):
+            self._tasks[i % self.threads].put(fn)
+        first: Optional[BaseException] = None
+        for _ in fns:
+            exc = self._done.get()
+            if exc is not None and first is None:
+                first = exc
+        if first is not None:
+            raise first
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for q in self._tasks:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5.0)
 
 
 class SimStepTorn(RuntimeError):
@@ -115,7 +214,9 @@ class SimCluster:
                  protocheck: bool = True, enable_metrics: bool = True,
                  min_ranks: int = 1, max_ranks: int = 0,
                  comm_timeout: Optional[float] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 driver_threads: int = 1,
+                 cache_capacity: Optional[int] = None):
         if ranks < 2:
             raise ValueError("SimCluster needs >= 2 logical ranks")
         self.ranks = ranks
@@ -125,6 +226,9 @@ class SimCluster:
         self.min_ranks = min_ranks
         self.max_ranks = max_ranks
         self.comm_timeout = comm_timeout
+        self.driver_threads = max(1, int(driver_threads))
+        self.cache_capacity = (DEFAULT_CACHE_CAPACITY if cache_capacity
+                               is None else max(0, int(cache_capacity)))
         self.extra_env = dict(env or {})
         self.addr = f"127.0.0.1:{_free_port()}"
         self.controller: Optional[Controller] = None
@@ -136,6 +240,7 @@ class SimCluster:
         self._touched_env: set = set()
         self._env_snapshot: Dict[str, str] = {}
         self._connect_error: Optional[BaseException] = None
+        self._pool: Optional[_DriverPool] = None
         self._stopped = False
 
     # ------------------------------------------------------------ lifecycle
@@ -154,13 +259,21 @@ class SimCluster:
             protocol.recorder().clear()
         if self.enable_metrics:
             metrics.enable()
+        if self.driver_threads > 1:
+            self._pool = _DriverPool(self.driver_threads)
+
+        def _dial(rank: int) -> None:
+            self.workers[rank] = SimWorker(
+                self.addr, rank, self.ranks,
+                comm_timeout=self.comm_timeout,
+                cache_capacity=self.cache_capacity)
 
         def _connect() -> None:
             try:
-                for rank in range(1, self.ranks):
-                    self.workers[rank] = SimWorker(
-                        self.addr, rank, self.ranks,
-                        comm_timeout=self.comm_timeout)
+                # Sharded dialing through the same pool the phases use:
+                # at 1024 logical ranks the serial connect handshake walk
+                # alone would dominate start().
+                self._fanout(range(1, self.ranks), _dial)
             except BaseException as exc:  # surfaced by start() below
                 self._connect_error = exc
 
@@ -183,6 +296,10 @@ class SimCluster:
             # rest of the test session.
             self.stop()
             raise
+        if self.enable_metrics and metrics.on():
+            m = _sim_metrics()
+            m.logical_ranks.set(float(self.ranks))
+            m.driver_threads.set(float(self.driver_threads))
         return self
 
     def stop(self) -> None:
@@ -215,6 +332,9 @@ class SimCluster:
                 joiner.close()
             if self.enable_metrics:
                 metrics.reset_for_tests()
+            if self._pool is not None:
+                self._pool.close()
+                self._pool = None
             self._restore_env()
             fault.reset()
             if self.protocheck:
@@ -233,7 +353,7 @@ class SimCluster:
             "HOROVOD_CONTROLLER_ADDR": self.addr,
             "HOROVOD_ENGINE": "python",
             "HOROVOD_CYCLE_TIME": "1",
-            "HOROVOD_CACHE_CAPACITY": "0",
+            "HOROVOD_CACHE_CAPACITY": str(self.cache_capacity),
         }
         if self.elastic:
             overrides["HOROVOD_ELASTIC"] = "1"
@@ -269,6 +389,31 @@ class SimCluster:
                 os.environ.pop(key, None)
         self._touched_env.clear()
 
+    # ---------------------------------------------------------- phase fanout
+
+    def _fanout(self, items: Sequence, fn: Callable) -> None:
+        """Run ``fn(item)`` for every item — one lockstep phase. With a
+        driver pool armed the items shard round-robin across the named
+        threads (each item stays on one thread for the whole phase, so
+        per-wire monitor state is single-threaded) and this blocks until
+        every shard finished: the phase barrier. Serial otherwise —
+        identical call order, identical failure surface."""
+        items = list(items)
+        if self._pool is None or len(items) <= 1:
+            for item in items:
+                fn(item)
+            return
+        shards = [items[i::self._pool.threads]
+                  for i in range(self._pool.threads)]
+
+        def _make(shard):
+            def _run():
+                for item in shard:
+                    fn(item)
+            return _run
+
+        self._pool.run_phase([_make(s) for s in shards if s])
+
     # ------------------------------------------------------------ membership
 
     @property
@@ -303,7 +448,8 @@ class SimCluster:
         service = self.controller._service
         before = service.parked_joiner_count()
         joiner = SimWorker(self.addr, 0, self.size, join=True,
-                           comm_timeout=self.comm_timeout)
+                           comm_timeout=self.comm_timeout,
+                           cache_capacity=self.cache_capacity)
         deadline = time.monotonic() + timeout
         while service.parked_joiner_count() <= before:
             if time.monotonic() > deadline:
@@ -369,6 +515,12 @@ class SimCluster:
                 self._settle_membership()
             return res
         expected = {spec.name for spec in specs}
+        # The completion probe below compares against THIS step's
+        # executions; a tensor name re-used across steps (the cache-hit
+        # workload shape) must not satisfy the probe with last step's
+        # execution.
+        for r in self.alive_worker_ranks:
+            self.workers[r].executed.clear()
         ops_by_rank = {
             r: [SimOp(spec.kind, spec.name, np.asarray(spec.make(r)),
                       spec.root_rank) for spec in specs]
@@ -394,17 +546,21 @@ class SimCluster:
                 except RuntimeError as exc:
                     res.error0 = exc
                 break
-            # -- tick fanout: on-time ranks first, then injected
-            # stragglers in delay order (sleep is the simulated slow
-            # host; the coordinator's tick-lateness accounting sees it).
+            # -- tick fanout: on-time ranks first (sharded across the
+            # driver pool when armed), then injected stragglers in delay
+            # order — delayed ticks stay on the driver thread, where the
+            # cumulative sleeps keep their relative lateness exact (the
+            # coordinator's tick-lateness accounting sees them).
             on_time = [r for r in alive
                        if r in skip or not (first_cycle and r in delays)]
-            for rank in on_time:
-                if rank in skip:
-                    continue
+            fc = first_cycle
+
+            def _tick(rank):
                 self.workers[rank].send_tick(
-                    ops_by_rank.get(rank) if first_cycle else None,
+                    ops_by_rank.get(rank) if fc else None,
                     shutdown=shutdown)
+
+            self._fanout([r for r in on_time if r not in skip], _tick)
             slept = 0.0
             for rank in sorted((r for r in alive
                                 if first_cycle and r in delays
@@ -417,12 +573,17 @@ class SimCluster:
                 self.workers[rank].send_tick(ops_by_rank.get(rank),
                                              shutdown=shutdown)
             first_cycle = False
-            # -- reply fanout
+            # -- reply fanout (statuses land keyed by rank; dict writes
+            # from shard threads hit distinct keys, GIL-atomic)
+            statuses: Dict[int, Tuple[str, Optional[dict]]] = {}
+
+            def _recv(rank):
+                statuses[rank] = self.workers[rank].recv_reply()
+
+            self._fanout([r for r in alive if r not in skip], _recv)
             replies = {}
-            for rank in alive:
-                if rank in skip:
-                    continue
-                status, reply = self.workers[rank].recv_reply()
+            for rank in sorted(statuses):
+                status, reply = statuses[rank]
                 if status == "reshape":
                     res.torn = True
                 elif status == "abort":
@@ -431,16 +592,33 @@ class SimCluster:
                     replies[rank] = reply
             if res.torn or res.aborted:
                 break
-            # -- data phases, identical global order on every rank
+            # -- data phases, identical global order on every rank:
+            # cache-bypass responses first (the order _process_reply
+            # executes them on rank 0), then the negotiated responses.
+            # Every rank pops its own bypass list (the cache mutation);
+            # the lists agree by cache coherence, so the lowest rank's
+            # copy drives the walk like `reply` does for responses.
             reply = replies[min(replies)] if replies else None
             if reply is None:
                 break
+            ranks = sorted(replies)
+            bypass: List = []
+            for rank in ranks:
+                popped = self.workers[rank].take_bypass(replies[rank])
+                if rank == ranks[0]:
+                    bypass = popped
+            for response in bypass:
+                self._fanout(ranks, lambda rank, r=response:
+                             self.workers[rank].data_send(r))
+                self._fanout(ranks, lambda rank, r=response:
+                             self.workers[rank].data_recv(
+                                 r, cache_put=False))
             responses = reply["responses"].responses
             for response in responses:
-                for rank in sorted(replies):
-                    self.workers[rank].data_send(response)
-                for rank in sorted(replies):
-                    self.workers[rank].data_recv(response)
+                self._fanout(ranks, lambda rank, r=response:
+                             self.workers[rank].data_send(r))
+                self._fanout(ranks, lambda rank, r=response:
+                             self.workers[rank].data_recv(r))
             if reply["responses"].shutdown:
                 res.shutdown = True
                 for rank in sorted(replies):
@@ -527,12 +705,10 @@ class SimCluster:
                 # discards; if the reform completed in the meantime the
                 # tick simply becomes the new epoch's first (empty)
                 # cycle and the recv returns its reply.
-                for worker in survivors:
-                    if worker.alive:
-                        worker.send_tick([])
-                for worker in survivors:
-                    if worker.alive:
-                        worker.recv_reply()
+                self._fanout([w for w in survivors if w.alive],
+                             lambda w: w.send_tick([]))
+                self._fanout([w for w in survivors if w.alive],
+                             lambda w: w.recv_reply())
             else:
                 time.sleep(0.0005)
         survivors = [w for w in survivors if w.alive]

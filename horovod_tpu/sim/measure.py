@@ -56,10 +56,20 @@ from .worker import SimOp
 
 def measure_world_size(ranks: int, cycles: int = 30,
                        payload_elems: int = 16,
-                       reshape: bool = True) -> dict:
-    """One world size's control-plane row (see module docstring)."""
-    cluster = SimCluster(ranks=ranks, elastic=True, protocheck=False,
-                         enable_metrics=True)
+                       reshape: bool = True,
+                       driver_threads: int = 1,
+                       protocheck: bool = False) -> dict:
+    """One world size's control-plane row (see module docstring).
+    Tensor names are unique per step, so every measured cycle takes the
+    full negotiation path even with the response cache armed;
+    ``driver_threads`` shards the logical ranks so sizes past ~256 are
+    reachable (the coordinator walk being measured is unchanged).
+    ``protocheck`` arms the wire-conformance monitor and records its
+    violation count in the row — the capacity probe's proof that the
+    threaded driver stayed on-spec at the size it calibrated."""
+    cluster = SimCluster(ranks=ranks, elastic=True, protocheck=protocheck,
+                         enable_metrics=True,
+                         driver_threads=driver_threads)
     cluster.start()
     try:
         for k in range(3):  # warm the wires and the allocator
@@ -81,9 +91,10 @@ def measure_world_size(ranks: int, cycles: int = 30,
             observed = cluster.reshape_seconds_observed()
             if observed:
                 reshape_s = observed[-1]
-        return {
+        row = {
             "ranks": ranks,
             "cycles": cycles,
+            "driver_threads": driver_threads,
             "negotiate_step_seconds": float(np.median(samples)),
             "negotiate_step_seconds_p90": float(np.percentile(samples, 90)),
             "heartbeat_fanout_seconds": hb,
@@ -91,16 +102,54 @@ def measure_world_size(ranks: int, cycles: int = 30,
         }
     finally:
         cluster.stop()
+    if protocheck:
+        report = cluster.protocheck_report or {}
+        row["protocheck_violations"] = len(report.get("violations", []))
+        row["protocheck_transitions"] = report.get("transitions", 0)
+    return row
 
 
 def measure_control_plane(sizes: Sequence[int] = (8, 16, 32, 64),
-                          cycles: int = 30) -> dict:
+                          cycles: int = 30,
+                          driver_threads: Optional[Dict[int, int]] = None,
+                          protocheck_sizes: Sequence[int] = (),
+                          repeats: int = 1,
+                          relative_fit: bool = False) -> dict:
     """The artifact's ``control_plane`` section + fitted calibration +
-    per-size model-vs-measured residuals."""
+    per-size model-vs-measured residuals. ``driver_threads`` maps a
+    world size to its pool width (absent sizes run the serial driver);
+    sizes listed in ``protocheck_sizes`` run with the conformance
+    monitor armed and record its verdict (summed violations) in their
+    row. ``repeats`` runs the whole size sweep that many times in
+    round-robin order — each row is then the median across repeats, so
+    machine-speed drift over the sweep (this substrate swings tens of
+    percent over minutes) hits every size instead of whichever one was
+    measured at the wrong moment. ``relative_fit`` selects the
+    rel-err-weighted calibration fit (see ``fit_linear_relative``)."""
+    threads = driver_threads or {}
+    armed = set(protocheck_sizes or ())
+    trials: Dict[int, List[dict]] = {int(n): [] for n in sizes}
+    for _ in range(max(1, repeats)):
+        for n in sizes:
+            trials[n].append(measure_world_size(
+                n, cycles=cycles, driver_threads=threads.get(n, 1),
+                protocheck=n in armed))
     rows: Dict[int, dict] = {}
-    for n in sizes:
-        rows[n] = measure_world_size(n, cycles=cycles)
-    report = control_plane_report(rows)
+    for n in sorted(trials):
+        runs = trials[n]
+        row = dict(runs[0])
+        for key in ("negotiate_step_seconds", "negotiate_step_seconds_p90",
+                    "heartbeat_fanout_seconds", "reshape_seconds"):
+            vals = [r[key] for r in runs if r.get(key) is not None]
+            row[key] = float(np.median(vals)) if vals else None
+        if n in armed:
+            row["protocheck_violations"] = sum(
+                r.get("protocheck_violations", 0) for r in runs)
+            row["protocheck_transitions"] = sum(
+                r.get("protocheck_transitions", 0) for r in runs)
+        row["repeats"] = len(runs)
+        rows[n] = row
+    report = control_plane_report(rows, relative=relative_fit)
     return {
         "world_sizes": sorted(rows),
         "control_plane": {str(n): rows[n] for n in sorted(rows)},
@@ -220,9 +269,20 @@ def run_overlap_probe(ranks: int, grads: int = 12,
 def _run_data_phases(cluster: SimCluster, replies: Dict[int, dict]) -> None:
     if not replies:
         return
+    ranks = sorted(replies)
+    bypass: List = []
+    for rank in ranks:
+        popped = cluster.workers[rank].take_bypass(replies[rank])
+        if rank == ranks[0]:
+            bypass = popped
+    for response in bypass:
+        for rank in ranks:
+            cluster.workers[rank].data_send(response)
+        for rank in ranks:
+            cluster.workers[rank].data_recv(response, cache_put=False)
     reply = replies[min(replies)]
     for response in reply["responses"].responses:
-        for rank in sorted(replies):
+        for rank in ranks:
             cluster.workers[rank].data_send(response)
-        for rank in sorted(replies):
+        for rank in ranks:
             cluster.workers[rank].data_recv(response)

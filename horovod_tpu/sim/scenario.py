@@ -53,15 +53,19 @@ class ScenarioResult:
 
 
 def run_scenario(ranks: int, driver: Optional[SimFaultDriver],
-                 steps: int = 40, retries: int = 16) -> ScenarioResult:
-    """Run ``steps`` collective steps under the plan; settle; judge."""
+                 steps: int = 40, retries: int = 16,
+                 driver_threads: int = 1) -> ScenarioResult:
+    """Run ``steps`` collective steps under the plan; settle; judge.
+    ``driver_threads > 1`` shards the lockstep phases across the named
+    pool (1024-rank storms; protocheck stays armed per wire)."""
     problems: List[str] = []
     findings: List[dict] = []
     expected: Dict[str, object] = expected_diagnoses(
         driver.rules if driver is not None else [], steps)
     final_epoch, final_size = 1, ranks
     cluster = SimCluster(ranks=ranks, elastic=True, protocheck=True,
-                         enable_metrics=True)
+                         enable_metrics=True,
+                         driver_threads=driver_threads)
     cluster.start()
     try:
         for cycle in range(1, steps + 1):

@@ -16,10 +16,15 @@ before advancing (``sim/cluster.py``).
 
 Fidelity boundary (docs/simcluster.md): everything ON the wire is real —
 frame kinds, epochs, reshape acks, abort payloads, conformance
-monitoring. What is simulated is the process around it: "killing" a
-logical rank closes its socket (how a SIGKILLed process looks from the
-coordinator's side of the wire), and a delayed tick is the driver
-sleeping, not a loaded host.
+monitoring, and (since r17) the response-cache bitmask plane: each
+logical rank holds its own :class:`ResponseCache` and runs the
+controller's exact tick/reply cache contract (``_build_tick`` masks,
+``_process_reply`` evictions/bypasses, the reshape reset), so cache-on
+jobs simulate with coherent bit masks instead of pinning the cache off.
+What is simulated is the process around it: "killing" a logical rank
+closes its socket (how a SIGKILLed process looks from the coordinator's
+side of the wire), and a delayed tick is the driver sleeping, not a
+loaded host.
 """
 
 from __future__ import annotations
@@ -29,7 +34,9 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common.message import Request, RequestList, RequestType, ResponseType
+from ..common.message import (Request, RequestList, RequestType, Response,
+                              ResponseType)
+from ..common.response_cache import ResponseCache
 from ..common.wire import RanksChangedError, RemoteAbortError
 from ..controller.service import WorkerClient
 
@@ -64,7 +71,8 @@ class SimWorker:
 
     def __init__(self, addr: str, rank: int, size: int,
                  join: bool = False,
-                 comm_timeout: Optional[float] = None):
+                 comm_timeout: Optional[float] = None,
+                 cache_capacity: int = 0):
         self.rank = rank
         self.size = size
         self.epoch = 1
@@ -81,6 +89,15 @@ class SimWorker:
         self.last_tune: Optional[tuple] = None
         self.tuned_bucket_bytes: Optional[int] = None
         self._pending: Dict[str, SimOp] = {}
+        # The controller's response-cache state, replicated per logical
+        # rank so the bit-mask plane stays coherent with rank 0
+        # (``capacity=0`` disables it, the pre-r17 behavior).
+        self._cache_capacity = int(cache_capacity)
+        self._cache = ResponseCache(self._cache_capacity)
+        self._cache_enabled = self._cache_capacity > 0
+        self._bit_pending: Dict[int, str] = {}
+        self._renegotiate: List[str] = []
+        self._bypass: List[Response] = []
         self._client = WorkerClient(addr, rank, join=join,
                                     comm_timeout=comm_timeout)
         if join:
@@ -105,9 +122,10 @@ class SimWorker:
                   shutdown: bool = False) -> None:
         """Phase 1 of a cycle: this rank's tick. ``ops`` mirror what the
         coordinator rank enqueued this step (negotiation completes only
-        when every rank reports a tensor). The sim never advertises
-        cache bits — the harness pins HOROVOD_CACHE_CAPACITY=0, the one
-        documented fidelity carve-out (docs/simcluster.md)."""
+        when every rank reports a tensor). Mirrors ``_build_tick``: a
+        cached announce parks on its bit instead of sending a request,
+        every still-pending bit is re-advertised in ``cache_mask``, and
+        a parameter-stale hit raises the bit in ``invalid_mask``."""
         if not self.alive:
             raise SimWorkerDead(f"logical rank {self.rank} is gone")
         ops = ops or []
@@ -116,11 +134,34 @@ class SimWorker:
         # cycle k may only negotiate (and exchange data) on cycle k+1,
         # after an empty follow-up tick.
         self._pending.update({op.name: op for op in ops})
-        requests = [op.request(self.rank) for op in ops]
+        announce = list(ops)
+        if self._renegotiate:
+            # Names whose cache bit died under them (invalidation, or
+            # the cache categorical flipping off) re-enter as ordinary
+            # announces — the controller's _queue requeue path.
+            announce.extend(self._pending[n] for n in self._renegotiate
+                            if n in self._pending)
+            self._renegotiate = []
+        cache_mask = 0
+        invalid_mask = 0
+        requests = []
+        for op in announce:
+            req = op.request(self.rank)
+            bit = self._cache.lookup(req) if self._cache_enabled else None
+            if bit is not None:
+                self._bit_pending[bit] = op.name
+                continue
+            if self._cache_enabled:
+                stale = self._cache.stale_bit(req)
+                if stale is not None:
+                    invalid_mask |= 1 << stale
+            requests.append(req)
+        for bit in self._bit_pending:
+            cache_mask |= 1 << bit
         self._client.send({
             "rank": self.rank,
-            "cache_mask": 0,
-            "invalid_mask": 0,
+            "cache_mask": cache_mask,
+            "invalid_mask": invalid_mask,
             "requests": RequestList(requests=requests, shutdown=shutdown),
         })
 
@@ -143,14 +184,55 @@ class SimWorker:
             self.close()
             return "abort", None
         tune = reply.get("tune")
+        cache_turned_off = False
         if tune is not None:
             # Mirror Controller._apply_tune: the synced knobs every rank
             # adopts from the cycle reply — including the r13 bucket-size
-            # element (docs/overlap.md), which the sync test pins here.
+            # element (docs/overlap.md), which the sync test pins here,
+            # and the cache categorical (every rank flips on the same
+            # cycle so the bit masks stay aligned).
             self.last_tune = tune
+            if len(tune) > 2:
+                new_cache = bool(tune[2].get("cache_enabled",
+                                             self._cache_enabled))
+                cache_turned_off = self._cache_enabled and not new_cache
+                self._cache_enabled = new_cache
             if len(tune) > 3 and tune[3].get("bucket_bytes"):
                 self.tuned_bucket_bytes = int(tune[3]["bucket_bytes"])
+        # _process_reply's cache walk, in its exact order: invalidations
+        # evict (a pending hit renegotiates), bypass bits pop into the
+        # cached fast path, and a cache turn-off renegotiates whatever
+        # is still parked on a bit (sorted by bit — rank-agnostic).
+        for bit in ResponseCache.mask_to_bits(reply["invalid_mask"]):
+            self._cache.evict_bit(bit)
+            name = self._bit_pending.pop(bit, None)
+            if name is not None:
+                self._renegotiate.append(name)
+        self._bypass = []
+        for bit in reply["bypass_bits"]:
+            _, cached = self._cache.get(bit)
+            self._cache.touch(bit)
+            name = self._bit_pending.pop(bit)
+            self._bypass.append(Response(
+                response_type=cached.response_type,
+                tensor_names=[name],
+                tensor_sizes=list(cached.tensor_sizes)))
+        if cache_turned_off:
+            self._renegotiate.extend(
+                name for _, name in sorted(self._bit_pending.items()))
+            self._bit_pending.clear()
         return "reply", reply
+
+    def take_bypass(self, reply: dict) -> List[Response]:
+        """The cache-bypass responses this rank popped while processing
+        ``reply`` (already removed from the bit-pending table). The
+        driver walks these data exchanges BEFORE ``reply["responses"]``
+        — the identical global order ``_process_reply`` executes them
+        in on rank 0. ``reply`` is accepted for symmetry with the other
+        phase methods; the pops happened in :meth:`recv_reply`."""
+        del reply
+        bypass, self._bypass = self._bypass, []
+        return bypass
 
     # ----------------------------------------------------------- data phase
 
@@ -180,8 +262,11 @@ class SimWorker:
             if self.rank == op.root_rank:
                 self._client.send_bytes(op.array.tobytes())
 
-    def data_recv(self, response) -> None:
-        """Per-response receive half; stores results by tensor name."""
+    def data_recv(self, response, cache_put: bool = True) -> None:
+        """Per-response receive half; stores results by tensor name.
+        ``cache_put=False`` marks a cache-bypass exchange (the driver's
+        walk of :meth:`take_bypass` responses) — mirroring ``_execute``,
+        only freshly-negotiated responses are inserted into the cache."""
         if not self.alive:
             raise SimWorkerDead(f"logical rank {self.rank} is gone")
         rtype = response.response_type
@@ -198,20 +283,28 @@ class SimWorker:
                     flat[offset:offset + n]).reshape(op.array.shape)
                 offset += n
         elif rtype == ResponseType.ALLGATHER:
-            op = self._pending.pop(response.tensor_names[0])
+            entries = [self._pending.pop(response.tensor_names[0])]
+            op = entries[0]
             rest = op.array.shape[1:]
             raw = np.frombuffer(self._client.recv_bytes(),
                                 dtype=op.array.dtype)
             self.results[op.name] = raw.reshape(
                 (sum(response.tensor_sizes),) + rest)
         elif rtype == ResponseType.BROADCAST:
-            op = self._pending.pop(response.tensor_names[0])
+            entries = [self._pending.pop(response.tensor_names[0])]
+            op = entries[0]
             if self.rank == op.root_rank:
                 self.results[op.name] = op.array
             else:
                 raw = np.frombuffer(self._client.recv_bytes(),
                                     dtype=op.array.dtype)
                 self.results[op.name] = raw.reshape(op.array.shape)
+        if cache_put and self._cache_enabled:
+            # _execute's put, per fused entry, in tensor_names order.
+            for op in entries:
+                self._cache.put(op.request(self.rank), Response(
+                    response_type=rtype, tensor_names=[op.name],
+                    tensor_sizes=list(response.tensor_sizes)))
 
     # ------------------------------------------------------- shard plane
 
@@ -255,9 +348,15 @@ class SimWorker:
     def apply_reshape(self, exc: RanksChangedError) -> None:
         """Adopt a membership assignment and acknowledge it — the worker
         half of ``reform()``'s ack handshake. Pending collectives from
-        the dead epoch are discarded, mirroring ``_drain_epoch``."""
+        the dead epoch are discarded, mirroring ``_drain_epoch``; the
+        response cache resets like the controller's reshape path does
+        (joiners arrive cold, so every member must restart coherent)."""
         self._adopt(exc)
         self._pending.clear()
+        self._bit_pending.clear()
+        self._renegotiate = []
+        self._bypass = []
+        self._cache = ResponseCache(self._cache_capacity)
         self.reshapes += 1
         self._client.wire.send_join({"ack": exc.epoch})
 

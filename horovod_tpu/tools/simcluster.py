@@ -47,6 +47,10 @@ def main(argv=None) -> int:
                              "rules only")
     parser.add_argument("--retries", type=int, default=16,
                         help="reshape retries per step before giving up")
+    parser.add_argument("--driver-threads", type=int, default=1,
+                        help="shard the lockstep phases across this many "
+                             "named driver threads (1024-rank storms; "
+                             "default 1 = serial)")
     parser.add_argument("--json", action="store_true",
                         help="emit the full verdict as JSON")
     args = parser.parse_args(argv)
@@ -61,7 +65,8 @@ def main(argv=None) -> int:
         driver = SimFaultDriver(rules, seed=seed)
 
     result = run_scenario(args.ranks, driver, steps=args.steps,
-                          retries=args.retries)
+                          retries=args.retries,
+                          driver_threads=args.driver_threads)
     if args.json:
         print(json.dumps(result.to_dict(), indent=1, sort_keys=True))
     else:

@@ -35,7 +35,7 @@ Ring-allreduce wire bytes use :mod:`.comm_accounting`'s model:
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .comm_accounting import ring_allreduce_bytes as ring_wire_bytes
 
@@ -311,19 +311,66 @@ def fit_linear(points: Dict[int, float]) -> Tuple[float, float]:
     return base, slope
 
 
+def fit_linear_relative(points: Dict[int, float]) -> Tuple[float, float]:
+    """Relative-error-weighted least squares (weights ``1/y**2``),
+    same non-negative clamps as :func:`fit_linear`. Plain least squares
+    is dominated by the largest world size's absolute cost, so a fit
+    over sizes spanning two orders of magnitude leaves the small sizes'
+    RELATIVE residuals unbounded; this variant spreads relative error
+    evenly — the right objective when the gate is a rel_err bound at
+    every recorded size. New calibration artifacts stamp ``"fit":
+    "relative"`` so :func:`control_plane_from_artifact` refits them the
+    same way (r13-era artifacts carry no stamp and keep the absolute
+    fit, bit-for-bit)."""
+    items = sorted(points.items())
+    if not items:
+        raise ValueError(
+            "fit_linear_relative needs at least one (n, seconds) point")
+    if len(items) == 1:
+        n, secs = items[0]
+        return 0.0, max(0.0, secs / max(1, n))
+    rows = [(float(n), float(y)) for n, y in items if float(y) > 0]
+    if len(rows) < 2:
+        return fit_linear(points)
+    # Weighted normal equations for y ~ b + m*n with w = 1/y^2.
+    sw = sn = sy = snn = sny = 0.0
+    for n, y in rows:
+        w = 1.0 / (y * y)
+        sw += w
+        sn += w * n
+        sy += w * y
+        snn += w * n * n
+        sny += w * n * y
+    det = sw * snn - sn * sn
+    if not det:
+        return fit_linear(points)
+    base = (snn * sy - sn * sny) / det
+    slope = (sw * sny - sn * sy) / det
+    slope = max(0.0, slope)
+    if base < 0.0:
+        # Re-solve the slope with the base pinned at its clamp, instead
+        # of keeping a slope optimized for the unclamped intercept.
+        base = 0.0
+        slope = max(0.0, sny / snn if snn else 0.0)
+    return base, slope
+
+
 def fit_control_plane(measured: Dict[int, dict],
-                      source: str = "measured") -> ControlPlaneCalibration:
+                      source: str = "measured",
+                      relative: bool = False) -> ControlPlaneCalibration:
     """Fit the three control-plane curves from per-world-size sim
     measurements: ``{n: {"negotiate_step_seconds": s,
     "reshape_seconds": s, "heartbeat_fanout_seconds": s}}`` (absent
-    fields are skipped per curve)."""
+    fields are skipped per curve). ``relative`` switches to the
+    rel-err-weighted fit (:func:`fit_linear_relative`)."""
+    fit = fit_linear_relative if relative else fit_linear
 
     def curve(key: str) -> Tuple[float, float]:
         pts = {n: row[key] for n, row in sorted(measured.items())
                if row.get(key) is not None}
         if not pts:
             return 0.0, 0.0
-        return fit_linear(pts)
+        return fit(pts)
 
     neg = curve("negotiate_step_seconds")
     resh = curve("reshape_seconds")
@@ -335,11 +382,14 @@ def fit_control_plane(measured: Dict[int, dict],
         source=source)
 
 
-def control_plane_report(measured: Dict[int, dict]) -> dict:
+def control_plane_report(measured: Dict[int, dict],
+                         relative: bool = False) -> dict:
     """Fit + per-size model-vs-measured residuals, JSON-ready — the
     shape ``artifacts/simcluster_r13.json`` embeds and the artifact gate
-    asserts on. Residuals are relative to the measured value."""
-    cal = fit_control_plane(measured)
+    asserts on. Residuals are relative to the measured value. The
+    ``fit`` key records which fit produced the calibration so
+    :func:`control_plane_from_artifact` reproduces it exactly."""
+    cal = fit_control_plane(measured, relative=relative)
     rows = {}
     for n in sorted(measured):
         row = measured[n]
@@ -362,16 +412,20 @@ def control_plane_report(measured: Dict[int, dict]) -> dict:
     return {
         "calibration": dataclasses.asdict(cal),
         "model_vs_measured": rows,
+        "fit": "relative" if relative else "absolute",
     }
 
 
 def control_plane_from_artifact(data: dict) -> ControlPlaneCalibration:
     """Rebuild the calibration from a loaded simcluster artifact (the
-    ``control_plane`` section keyed by world size)."""
+    ``control_plane`` section keyed by world size), honoring the
+    artifact's recorded ``fit`` flavor (absent on r13-era artifacts —
+    those keep the absolute fit they were committed with)."""
     measured = {int(n): row
                 for n, row in sorted(data["control_plane"].items())}
     return fit_control_plane(
-        measured, source=data.get("substrate", "artifact"))
+        measured, source=data.get("substrate", "artifact"),
+        relative=data.get("fit") == "relative")
 
 
 def pipelined_modeled_events(event_dicts: Sequence[dict],
@@ -441,3 +495,264 @@ def measured_overlap_report(events: Sequence[BucketEvent],
         "comm_busy_s": round(sum(max(0.0, e.complete_s - e.launch_s)
                                  for e in events), 6),
     }
+
+
+# --------------------------------------------------------------------------
+# Capacity planner (round 17): invert the calibrated curves. Rounds
+# 13–16 answered "what does the control plane cost at the sizes we ran";
+# the planner answers the operator's forward question — "what saturates
+# FIRST if I scale this job to N ranks" — from the committed calibration
+# artifacts (r13 control plane, r15 restore, r16 stall split), each
+# prediction carried with its fit residual as an explicit uncertainty.
+# Substrate honesty: the calibrations are loopback+GIL coordinator walk
+# costs, not NIC latency — every report stamps its calibration source
+# (docs/capacity.md).
+
+# Fixed evaluation order; ties in saturation rank deterministically.
+CAPACITY_PLANES = ("negotiation", "reshape", "heartbeat_fanout",
+                   "restore", "overlap_stall")
+
+_MIB = 1024 * 1024
+
+# Operator hints, per plane — what to turn when the plane binds.
+CAPACITY_HINTS = {
+    "negotiation": (
+        "negotiation is a per-rank coordinator walk: keep the response "
+        "cache on (HOROVOD_CACHE_CAPACITY) so repeated tensors bypass "
+        "it, raise HOROVOD_CYCLE_TIME to amortize the walk, or grow "
+        "buckets so fewer rounds run per step"),
+    "reshape": (
+        "reform fanout is O(ranks); batch membership changes so one "
+        "reshape absorbs many joiners, and keep "
+        "HOROVOD_COMM_TIMEOUT_SECONDS above the modeled reshape time"),
+    "heartbeat_fanout": (
+        "the liveness sweep walks every wire from rank 0; raise "
+        "HOROVOD_HEARTBEAT_INTERVAL_SECONDS so sweeps stay a small "
+        "fraction of the interval"),
+    "restore": (
+        "use p2p sharded restore (HOROVOD_ELASTIC_RESTORE=p2p) — the "
+        "per-rank shard shrinks as the world grows, unlike the "
+        "broadcast path"),
+    "overlap_stall": (
+        "per-bucket negotiation stall outgrows the backward window: "
+        "raise HOROVOD_BUCKET_BYTES (fewer rounds per step) or set "
+        "HOROVOD_AUTOTUNE_PRIORS=capacity to seed the tuner at the "
+        "modeled point"),
+}
+
+
+def fit_restore_curve(restore_data: dict) -> Tuple[float, float]:
+    """``base + per_mib * shard_mib`` from the r15 restore artifact's
+    measured p2p leaf timings (``leaf_kinds.jax.p2p``: per-size
+    ``median_s`` rows). The p2p plane is the one whose per-rank cost
+    stays flat as the world grows (each joiner fetches only its shard),
+    which is why it is the restore curve worth extrapolating."""
+    rows = restore_data["leaf_kinds"]["jax"]["p2p"]
+    points = {}
+    for size_mib, entry in sorted(rows.items()):
+        try:
+            points[float(size_mib)] = float(entry["median_s"])
+        except (TypeError, ValueError):
+            continue  # the "ratio" summary key rides beside the sizes
+    if not points:
+        raise ValueError("restore artifact has no p2p size rows")
+    return fit_linear(points)
+
+
+def _curve_residual(control_plane_report_data: dict, key: str):
+    """Max relative fit error for one measured curve across the
+    artifact's model-vs-measured rows — the honesty number every
+    extrapolation carries (predicted ± predicted * residual)."""
+    worst = None
+    rows = control_plane_report_data.get("model_vs_measured", {})
+    for _, entry in sorted(rows.items()):
+        rel = entry.get(key, {}).get("rel_err")
+        if rel is not None:
+            worst = rel if worst is None else max(worst, rel)
+    return worst
+
+
+def saturation_ranks(base_s: float, per_rank_s: float,
+                     budget_s: float) -> Optional[int]:
+    """Smallest world size at which ``base + per_rank * n`` meets the
+    budget; None when the curve never reaches it (zero slope)."""
+    if budget_s <= base_s:
+        return 1
+    if per_rank_s <= 0:
+        return None
+    n = (budget_s - base_s) / per_rank_s
+    return max(1, int(n) + 1)
+
+
+def capacity_plan(ranks: int, model_bytes: int = 0,
+                  control_plane_data: Optional[dict] = None,
+                  restore_data: Optional[dict] = None,
+                  overlap_data: Optional[dict] = None,
+                  step_window_s: Optional[float] = None,
+                  comm_timeout_s: Optional[float] = None,
+                  heartbeat_interval_s: Optional[float] = None) -> dict:
+    """Per-plane predicted cost at ``ranks`` + the first bottleneck.
+
+    ``control_plane_data`` is a simcluster measurement artifact (the
+    ``control_plane`` + ``model_vs_measured`` shape) — required; the
+    calibration is re-fit from its measured rows, never trusted as
+    stored coefficients. ``restore_data``/``overlap_data`` arm the
+    restore and overlap-stall planes (r15/r16 artifact shapes);
+    ``step_window_s`` overrides the overlap artifact's measured backward
+    window. Budgets default to the config defaults a fresh job runs
+    with. Returns a JSON-ready dict: ``planes`` (one entry per
+    CAPACITY_PLANES member, fixed order), ``first_bottleneck``,
+    ``calibration`` and sources."""
+    if ranks < 1:
+        raise ValueError("capacity_plan needs ranks >= 1")
+    if control_plane_data is None:
+        raise ValueError("capacity_plan needs a control-plane artifact")
+    from ..common.config import DEFAULT_COMM_TIMEOUT_SECONDS
+
+    cal = control_plane_from_artifact(control_plane_data)
+    if comm_timeout_s is None:
+        comm_timeout_s = DEFAULT_COMM_TIMEOUT_SECONDS
+    if heartbeat_interval_s is None:
+        heartbeat_interval_s = min(10.0, comm_timeout_s / 4.0)
+
+    window_s = step_window_s
+    buckets = None
+    if overlap_data is not None:
+        # r16 probe artifacts nest the measured step under
+        # median_step_report; the raw measured_overlap_report shape is
+        # flat. Accept both.
+        report = overlap_data.get("median_step_report") or overlap_data
+        if window_s is None:
+            window_s = report.get("compute_window_s")
+        buckets = report.get("buckets", overlap_data.get("buckets"))
+    if buckets is None:
+        buckets = 4  # the probe default; overridden by real artifacts
+
+    planes = {}
+
+    def _plane(name, predicted, budget, budget_desc, sat, residual,
+               note=None):
+        entry = {
+            "predicted_seconds": round(float(predicted), 6),
+            "budget_seconds": (round(float(budget), 6)
+                               if budget is not None else None),
+            "budget": budget_desc,
+            "saturation_ranks": sat,
+            "fit_residual": residual,
+            "uncertainty_seconds": (
+                round(float(predicted) * residual, 6)
+                if residual is not None else None),
+            "hint": CAPACITY_HINTS[name],
+        }
+        if note:
+            entry["note"] = note
+        planes[name] = entry
+
+    _plane("negotiation", cal.negotiation_seconds(ranks), window_s,
+           "backward compute window per step",
+           (saturation_ranks(cal.negotiation_base_s,
+                             cal.negotiation_per_rank_s, window_s)
+            if window_s else None),
+           _curve_residual(control_plane_data, "negotiate_step_seconds"))
+
+    _plane("reshape", cal.reshape_seconds(ranks), comm_timeout_s,
+           "comm deadline (HOROVOD_COMM_TIMEOUT_SECONDS)",
+           saturation_ranks(cal.reshape_base_s, cal.reshape_per_rank_s,
+                            comm_timeout_s),
+           _curve_residual(control_plane_data, "reshape_seconds"))
+
+    _plane("heartbeat_fanout", cal.heartbeat_fanout_seconds(ranks),
+           heartbeat_interval_s,
+           "heartbeat interval (sweep must fit inside it)",
+           saturation_ranks(cal.heartbeat_base_s, cal.heartbeat_per_rank_s,
+                            heartbeat_interval_s),
+           _curve_residual(control_plane_data, "heartbeat_fanout_seconds"))
+
+    if restore_data is not None:
+        base, per_mib = fit_restore_curve(restore_data)
+        shard_mib = (model_bytes / max(1, ranks)) / _MIB
+        pts = {float(s): float(e["median_s"])
+               for s, e in sorted(
+                   restore_data["leaf_kinds"]["jax"]["p2p"].items())
+               if isinstance(e, dict) and "median_s" in e}
+        residual = max((abs((base + per_mib * s) - y) / y
+                        for s, y in pts.items() if y), default=None)
+        _plane("restore", base + per_mib * shard_mib, comm_timeout_s,
+               "comm deadline (HOROVOD_COMM_TIMEOUT_SECONDS)",
+               None,  # per-rank shard SHRINKS with n: never saturates
+               round(residual, 4) if residual is not None else None,
+               note=("p2p restore cost falls with world size (shard = "
+                     "model_bytes / ranks); not a scaling bottleneck"))
+
+    # Overlap stall: the per-step negotiation tax the r16 stall split
+    # measured, extrapolated — `buckets` negotiation rounds per step
+    # must fit inside the backward window or gradients wait on the
+    # control plane instead of the wire.
+    stall = buckets * cal.negotiation_seconds(ranks)
+    _plane("overlap_stall", stall, window_s,
+           "backward compute window per step "
+           f"({buckets} negotiation rounds)",
+           (saturation_ranks(buckets * cal.negotiation_base_s,
+                             buckets * cal.negotiation_per_rank_s,
+                             window_s)
+            if window_s else None),
+           _curve_residual(control_plane_data, "negotiate_step_seconds"),
+           note=None if window_s else (
+               "no overlap artifact/step window given: stall reported "
+               "without a saturation point"))
+
+    first = None
+    for name in CAPACITY_PLANES:
+        entry = planes.get(name)
+        if entry is None or entry["saturation_ranks"] is None:
+            continue
+        if first is None or (entry["saturation_ranks"]
+                             < planes[first]["saturation_ranks"]):
+            first = name
+    bottleneck = None
+    if first is not None:
+        e = planes[first]
+        bottleneck = {
+            "plane": first,
+            "saturation_ranks": e["saturation_ranks"],
+            "summary": (
+                f"{first} saturates its budget "
+                f"({e['budget_seconds']}s — {e['budget']}) at "
+                f"~{e['saturation_ranks']} ranks; at {ranks} ranks the "
+                f"modeled cost is {e['predicted_seconds']}s"
+                + (f" (±{e['uncertainty_seconds']}s fit uncertainty)"
+                   if e["uncertainty_seconds"] is not None else "")),
+            "hint": e["hint"],
+        }
+    return {
+        "ranks": ranks,
+        "model_bytes": int(model_bytes),
+        "planes": {name: planes[name] for name in CAPACITY_PLANES
+                   if name in planes},
+        "first_bottleneck": bottleneck,
+        "calibration": dataclasses.asdict(cal),
+        "calibration_source": cal.source,
+    }
+
+
+def recommend_autotune_seeds(cal: ControlPlaneCalibration, ranks: int,
+                             reference_ranks: int = 64) -> Dict[str, int]:
+    """Planner-predicted warm-start seeds for the GP autotuner
+    (``HOROVOD_AUTOTUNE_PRIORS=capacity``, docs/autotune.md): as the
+    calibrated negotiation round gets costlier with world size, the
+    right starting bucket grows proportionally (fewer rounds per step)
+    and the ring chunk with its square root (pipelining still wants
+    depth). A deterministic heuristic snapped to the tuner's own
+    power-of-two grid — a SEED the search refines, not a pin."""
+    import math
+
+    from ..common.config import DEFAULT_BUCKET_BYTES
+
+    ref = max(1e-9, cal.negotiation_seconds(reference_ranks))
+    ratio = max(1e-9, cal.negotiation_seconds(max(1, ranks))) / ref
+    bucket_log2 = round(math.log2(DEFAULT_BUCKET_BYTES) + math.log2(ratio))
+    bucket_log2 = min(26, max(21, bucket_log2))
+    chunk_log2 = round(18 + math.log2(ratio) / 2.0)
+    chunk_log2 = min(21, max(16, chunk_log2))
+    return {"bucket_bytes": 1 << bucket_log2,
+            "ring_chunk_bytes": 1 << chunk_log2}

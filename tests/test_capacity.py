@@ -1,0 +1,521 @@
+"""Capacity planner (round 17, docs/capacity.md): thousand-rank
+simcluster fidelity + calibrated bottleneck attribution.
+
+Four layers of coverage:
+
+* **units** — the rel-err-weighted fit (exact linear recovery,
+  non-negative clamps, the single-point degenerate, the ``fit`` stamp
+  round-tripping through ``control_plane_from_artifact``), the
+  saturation arithmetic, ``capacity_plan``'s deterministic bottleneck
+  ordering under ties, and the autotune-seed recommendation.
+* **wiring** — ``HOROVOD_AUTOTUNE_PRIORS=capacity`` seeds the FIRST
+  probed tuner configuration from the planner's recommendation (an
+  explicit env pin still wins), and the ``capacity_headroom`` doctor
+  rule fires on synthetic over-budget evidence while staying silent on
+  healthy jobs, thin samples, and missing calibration.
+* **CLI** — ``python -m horovod_tpu.tools.capacity`` JSON/exit-code
+  contract (unreachable artifacts exit 2; there is nothing honest to
+  extrapolate from without measured points) and the golden text report
+  over the committed artifacts.
+* **acceptance** — the committed ``artifacts/capacity_r17.json``:
+  negotiation model-vs-measured rel_err <= 10% at EVERY recorded world
+  size (seven sizes, three on the threaded driver with the
+  wire-conformance monitor armed and zero violations), and the seeded
+  join/leave storm from r13 re-run on the threaded driver at 128
+  logical ranks (1024 @slow) — protocheck zero, doctor still names the
+  injected faults.
+"""
+
+import json
+import os
+
+import pytest
+
+from horovod_tpu.doctor.evidence import Evidence
+from horovod_tpu.doctor.rules import (
+    ALL_RULES,
+    CAPACITY_HEADROOM_FACTOR,
+    RULE_SLUGS,
+    check_capacity_headroom,
+    diagnose,
+)
+from horovod_tpu.sim import SimFaultDriver, run_scenario
+from horovod_tpu.utils import scaling_model as sm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACTS = os.path.join(REPO, "artifacts")
+ARTIFACT = os.path.join(ARTIFACTS, "capacity_r17.json")
+
+
+# ---------------------------------------------------------------------------
+# fit units: the rel-err-weighted calibration fit
+
+
+def test_fit_linear_relative_recovers_exact_line():
+    pts = {n: 0.002 + 0.0004 * n for n in (8, 16, 32, 64, 128)}
+    base, slope = sm.fit_linear_relative(pts)
+    assert base == pytest.approx(0.002, rel=1e-9)
+    assert slope == pytest.approx(0.0004, rel=1e-9)
+
+
+def test_fit_linear_relative_single_point_and_empty():
+    # One point degenerates to a pure per-rank rate, same as fit_linear.
+    assert sm.fit_linear_relative({64: 0.032}) == (0.0, 0.0005)
+    with pytest.raises(ValueError):
+        sm.fit_linear_relative({})
+
+
+def test_fit_linear_relative_clamps_nonnegative():
+    # A decreasing curve is measurement noise, not physics: the slope
+    # clamps to zero and the intercept stays non-negative.
+    base, slope = sm.fit_linear_relative({8: 0.01, 64: 0.002})
+    assert slope == 0.0 and base > 0.0
+    # A negative unclamped intercept pins at zero and RE-SOLVES the
+    # slope (instead of keeping one optimized for the discarded base).
+    pts = {n: -0.0001 + 0.0001 * n for n in (8, 64, 128)}
+    base2, slope2 = sm.fit_linear_relative(pts)
+    assert base2 == 0.0
+    assert slope2 == pytest.approx(0.0001, rel=0.1)
+
+
+def test_relative_fit_bounds_small_size_relative_error():
+    """The reason the r17 probe switched fits: plain least squares is
+    dominated by the largest size's absolute cost, so one drifted
+    top-end measurement wrecks the SMALL sizes' relative residuals.
+    The weighted fit spreads relative error evenly."""
+    pts = {n: 100e-6 * n for n in (8, 16, 32, 64, 128, 256)}
+    pts[512] = 100e-6 * 512 * 1.25  # the box sped up mid-sweep
+
+    def max_rel(fit):
+        base, slope = fit(pts)
+        return max(abs(base + slope * n - y) / y
+                   for n, y in sorted(pts.items()))
+
+    assert max_rel(sm.fit_linear_relative) < max_rel(sm.fit_linear)
+
+
+def test_fit_stamp_round_trips_through_artifact():
+    """New artifacts stamp "fit": "relative" and refit the same way;
+    r13-era artifacts carry no stamp and keep the absolute fit they
+    were committed with, bit-for-bit."""
+    rows = {n: {"negotiate_step_seconds": 0.0005 * n,
+                "reshape_seconds": 0.001 + 0.0002 * n,
+                "heartbeat_fanout_seconds": 0.0001 * n}
+            for n in (8, 16, 64, 256)}
+    report = sm.control_plane_report(rows, relative=True)
+    assert report["fit"] == "relative"
+    data = {"control_plane": {str(n): r for n, r in sorted(rows.items())},
+            **report}
+    refit = sm.control_plane_from_artifact(data)
+    cal = report["calibration"]
+    for field in ("negotiation_per_rank_s", "negotiation_base_s",
+                  "reshape_per_rank_s", "heartbeat_per_rank_s"):
+        assert getattr(refit, field) == pytest.approx(cal[field],
+                                                      abs=1e-12)
+    legacy = {"control_plane": {str(n): r
+                                for n, r in sorted(rows.items())}}
+    absolute = sm.fit_control_plane(rows, relative=False)
+    assert (sm.control_plane_from_artifact(legacy).negotiation_per_rank_s
+            == absolute.negotiation_per_rank_s)
+
+
+def test_saturation_ranks():
+    assert sm.saturation_ranks(0.2, 0.001, 0.1) == 1   # over budget at n=1
+    assert sm.saturation_ranks(0.0, 0.0, 0.1) is None  # flat: never
+    assert sm.saturation_ranks(0.0, 0.001, 0.0995) == 100
+    assert sm.saturation_ranks(0.05, 0.001, 0.1) == 51
+
+
+# ---------------------------------------------------------------------------
+# capacity_plan units
+
+
+def _plan_data(per_rank=0.0005):
+    rows = {str(n): {"negotiate_step_seconds": per_rank * n,
+                     "reshape_seconds": per_rank * n,
+                     "heartbeat_fanout_seconds": per_rank * n}
+            for n in (8, 16, 32, 64)}
+    return {"control_plane": rows, "fit": "relative"}
+
+
+def test_capacity_plan_tie_breaks_in_fixed_plane_order():
+    """Identical curves and budgets on every plane: the bottleneck must
+    come out deterministic — the first plane in CAPACITY_PLANES order
+    (strict < keeps the earlier one on ties), never dict luck."""
+    overlap = {"median_step_report": {"compute_window_s": 0.1,
+                                      "buckets": 1}}
+    plan = sm.capacity_plan(4096, control_plane_data=_plan_data(),
+                            overlap_data=overlap, step_window_s=0.1,
+                            comm_timeout_s=0.1, heartbeat_interval_s=0.1)
+    sats = {name: plan["planes"][name]["saturation_ranks"]
+            for name in sorted(plan["planes"])}
+    assert len({sats[k] for k in sorted(sats)}) == 1, sats  # four-way tie
+    assert plan["first_bottleneck"]["plane"] == "negotiation"
+    assert plan["first_bottleneck"]["hint"] == \
+        sm.CAPACITY_HINTS["negotiation"]
+
+
+def test_capacity_plan_validates_inputs():
+    with pytest.raises(ValueError):
+        sm.capacity_plan(0, control_plane_data=_plan_data())
+    with pytest.raises(ValueError):
+        sm.capacity_plan(64)  # no control-plane artifact: nothing honest
+
+
+def test_capacity_plan_restore_plane_never_saturates():
+    """The p2p restore shard SHRINKS as the world grows — the plane is
+    reported (with its fit residual) but can never be the bottleneck."""
+    with open(os.path.join(ARTIFACTS, "elastic_restore_r15.json"),
+              encoding="utf-8") as f:
+        restore = json.load(f)
+    small = sm.capacity_plan(64, model_bytes=1 << 30,
+                             control_plane_data=_plan_data(),
+                             restore_data=restore)
+    big = sm.capacity_plan(4096, model_bytes=1 << 30,
+                           control_plane_data=_plan_data(),
+                           restore_data=restore)
+    assert small["planes"]["restore"]["saturation_ranks"] is None
+    assert big["planes"]["restore"]["saturation_ranks"] is None
+    assert (big["planes"]["restore"]["predicted_seconds"]
+            <= small["planes"]["restore"]["predicted_seconds"])
+
+
+def test_capacity_plan_carries_fit_residual_as_uncertainty():
+    """Every extrapolated plane carries its own honesty number: the
+    worst model-vs-measured residual, scaled to the prediction."""
+    data = _plan_data()
+    data.update(sm.control_plane_report(
+        {int(n): r for n, r in sorted(data["control_plane"].items())},
+        relative=True))
+    plan = sm.capacity_plan(1024, control_plane_data=data,
+                            step_window_s=0.1)
+    neg = plan["planes"]["negotiation"]
+    assert neg["fit_residual"] is not None
+    assert neg["uncertainty_seconds"] == pytest.approx(
+        neg["predicted_seconds"] * neg["fit_residual"], abs=1e-6)
+
+
+def test_recommend_autotune_seeds_scales_with_negotiation_ratio():
+    cal = sm.ControlPlaneCalibration(
+        negotiation_base_s=0.0, negotiation_per_rank_s=0.0005,
+        reshape_base_s=0.0, reshape_per_rank_s=0.0,
+        heartbeat_base_s=0.0, heartbeat_per_rank_s=0.0, source="unit")
+    # At the reference size the seeds ARE the defaults (8 MiB / 256 KiB).
+    assert sm.recommend_autotune_seeds(cal, 64) == {
+        "bucket_bytes": 1 << 23, "ring_chunk_bytes": 1 << 18}
+    # 16x the negotiation cost: bucket grows with the ratio (clamped to
+    # the tuner's 64 MiB rail), chunk with its square root.
+    assert sm.recommend_autotune_seeds(cal, 1024) == {
+        "bucket_bytes": 1 << 26, "ring_chunk_bytes": 1 << 20}
+
+
+# ---------------------------------------------------------------------------
+# autotune priors (HOROVOD_AUTOTUNE_PRIORS=capacity)
+
+
+def test_autotune_capacity_priors_seed_first_probed_config(monkeypatch):
+    """The pin the satellite asks for: with priors armed, the tuner's
+    FIRST probed bucket/chunk configuration equals the planner's
+    recommendation for this world size — and an explicit env pin beats
+    the prior, exactly as it beats the resolved defaults."""
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.controller.autotune_glue import make_parameter_manager
+
+    for env in ("HOROVOD_BUCKET_BYTES", "HOROVOD_RING_CHUNK_BYTES"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_PRIORS", "capacity")
+    monkeypatch.setenv("HOROVOD_CAPACITY_CALIBRATION", ARTIFACT)
+    with open(ARTIFACT, encoding="utf-8") as f:
+        data = json.load(f)
+    want = sm.recommend_autotune_seeds(
+        sm.control_plane_from_artifact(data), 1024)
+    pm = make_parameter_manager(Config.from_env(), tune_bucket=True,
+                                tune_ring_chunk=True, world_size=1024)
+    assert pm.bucket_bytes == want["bucket_bytes"]
+    assert pm.ring_chunk_bytes == want["ring_chunk_bytes"]
+    assert "bucket_bytes" not in pm.fixed  # a seed, not a pin
+
+    monkeypatch.setenv("HOROVOD_BUCKET_BYTES", str(4 << 20))
+    pm2 = make_parameter_manager(Config.from_env(), tune_bucket=True,
+                                 tune_ring_chunk=True, world_size=1024)
+    assert pm2.bucket_bytes == 4 << 20 and "bucket_bytes" in pm2.fixed
+
+
+def test_autotune_priors_off_keeps_resolver_defaults(monkeypatch):
+    from horovod_tpu.common.config import DEFAULT_BUCKET_BYTES, Config
+    from horovod_tpu.controller.autotune_glue import make_parameter_manager
+
+    monkeypatch.delenv("HOROVOD_BUCKET_BYTES", raising=False)
+    monkeypatch.delenv("HOROVOD_AUTOTUNE_PRIORS", raising=False)
+    monkeypatch.setenv("HOROVOD_CAPACITY_CALIBRATION", ARTIFACT)
+    pm = make_parameter_manager(Config.from_env(), tune_bucket=True,
+                                world_size=1024)
+    assert pm.bucket_bytes == DEFAULT_BUCKET_BYTES
+    # Mode on but artifact unreadable: silently fall back, never crash.
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_PRIORS", "capacity")
+    monkeypatch.setenv("HOROVOD_CAPACITY_CALIBRATION", "/nonexistent.json")
+    pm2 = make_parameter_manager(Config.from_env(), tune_bucket=True,
+                                 world_size=1024)
+    assert pm2.bucket_bytes == DEFAULT_BUCKET_BYTES
+
+
+# ---------------------------------------------------------------------------
+# capacity_headroom doctor rule
+
+
+def _hist_entry(buckets, counts):
+    return {"type": "histogram", "buckets": list(buckets),
+            "values": [[[], {"counts": list(counts), "sum": 0.0,
+                             "count": sum(counts)}]]}
+
+
+def _gauge_entry(value):
+    return {"type": "gauge", "values": [[[], float(value)]]}
+
+
+def _headroom_evidence(cycle_counts=None, reshape_counts=None, world=64,
+                       calibrated=True):
+    """Synthetic evidence against the exact-linear calibration of
+    ``_plan_data`` (negotiation 0.5 ms/rank -> modeled 32 ms at world
+    64, so the 2x trip wire sits at 64 ms)."""
+    snap = {"hvd_membership_size": _gauge_entry(world)}
+    buckets = (0.01, 0.02, 0.05, 0.1, 1.0)
+    if cycle_counts is not None:
+        snap["hvd_controller_cycle_seconds"] = _hist_entry(
+            buckets, cycle_counts)
+    if reshape_counts is not None:
+        snap["hvd_elastic_reshape_seconds"] = _hist_entry(
+            buckets, reshape_counts)
+    return Evidence(
+        snapshots={0: snap},
+        capacity_calibration=_plan_data() if calibrated else None)
+
+
+def test_capacity_headroom_silent_on_healthy_job():
+    # 30 cycles all under 50 ms vs the 64 ms trip wire: no finding.
+    ev = _headroom_evidence(cycle_counts=[0, 0, 30, 0, 0, 0])
+    assert list(check_capacity_headroom(ev)) == []
+
+
+def test_capacity_headroom_fires_when_measured_2x_modeled():
+    ev = _headroom_evidence(cycle_counts=[0, 0, 0, 0, 30, 0])
+    findings = list(check_capacity_headroom(ev))
+    assert len(findings) == 1
+    d = findings[0]
+    assert d.rule == "capacity_headroom" and d.severity == "warning"
+    assert d.evidence["plane"] == "negotiation"
+    assert d.evidence["world_size"] == 64
+    assert d.evidence["factor"] >= CAPACITY_HEADROOM_FACTOR
+    assert d.evidence["modeled_seconds"] == pytest.approx(0.032, rel=1e-6)
+    assert "capacity_probe" in d.hint  # the re-calibration pointer
+
+
+def test_capacity_headroom_reshape_plane_and_min_samples():
+    # 2 slow reshapes: below the 3-observation floor, silent.
+    ev = _headroom_evidence(reshape_counts=[0, 0, 0, 0, 2, 0])
+    assert list(check_capacity_headroom(ev)) == []
+    # The third slow reshape crosses the floor: the rule names the plane.
+    ev3 = _headroom_evidence(reshape_counts=[0, 0, 0, 0, 3, 0])
+    findings = list(check_capacity_headroom(ev3))
+    assert [d.evidence["plane"] for d in findings] == ["reshape"]
+    # Thin cycle evidence is gated the same way (20-cycle floor).
+    thin = _headroom_evidence(cycle_counts=[0, 0, 0, 0, 10, 0])
+    assert list(check_capacity_headroom(thin)) == []
+
+
+def test_capacity_headroom_needs_calibration_and_world_size():
+    # No calibration artifact: nothing honest to compare against.
+    sick = [0, 0, 0, 0, 30, 0]
+    ev = _headroom_evidence(cycle_counts=sick, calibrated=False)
+    assert list(check_capacity_headroom(ev)) == []
+    # No hvd_membership_size abscissa: stand down too.
+    ev2 = _headroom_evidence(cycle_counts=sick)
+    del ev2.snapshots[0]["hvd_membership_size"]
+    assert list(check_capacity_headroom(ev2)) == []
+
+
+def test_capacity_headroom_registered_and_diagnosable():
+    assert check_capacity_headroom in ALL_RULES
+    assert "capacity_headroom" in RULE_SLUGS
+    ev = _headroom_evidence(cycle_counts=[0, 0, 0, 0, 30, 0])
+    assert any(d.rule == "capacity_headroom" for d in diagnose(ev))
+
+
+def test_evidence_picks_up_calibration_live_and_offline(monkeypatch,
+                                                        tmp_path):
+    monkeypatch.setenv("HOROVOD_CAPACITY_CALIBRATION", ARTIFACT)
+    live = Evidence.live()
+    assert live.capacity_calibration is not None
+    assert live.capacity_calibration.get("control_plane")
+    # Offline: a committed capacity artifact beside the traces is found.
+    with open(tmp_path / "capacity_r17.json", "w", encoding="utf-8") as f:
+        json.dump(_plan_data(), f)
+    offline = Evidence.from_artifacts(str(tmp_path))
+    assert offline.capacity_calibration == _plan_data()
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+
+
+def test_tools_capacity_cli_json_contract(capsys):
+    from horovod_tpu.tools.capacity import main
+
+    rc = main(["--ranks", "4096", "--model-bytes", str(1 << 30),
+               "--artifacts", ARTIFACTS, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    plan = json.loads(out)
+    assert set(plan["planes"]) == set(sm.CAPACITY_PLANES)
+    for name in sorted(plan["planes"]):
+        entry = plan["planes"][name]
+        assert "predicted_seconds" in entry and "hint" in entry
+        assert "fit_residual" in entry and "uncertainty_seconds" in entry
+    bottleneck = plan["first_bottleneck"]
+    assert bottleneck is not None
+    assert bottleneck["plane"] in sm.CAPACITY_PLANES
+    assert bottleneck["hint"] == sm.CAPACITY_HINTS[bottleneck["plane"]]
+    # The r17 artifact outranks the r13 fallback when both are present.
+    assert plan["artifacts"]["control_plane"].endswith("capacity_r17.json")
+
+
+def test_tools_capacity_cli_unreachable_artifacts_exit_2(tmp_path, capsys):
+    from horovod_tpu.tools.capacity import main
+
+    rc = main(["--ranks", "4096", "--artifacts", str(tmp_path)])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "capacity_probe" in err  # tells the operator how to measure
+
+
+def test_tools_capacity_cli_golden_text_report(capsys):
+    """The golden report over the committed artifacts: every plane
+    priced, the first bottleneck named with its operator hint. Pinned
+    to the committed r17 calibration, where the overlap-stall plane
+    (4 negotiation rounds inside the measured backward window) binds
+    first."""
+    from horovod_tpu.tools.capacity import main
+
+    rc = main(["--ranks", "4096", "--model-bytes", str(1 << 30),
+               "--artifacts", ARTIFACTS])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for plane in sm.CAPACITY_PLANES:
+        assert plane in out
+    assert "first bottleneck: overlap_stall" in out
+    assert "hint:" in out and "calibration:" in out
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the committed r17 artifact
+
+
+def test_capacity_artifact_model_vs_measured_gate():
+    """The acceptance bar (ISSUE 17): negotiation model-vs-measured
+    rel_err <= 10% at >= 4 sim-reachable sizes including at least one
+    threaded-driver size >= 512 ranks, protocheck zero. The committed
+    artifact clears it at EVERY recorded size, so this gate pins all
+    seven; the threaded rows (128/256/512 across 8 shard threads) ran
+    with the conformance monitor armed across all repeats."""
+    with open(ARTIFACT, encoding="utf-8") as f:
+        data = json.load(f)
+    sizes = data["world_sizes"]
+    assert len(sizes) >= 6 and max(sizes) >= 512
+    threaded = [n for n in sizes
+                if data["control_plane"][str(n)]["driver_threads"] > 1]
+    assert any(n >= 512 for n in threaded)
+    within = []
+    for n in sizes:
+        entry = data["model_vs_measured"][str(n)]
+        rel = entry["negotiate_step_seconds"]["rel_err"]
+        assert rel <= 0.10, (n, entry)
+        within.append(n)
+        if "reshape_seconds" in entry:
+            assert entry["reshape_seconds"]["rel_err"] <= 0.35, (n, entry)
+        assert entry["heartbeat_fanout_seconds"]["rel_err"] <= 0.35, \
+            (n, entry)
+        # Conformance armed at EVERY size, clean at every size.
+        row = data["control_plane"][str(n)]
+        assert row["protocheck_violations"] == 0, (n, row)
+        assert row["protocheck_transitions"] > 0
+        assert row["repeats"] >= 3  # median-of-repeats drift insurance
+    assert len(within) >= 4
+    assert any(n in threaded for n in within)
+
+
+def test_capacity_artifact_refit_and_embedded_plan():
+    """Self-consistency: re-fitting from the raw rows (honoring the
+    recorded relative-fit stamp) reproduces the committed calibration,
+    the curves carry real (strictly positive) per-rank costs, and the
+    embedded forward plan names a bottleneck from the fixed plane
+    vocabulary. Substrate honesty is recorded in the artifact itself."""
+    with open(ARTIFACT, encoding="utf-8") as f:
+        data = json.load(f)
+    assert data["fit"] == "relative"
+    refit = sm.control_plane_from_artifact(data)
+    cal = data["calibration"]
+    assert refit.negotiation_per_rank_s == pytest.approx(
+        cal["negotiation_per_rank_s"], rel=1e-6)
+    assert refit.reshape_per_rank_s == pytest.approx(
+        cal["reshape_per_rank_s"], rel=1e-6)
+    assert refit.negotiation_per_rank_s > 0
+    assert refit.reshape_per_rank_s > 0
+    plan = data["plan"]
+    assert plan["ranks"] == 4096
+    assert plan["first_bottleneck"]["plane"] in sm.CAPACITY_PLANES
+    assert set(plan["planes"]) == set(sm.CAPACITY_PLANES)
+    assert "loopback" in data["substrate"]  # not NIC latency
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the r13 seeded storm on the THREADED driver
+
+THREADED_STORM_PLAN = {"seed": 17, "faults": [
+    # flapping NIC: rank 5's ticks 30ms late for 30 cycles (>= the
+    # straggler rule's 20-sample / 10ms floors)
+    {"site": "cycle", "action": "delay", "rank": 5, "at": 1,
+     "times": 30, "seconds": 0.03},
+    {"site": "cycle", "action": "kill", "rank": 9, "at": 6},
+    {"site": "cycle", "action": "leave", "rank": 20, "at": 10},
+    # correlated rack failure: four ranks at once
+    {"site": "cycle", "action": "group_kill",
+     "ranks": [40, 41, 42, 43], "at": 14},
+    {"site": "cycle", "action": "join", "rank": 1, "at": 16},
+    {"site": "cycle", "action": "join", "rank": 1, "at": 18},
+    # the renumbered slot 9 dies AGAIN: the most-departed label
+    {"site": "cycle", "action": "kill", "rank": 9, "at": 22},
+]}
+
+
+def _threaded_storm(ranks, threads=8, steps=34):
+    driver = SimFaultDriver.from_json(json.dumps(THREADED_STORM_PLAN))
+    result = run_scenario(ranks, driver, steps=steps,
+                          driver_threads=threads)
+    assert result.ok, "\n".join(result.problems)
+    assert result.final_size == ranks - 5
+    assert result.final_epoch >= 6
+    assert result.transitions > 0 and not result.violations
+    stragglers = {f["rank"] for f in result.findings
+                  if f["rule"] == "persistent_straggler"}
+    assert 5 in stragglers, result.findings
+    churn = {f["rank"] for f in result.findings
+             if f["rule"] == "membership_churn"}
+    assert 9 in churn, result.findings
+    return result
+
+
+def test_sim_128_rank_threaded_storm_protocheck_zero():
+    """The r13 acceptance storm with the logical ranks sharded across
+    the named driver pool: same seeded join/leave chaos, same verdict —
+    epochs settle, collectives match live membership, protocheck sees
+    zero off-spec transitions on every wire, and the doctor names the
+    injected straggler and the most-departed rank."""
+    _threaded_storm(128)
+
+
+@pytest.mark.slow
+def test_sim_1024_rank_threaded_storm_protocheck_zero():
+    """The thousand-rank tentpole: the storm at 1024 logical ranks on
+    8 shard threads (the size the capacity planner extrapolates past,
+    made sim-reachable by the poll()-based wires and the pool)."""
+    _threaded_storm(1024)

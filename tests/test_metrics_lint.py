@@ -88,8 +88,11 @@ def test_known_series_present():
         "hvd_doctor_runs_total",
         "hvd_doctor_findings",
         "hvd_membership_epoch",
+        "hvd_membership_size",
         "hvd_membership_transitions_total",
         "hvd_membership_rank_departures_total",
+        "hvd_sim_logical_ranks",
+        "hvd_sim_driver_threads",
         "hvd_elastic_reshape_seconds",
         "hvd_elastic_restore_seconds",
         "hvd_elastic_restore_bytes_total",

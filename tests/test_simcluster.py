@@ -373,6 +373,25 @@ def test_sim_correlated_rack_kill_settles_through_retry():
         {"8", "9", "10", "11"}
 
 
+def test_sim_response_cache_hits_under_repeated_tensor_workload():
+    """Satellite (r17): SimWorkers replicate the coordinator's
+    response-cache bitmask, so a repeated-tensor workload takes the
+    cache fast path end-to-end — the first step negotiates (a miss per
+    rank), every later step's tick carries the cached bit and the
+    coordinator's hit counter moves, while the collectives stay exact."""
+    with SimCluster(ranks=6, elastic=True) as c:
+        for _ in range(6):
+            res = c.run_step([allreduce_spec(
+                "same.tensor", lambda r: np.ones(4, np.float32))])
+            assert float(res.results0["same.tensor"][0]) == 6.0
+    hits = counter_by_label(c.final_metrics,
+                            "hvd_controller_cache_hits_total")
+    misses = counter_by_label(c.final_metrics,
+                              "hvd_controller_cache_misses_total")
+    assert sum(misses[k] for k in sorted(misses)) >= 1, (hits, misses)
+    assert sum(hits[k] for k in sorted(hits)) >= 4, (hits, misses)
+
+
 # ---------------------------------------------------------------------------
 # acceptance: the seeded storm (ISSUE 13 headline)
 
