@@ -754,10 +754,189 @@ def supervisor_full():
     return 0 if ok == len(rows) else 3
 
 
+# --------------------------------------------------------------------------
+# --check-trend: the regression sentinel (docs/capacity.md "Live
+# recalibration"). A fresh suite run writes its artifacts into a scratch
+# directory (--out into DIR instead of artifacts/); this mode then compares
+# each freshly written ``<family>_r<N>.json`` against its newest COMMITTED
+# sibling (same file name when committed, else the highest-round file of
+# the same family) within a per-metric tolerance table, prints one verdict
+# line per compared metric, and exits 1 on any regression. Tolerances are
+# deliberately loose: these are loopback-TCP shared-GIL measurements that
+# swing tens of percent between runs (sim/measure.py) — the sentinel
+# catches step-function regressions, not noise.
+# --------------------------------------------------------------------------
+
+# family -> ((label, path, direction, tolerance_fraction), ...)
+# ``path`` is a dotted path into the artifact JSON, or a (numerator,
+# denominator) pair of dotted paths for ratio metrics. ``direction`` is
+# which way the metric is allowed to move: "lower" metrics regress when
+# current > baseline * (1 + tol); "higher" metrics regress when
+# current < baseline * (1 - tol).
+TREND_TOLERANCES = {
+    "capacity": (
+        ("negotiation_per_rank_s",
+         "calibration.negotiation_per_rank_s", "lower", 0.50),
+        ("reshape_per_rank_s",
+         "calibration.reshape_per_rank_s", "lower", 0.50),
+        ("heartbeat_per_rank_s",
+         "calibration.heartbeat_per_rank_s", "lower", 0.50),
+    ),
+    "simcluster": (
+        ("negotiation_per_rank_s",
+         "calibration.negotiation_per_rank_s", "lower", 0.50),
+        ("reshape_per_rank_s",
+         "calibration.reshape_per_rank_s", "lower", 0.50),
+    ),
+    "overlap": (
+        ("overlap_efficiency",
+         "median_step_report.overlap_efficiency", "higher", 0.15),
+    ),
+    "elastic_restore": (
+        ("restore_mean_s",
+         ("hvd_elastic_restore_seconds.sum",
+          "hvd_elastic_restore_seconds.count"), "lower", 0.50),
+    ),
+    "serving": (
+        ("tokens_per_s", "value", "higher", 0.30),
+    ),
+    "allreduce_bandwidth": (
+        ("best_bf16_GB_s_16mib",
+         "best_by_size_and_wire.16mib_bf16.effective_GB_s", "higher", 0.30),
+    ),
+}
+
+
+def _trend_family(filename):
+    """``capacity_r17.json`` -> ``("capacity", 17)``; None for files
+    outside the ``<family>_r<N>.json`` convention."""
+    import re
+
+    m = re.match(r"(.+)_r(\d+)\.json$", os.path.basename(filename))
+    if not m:
+        return None
+    return m.group(1), int(m.group(2))
+
+
+def _trend_value(data, path):
+    """Resolve a dotted path (or a (num, den) ratio pair) to a float;
+    None when any hop is missing or non-numeric."""
+    if isinstance(path, tuple):
+        num = _trend_value(data, path[0])
+        den = _trend_value(data, path[1])
+        if num is None or den is None or den == 0:
+            return None
+        return num / den
+    node = data
+    for key in path.split("."):
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def _trend_baseline_path(current_name, baseline_dir):
+    """The committed artifact to judge against: the same file name when
+    committed, else the newest (highest round) of the same family."""
+    import glob
+
+    exact = os.path.join(baseline_dir, os.path.basename(current_name))
+    if os.path.exists(exact):
+        return exact
+    fam = _trend_family(current_name)
+    if fam is None:
+        return None
+    candidates = []
+    for path in glob.glob(os.path.join(baseline_dir, f"{fam[0]}_r*.json")):
+        parsed = _trend_family(path)
+        if parsed is not None and parsed[0] == fam[0]:
+            candidates.append((parsed[1], path))
+    if not candidates:
+        return None
+    return max(candidates)[1]
+
+
+def check_trend(current_dir, baseline_dir="artifacts"):
+    """Compare every ``*_r*.json`` under ``current_dir`` against its
+    committed sibling. One verdict line per metric; returns the exit
+    code (1 on any regression, 0 otherwise — including the degenerate
+    no-comparable-artifacts run, which is reported but not failed)."""
+    import glob
+
+    regressions = 0
+    compared = 0
+    for current_path in sorted(glob.glob(
+            os.path.join(current_dir, "*_r*.json"))):
+        fam = _trend_family(current_path)
+        if fam is None or fam[0] not in TREND_TOLERANCES:
+            continue
+        baseline_path = _trend_baseline_path(current_path, baseline_dir)
+        if baseline_path is None:
+            print(f"trend {os.path.basename(current_path)}: skip "
+                  f"(no committed {fam[0]}_r*.json under {baseline_dir})")
+            continue
+        try:
+            with open(current_path) as f:
+                current = json.load(f)
+            with open(baseline_path) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"trend {os.path.basename(current_path)}: skip "
+                  f"(unreadable: {exc})")
+            continue
+        for label, path, direction, tol in TREND_TOLERANCES[fam[0]]:
+            cur = _trend_value(current, path)
+            base = _trend_value(baseline, path)
+            name = f"{os.path.basename(current_path)}:{label}"
+            if cur is None or base is None:
+                print(f"trend {name}: skip (metric absent in "
+                      f"{'current' if cur is None else 'baseline'})")
+                continue
+            compared += 1
+            if direction == "lower":
+                bad = cur > base * (1.0 + tol)
+                moved = (cur / base - 1.0) if base else float("inf")
+            else:
+                bad = cur < base * (1.0 - tol)
+                moved = (1.0 - cur / base) if base else float("inf")
+            verdict = "REGRESSION" if bad else "ok"
+            if bad:
+                regressions += 1
+            print(f"trend {name}: {verdict} current={cur:.6g} "
+                  f"baseline={base:.6g} ({direction} is better, "
+                  f"moved {moved:+.1%}, tolerance {tol:.0%}, "
+                  f"vs {os.path.basename(baseline_path)})")
+    print(f"trend: {compared} metric(s) compared, "
+          f"{regressions} regression(s)")
+    return 1 if regressions else 0
+
+
+def _check_trend_main(argv):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python bench.py --check-trend",
+        description="compare a fresh run's artifacts against the newest "
+                    "committed *_r*.json siblings")
+    parser.add_argument("current", help="directory holding the fresh "
+                        "run's *_r*.json artifacts")
+    parser.add_argument("--baseline", default="artifacts",
+                        help="committed artifacts directory "
+                             "(default: artifacts/)")
+    args = parser.parse_args(argv)
+    return check_trend(args.current, args.baseline)
+
+
 if __name__ == "__main__":
     mode = os.environ.get("BENCH_CHILD")
     if mode:
         child_main(mode)
+    elif "--check-trend" in sys.argv[1:]:
+        argv = list(sys.argv[1:])
+        argv.remove("--check-trend")
+        sys.exit(_check_trend_main(argv))
     elif "--full" in sys.argv[1:]:
         sys.exit(supervisor_full())
     else:

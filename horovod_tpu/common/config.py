@@ -511,6 +511,38 @@ def capacity_calibration_path() -> Optional[str]:
     return val.strip() if val and val.strip() else None
 
 
+def metrics_window_seconds() -> float:
+    """``HOROVOD_METRICS_WINDOW_SECONDS``: how long one rolling
+    telemetry window lasts (docs/metrics.md). The rank-0 window roller
+    delta-snapshots the cluster view at this cadence into a bounded
+    ring (last 32 windows), feeding the windowed doctor rules and the
+    live calibration re-fit (docs/capacity.md). Garbage/non-positive
+    falls back to the default 30s."""
+    val = _env_float("HOROVOD_METRICS_WINDOW_SECONDS", 30.0)
+    return val if val > 0 else 30.0
+
+
+def capacity_refit_windows() -> int:
+    """``HOROVOD_CAPACITY_REFIT_WINDOWS``: telemetry windows between
+    live-calibration re-fits (docs/capacity.md) — every N completed
+    windows rank 0 re-fits the control-plane curves from the windowed
+    histograms and, when ``HOROVOD_CAPACITY_LIVE_DIR`` is set, rewrites
+    ``capacity_live.json``. Minimum/garbage clamps to 1; default 8."""
+    val = _env_int("HOROVOD_CAPACITY_REFIT_WINDOWS", 8)
+    return max(1, val)
+
+
+def capacity_live_dir() -> Optional[str]:
+    """``HOROVOD_CAPACITY_LIVE_DIR``: directory where rank 0 persists
+    ``capacity_live.json`` — the live re-fit of the control-plane
+    calibration in the exact ``capacity_r17.json`` schema, stamped
+    ``"source": "live"`` (docs/capacity.md). Written on every
+    ``HOROVOD_CAPACITY_REFIT_WINDOWS``-th window and at shutdown.
+    Unset (default): the live re-fit stays in memory only."""
+    val = env_str("HOROVOD_CAPACITY_LIVE_DIR")
+    return val.strip() if val and val.strip() else None
+
+
 def serving_max_batch() -> int:
     """``HOROVOD_SERVING_MAX_BATCH``: decode-batch slots in the serving
     engine — the most sequences one continuous-batching decode step

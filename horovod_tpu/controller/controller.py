@@ -371,6 +371,11 @@ class Controller:
         self._doctor_thread: Optional[threading.Thread] = None
         self._autotune_steps_pub: Optional[int] = None
         self._publish_tuner = None
+        # One-shot latch for the calibration_drift -> autotune re-seed
+        # (HOROVOD_AUTOTUNE_PRIORS=capacity, docs/capacity.md): the GP is
+        # re-seeded from the live curves at most once per job. Written
+        # and read only on the doctor-sweep thread (sweeps never stack).
+        self._live_reseed_done = False
         if config.autotune and topology.rank == 0:
             from .autotune_glue import (
                 make_parameter_manager,
@@ -441,6 +446,17 @@ class Controller:
             em = _elastic_metrics()
             em.epoch.set(self._epoch)
             em.size.set(self.topo.size)
+            if self.topo.rank == 0:
+                # Rank-0 live-calibration plane (docs/capacity.md): the
+                # window roller delta-snapshots the cluster view every
+                # HOROVOD_METRICS_WINDOW_SECONDS, and each completed
+                # window feeds the in-job capacity re-fit so the doctor's
+                # calibration_drift rule judges live slopes, not stale
+                # committed ones.
+                from ..utils import live_calibration
+
+                roller = metrics.start_window_roller()
+                roller.add_observer(live_calibration.on_window)
 
         # Cluster tracing (docs/tracing.md): per-rank clock-anchored span
         # writer, a coordinator-assigned sequence id per fused op carried
@@ -752,6 +768,23 @@ class Controller:
                             self.cfg.trace_dir, OFFSETS_FILE))
                 except Exception:
                     pass  # tracing must never mask the real teardown
+            if self.topo.rank == 0 and metrics.on():
+                # Flush the live-calibration plane before the telemetry
+                # stack goes away: close the tail window (a job shorter
+                # than one interval still yields a re-fit), persist
+                # capacity_live.json when HOROVOD_CAPACITY_LIVE_DIR is
+                # set, and stop the roller thread. Best-effort — the
+                # teardown below must run regardless.
+                try:
+                    from ..utils import live_calibration
+
+                    roller = metrics.window_roller()
+                    if roller is not None:
+                        roller.roll_now()
+                    live_calibration.persist_on_shutdown()
+                except Exception:
+                    pass
+                metrics.stop_window_roller()
             for ring in (self._ring, self._local_ring, self._cross_ring):
                 if ring is not None:
                     ring.shutdown()
@@ -1180,12 +1213,38 @@ class Controller:
                               + rep["counts"]["warning"]) > 0
                 log = logging.warning if actionable else logging.info
                 log("doctor: %s", doctor.periodic_line(rep=rep))
+                self._maybe_reseed_from_drift(rep)
             except Exception as exc:
                 logging.debug("doctor sweep failed: %s", exc)
 
         self._doctor_thread = threading.Thread(
             target=sweep, name="hvd-doctor", daemon=True)
         self._doctor_thread.start()
+
+    def _maybe_reseed_from_drift(self, rep: dict) -> None:
+        """Close the loop on a confirmed ``calibration_drift`` finding:
+        with HOROVOD_AUTOTUNE_PRIORS=capacity and the search still
+        exploring, re-seed the GP ONCE per job from the live re-fit's
+        curves (autotune_glue.reseed_from_live). Runs on the doctor-sweep
+        thread (never stacked), so the latch needs no lock."""
+        if self._live_reseed_done or self._param_manager is None:
+            return
+        from ..common.config import autotune_priors
+
+        if autotune_priors() != "capacity":
+            return
+        if not any(f.get("rule") == "calibration_drift"
+                   for f in rep.get("findings", [])):
+            return
+        from .autotune_glue import reseed_from_live
+
+        self._live_reseed_done = True
+        applied = reseed_from_live(self._param_manager, self.topo.size)
+        if applied:
+            logging.warning(
+                "calibration drift confirmed: autotune search re-seeded "
+                "from the live capacity curves (%s)",
+                ", ".join(f"{k}={v}" for k, v in sorted(applied.items())))
 
     # ----------------------------------------------------------- both sides
 

@@ -49,6 +49,15 @@ class Evidence:
     # runs pick up a committed capacity/simcluster artifact beside the
     # traces when one exists.
     capacity_calibration: Optional[dict] = None
+    # Completed telemetry windows (metrics.windows() shape, oldest
+    # first): the windowed rules judge the RECENT windows instead of
+    # lifetime-cumulative snapshots whenever any exist.
+    windows: List[dict] = dataclasses.field(default_factory=list)
+    # Live-calibration summary (utils/live_calibration.py
+    # LiveCalibration.summary() shape) for the calibration_drift rule.
+    # Live jobs carry the in-process re-fit; offline runs rebuild an
+    # equivalent summary from a persisted capacity_live.json.
+    live_calibration: Optional[dict] = None
     # "live" or "artifacts:<dir>" — recorded in the report for operators.
     source: str = "live"
 
@@ -63,6 +72,8 @@ class Evidence:
             restart_epoch,
         )
 
+        from ..utils import live_calibration
+
         local = env_rank() or 0
         snapshots = {local: metrics.snapshot()}
         for rank, snap in sorted(metrics.remote_snapshots().items()):
@@ -72,7 +83,10 @@ class Evidence:
         if cal_path:
             calibration = _load_json(cal_path)
         return cls(snapshots=snapshots, restart_epoch=restart_epoch(),
-                   capacity_calibration=calibration, source="live")
+                   capacity_calibration=calibration,
+                   windows=metrics.windows(),
+                   live_calibration=live_calibration.live_summary(),
+                   source="live")
 
     @classmethod
     def from_artifacts(cls, path: str) -> "Evidence":
@@ -124,9 +138,19 @@ class Evidence:
             if loaded and loaded.get("control_plane"):
                 calibration = loaded
                 break
+        # A dead job's persisted live re-fit (capacity_live.json) lets
+        # the drift rule run offline against the committed calibration
+        # found beside it.
+        live_summary = None
+        live_artifact = _load_json(os.path.join(path, "capacity_live.json"))
+        if live_artifact is not None:
+            from ..utils.live_calibration import summary_from_artifact
+
+            live_summary = summary_from_artifact(live_artifact)
         return cls(straggler_report=report, clock=clock,
                    postmortems=postmortems, restart_epoch=restarts,
                    capacity_calibration=calibration,
+                   live_calibration=live_summary,
                    source=f"artifacts:{path}")
 
     def ranks_observed(self) -> List[int]:

@@ -40,6 +40,7 @@ from .registry import (  # noqa: F401
     log_buckets,
     quantile,
     render_prometheus,
+    subtract_snapshots,
 )
 
 __all__ = [
@@ -50,6 +51,9 @@ __all__ = [
     "record_sampled_event", "dump_flight_recorder", "flight_recorder_path",
     "controller_health", "push_cycles", "quantile", "render_prometheus",
     "log_buckets", "start_exporter", "reset_for_tests", "expand_rank_path",
+    "WindowRoller", "windows", "window_roller", "start_window_roller",
+    "stop_window_roller", "set_mark", "snapshot_delta",
+    "subtract_snapshots",
 ]
 
 # Tri-state enabled cache. Unlike horovod_tpu.fault's per-call pid check,
@@ -121,11 +125,19 @@ def reset_for_tests() -> None:
     from types import SimpleNamespace
 
     global _on, _recorder
+    stop_window_roller()
     with _lock:
         _on = None
         _recorder = None
         _remote.clear()
     _registry.clear()
+    # Live-calibration state (utils/live_calibration.py) accumulates
+    # per-window samples off the roller; a cleared registry makes those
+    # orphans too. Only touch the module if something already imported
+    # it — reset must not grow the import graph.
+    live_cal = sys.modules.get("horovod_tpu.utils.live_calibration")
+    if live_cal is not None:
+        live_cal.reset_for_tests()
     for name, mod in list(sys.modules.items()):
         if not name.startswith("horovod_tpu") or mod is None:
             continue
@@ -421,14 +433,225 @@ def remote_snapshots() -> Dict[int, Dict[str, dict]]:
         return dict(_remote)
 
 
-def render_all() -> str:
+def render_all(query: str = "") -> str:
     """Prometheus exposition of the local registry plus every ingested
     remote snapshot — what the scrape endpoint serves. Goes through
     snapshot() so a scrape always carries the freshly mirrored
     hvd_ring_* / hvd_native_* native counters (under the native engine
-    nothing else calls snapshot() periodically)."""
+    nothing else calls snapshot() periodically).
+
+    ``?window=recent`` on the scrape URL renders the most recent
+    completed telemetry window's DELTAS instead of the lifetime totals
+    (docs/metrics.md): counters and histogram buckets show only what
+    happened inside the window, gauges their current level."""
+    if query:
+        from urllib.parse import parse_qs
+
+        if parse_qs(query).get("window") == ["recent"]:
+            recent = windows()
+            if not recent:
+                return ("# no completed telemetry window yet "
+                        "(HOROVOD_METRICS_WINDOW_SECONDS rolls them; "
+                        "lifetime totals at /metrics)\n")
+            snaps = dict(recent[-1]["snapshots"])
+            rank = _local_rank() or 0
+            local = snaps.pop(rank, {})
+            return render_prometheus(local, _local_rank(), snaps)
     return render_prometheus(snapshot(), _local_rank(),
                              remote_snapshots())
+
+
+def set_mark(mark: str) -> Dict[str, dict]:
+    """(Re)set a named watermark on the default registry at the current
+    totals (native mirrors refreshed first, like :func:`snapshot`)."""
+    refresh_ring_wire_metrics()
+    refresh_native_engine_metrics()
+    return _registry.set_mark(mark)
+
+
+def snapshot_delta(mark: str) -> Dict[str, dict]:
+    """Per-metric deltas since :func:`set_mark`'s watermark — counters
+    and histogram buckets subtract, gauges pass through. A mark never
+    set reads as a mark at process start (full snapshot)."""
+    refresh_ring_wire_metrics()
+    refresh_native_engine_metrics()
+    return _registry.snapshot_delta(mark)
+
+
+class WindowRoller:
+    """Rank-0 background thread (``hvd-metrics-window``) that rolls the
+    cluster's telemetry into fixed-duration delta windows: every
+    ``interval_s`` it snapshots the local registry plus every
+    piggybacked worker snapshot, subtracts the previous roll's totals
+    (:func:`subtract_snapshots`), and appends one window record —
+    ``{"index", "start", "end", "duration_seconds", "snapshots":
+    {rank: delta}}`` — to a bounded ring of the last ``capacity``
+    windows. The doctor's windowed rules and the live-calibration
+    re-fit (docs/capacity.md) consume the ring via
+    :func:`windows`; observers run synchronously after each roll.
+
+    Locking (the r14/r15 lesson): the ring/baseline lock guards only
+    call-free dict/deque swaps; snapshot gathering and delta math run
+    outside it, serialized by a dedicated roll lock so a manual
+    :meth:`roll_now` never interleaves with the timer thread."""
+
+    def __init__(self, interval_s: float = 30.0, capacity: int = 32):
+        import collections
+
+        self.interval_s = max(0.05, float(interval_s))
+        self._lock = make_lock("metrics.window")
+        self._roll_lock = make_lock("metrics.window.roll")
+        self._ring = collections.deque(maxlen=max(1, int(capacity)))
+        self._prev: Dict[int, Dict[str, dict]] = {}
+        self._prev_time = 0.0
+        self._index = 0
+        self._observers: list = []
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        """Prime the baseline at now and launch the timer thread
+        (idempotent)."""
+        import time
+
+        with self._roll_lock:
+            baseline = self._gather()
+            with self._lock:
+                if not self._prev:
+                    self._prev = baseline
+                    # Window boundaries are wall stamps (read next to
+                    # logs/dashboards). hvdlint: disable=HVD004
+                    self._prev_time = time.time()
+        if self._thread is None or not self._thread.is_alive():
+            self._stop_event.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="hvd-metrics-window", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        thread = self._thread
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=2.0)
+        self._thread = None
+
+    def add_observer(self, fn) -> None:
+        """``fn(window_record)`` after every roll (same thread as the
+        roll; exceptions are swallowed to a debug line — telemetry must
+        never kill the job it observes). Idempotent by identity, so a
+        restarted controller re-registering the live-calibration feed
+        never double-ingests a window."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def windows(self) -> list:
+        """Completed windows, oldest first (up to ``capacity``)."""
+        with self._lock:
+            return list(self._ring)
+
+    @staticmethod
+    def _gather() -> Dict[int, Dict[str, dict]]:
+        rank = _local_rank() or 0
+        current = {rank: snapshot()}
+        for r, snap in remote_snapshots().items():
+            if int(r) != rank:
+                current[int(r)] = snap
+        return current
+
+    def roll_now(self) -> dict:
+        """Close the current window synchronously and return its record
+        (tests and the sim harness roll deterministically instead of
+        waiting out the interval)."""
+        import time
+
+        with self._roll_lock:
+            current = self._gather()
+            now = time.time()  # hvdlint: disable=HVD004 (wall stamp)
+            with self._lock:
+                prev = self._prev
+                prev_time = self._prev_time
+                self._prev = current
+                self._prev_time = now
+                index = self._index
+                self._index += 1
+            deltas = {r: subtract_snapshots(snap, prev.get(r, {}))
+                      for r, snap in sorted(current.items())}
+            window = {
+                "index": index,
+                "start": prev_time,
+                "end": now,
+                "duration_seconds": max(0.0, now - prev_time),
+                "snapshots": deltas,
+            }
+            with self._lock:
+                self._ring.append(window)
+                observers = list(self._observers)
+        if on():
+            counter("hvd_metrics_windows_total",
+                    "Telemetry windows the rank-0 roller has completed "
+                    "(each one delta-snapshots the whole cluster view)"
+                    ).inc()
+        for fn in observers:
+            try:
+                fn(window)
+            except Exception as exc:
+                from ..common import hvd_logging as logging
+
+                logging.debug("window observer failed: %r", exc)
+        return window
+
+    def _loop(self) -> None:
+        while not self._stop_event.wait(self.interval_s):
+            try:
+                self.roll_now()
+            except Exception as exc:
+                from ..common import hvd_logging as logging
+
+                logging.debug("window roll failed: %r", exc)
+
+
+_roller: Optional[WindowRoller] = None
+
+
+def window_roller() -> Optional[WindowRoller]:
+    """The process's roller, if one was started (rank 0 only)."""
+    with _lock:
+        return _roller
+
+
+def start_window_roller(interval_s: Optional[float] = None,
+                        capacity: int = 32) -> WindowRoller:
+    """Start (or return) the process-wide window roller. Interval
+    defaults to ``HOROVOD_METRICS_WINDOW_SECONDS`` (30s)."""
+    global _roller
+    from ..common.config import metrics_window_seconds
+
+    if interval_s is None:
+        interval_s = metrics_window_seconds()
+    with _lock:
+        roller = _roller
+        if roller is None:
+            roller = WindowRoller(interval_s, capacity=capacity)
+            _roller = roller
+    roller.start()
+    return roller
+
+
+def stop_window_roller() -> None:
+    global _roller
+    with _lock:
+        roller = _roller
+        _roller = None
+    if roller is not None:
+        roller.stop()
+
+
+def windows() -> list:
+    """Completed telemetry windows (oldest first); empty when no roller
+    ran — callers fall back to lifetime snapshots."""
+    roller = window_roller()
+    return roller.windows() if roller is not None else []
 
 
 def push_cycles() -> int:

@@ -50,10 +50,18 @@ class MetricsExporter:
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/metrics":
-                        ctype, body = CONTENT_TYPE, exporter._render()
+                        # Query-aware renders (``?window=recent`` — the
+                        # windowed-telemetry view, docs/metrics.md) only
+                        # for callables that declare a parameter; legacy
+                        # zero-arg renders keep their exact contract.
+                        if query and exporter._render_takes_query:
+                            body = exporter._render(query)
+                        else:
+                            body = exporter._render()
+                        ctype = CONTENT_TYPE
                     elif path in exporter._routes:
                         ctype, body = exporter._routes[path]()
                     else:
@@ -74,6 +82,13 @@ class MetricsExporter:
                 pass
 
         self._render = render
+        try:
+            import inspect
+
+            self._render_takes_query = bool(
+                inspect.signature(render).parameters)
+        except (TypeError, ValueError):
+            self._render_takes_query = False
         self._routes = dict(routes or {})
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True

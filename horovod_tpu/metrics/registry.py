@@ -202,6 +202,57 @@ class Histogram(_Metric):
         return snap
 
 
+def subtract_snapshots(current: Dict[str, dict],
+                       baseline: Dict[str, dict]) -> Dict[str, dict]:
+    """Pure delta algebra over two :meth:`MetricsRegistry.snapshot`
+    dicts: counters subtract per label set (a label set absent from the
+    baseline subtracts an implicit zero — it was born inside the
+    window), histogram ``counts``/``sum``/``count`` subtract
+    element-wise, and gauges pass the CURRENT value through (a gauge is
+    a level, not a flow — "delta of membership size" is not a thing an
+    operator wants). Metrics absent from the baseline appear whole.
+    Inputs are never mutated; the result is a fresh snapshot-shaped
+    dict, so windowed and lifetime views travel the same pipelines
+    (quantile(), render_prometheus(), the doctor rules)."""
+    out: Dict[str, dict] = {}
+    for name, entry in current.items():
+        kind = entry.get("type")
+        base = baseline.get(name)
+        if (kind == "gauge" or base is None or base.get("type") != kind):
+            out[name] = {**entry,
+                         "values": [[list(k), _copy_value(v)]
+                                    for k, v in entry.get("values", [])]}
+            continue
+        base_by_labels = {tuple(k): v for k, v in base.get("values", [])}
+        values = []
+        for labelvalues, value in entry.get("values", []):
+            prev = base_by_labels.get(tuple(labelvalues))
+            if kind == "histogram":
+                prev = prev or {"counts": [], "sum": 0.0, "count": 0}
+                prev_counts = list(prev.get("counts", []))
+                cur_counts = value["counts"]
+                prev_counts += [0] * (len(cur_counts) - len(prev_counts))
+                delta = {
+                    "counts": [c - p for c, p
+                               in zip(cur_counts, prev_counts)],
+                    "sum": value["sum"] - prev.get("sum", 0.0),
+                    "count": value["count"] - prev.get("count", 0),
+                }
+            else:
+                delta = value - (prev or 0.0)
+            values.append([list(labelvalues), delta])
+        out[name] = {**entry, "values": values}
+    return out
+
+
+def _copy_value(value):
+    if isinstance(value, dict):  # histogram child value
+        return {"counts": list(value.get("counts", [])),
+                "sum": value.get("sum", 0.0),
+                "count": value.get("count", 0)}
+    return value
+
+
 class MetricsRegistry:
     """Name -> metric, with get-or-create registration. A name re-registered
     with a different kind or label set is a programming error and raises —
@@ -211,6 +262,11 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = make_lock("metrics.registry")
         self._metrics: Dict[str, _Metric] = {}
+        # Named watermarks for windowed delta snapshots: mark name ->
+        # the full snapshot taken when the mark was (re)set. Marks are
+        # independent — two callers rolling their own marks never see
+        # each other's baselines.
+        self._marks: Dict[str, Dict[str, dict]] = {}
 
     def _get_or_create(self, cls, name: str, help: str,
                        labelnames: Sequence[str], **kw) -> _Metric:
@@ -266,9 +322,12 @@ class MetricsRegistry:
             return self._metrics.get(name)
 
     def clear(self) -> None:
-        """Drop every registered metric (tests only)."""
+        """Drop every registered metric AND every watermark (tests
+        only) — a stale mark over a fresh registry would subtract a
+        dead process's totals."""
         with self._lock:
             self._metrics.clear()
+            self._marks.clear()
 
     def snapshot(self) -> Dict[str, dict]:
         """Plain-dict view of every series; JSON/pickle-clean, so it rides
@@ -276,6 +335,32 @@ class MetricsRegistry:
         with self._lock:
             metrics = list(self._metrics.values())
         return {m.name: m.snapshot() for m in metrics}
+
+    def set_mark(self, mark: str) -> Dict[str, dict]:
+        """(Re)set a named watermark at the current totals and return
+        the snapshot it captured. The next :meth:`snapshot_delta` with
+        this mark reports only what happened after this moment."""
+        snap = self.snapshot()
+        with self._lock:
+            self._marks[mark] = snap
+        return snap
+
+    def drop_mark(self, mark: str) -> None:
+        with self._lock:
+            self._marks.pop(mark, None)
+
+    def snapshot_delta(self, mark: str) -> Dict[str, dict]:
+        """Per-metric deltas since the named watermark
+        (:func:`subtract_snapshots`: counters/histograms subtract,
+        gauges pass through). A mark never set behaves as a mark set at
+        process start — the delta since an all-zero baseline is the
+        full snapshot."""
+        current = self.snapshot()
+        with self._lock:
+            baseline = self._marks.get(mark)
+        if baseline is None:
+            return subtract_snapshots(current, {})
+        return subtract_snapshots(current, baseline)
 
 
 # ---------------------------------------------------------------------------

@@ -750,6 +750,22 @@ class SimCluster:
                 out.extend([float(val.get("sum", 0.0)) / count] * count)
         return out
 
+    def roll_window(self) -> Optional[dict]:
+        """Close one telemetry window deterministically (the rank-0
+        roller's ``roll_now``, docs/metrics.md): tests and the
+        measurement harness roll at step boundaries instead of waiting
+        out HOROVOD_METRICS_WINDOW_SECONDS. The real coordinator already
+        started the roller and registered the live-calibration observer
+        at init (both idempotent — re-arming here only covers a cluster
+        whose controller predates the roller). None with metrics off."""
+        if not (self.enable_metrics and metrics.on()):
+            return None
+        from ..utils import live_calibration
+
+        roller = metrics.start_window_roller()
+        roller.add_observer(live_calibration.on_window)
+        return roller.roll_now()
+
     def doctor_report(self) -> dict:
         """The live cluster doctor over this process's registry — the
         same Evidence path the rank-0 periodic sweep and /doctor use."""

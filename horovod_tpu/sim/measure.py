@@ -58,7 +58,8 @@ def measure_world_size(ranks: int, cycles: int = 30,
                        payload_elems: int = 16,
                        reshape: bool = True,
                        driver_threads: int = 1,
-                       protocheck: bool = False) -> dict:
+                       protocheck: bool = False,
+                       roll_window: bool = False) -> dict:
     """One world size's control-plane row (see module docstring).
     Tensor names are unique per step, so every measured cycle takes the
     full negotiation path even with the response cache armed;
@@ -66,7 +67,12 @@ def measure_world_size(ranks: int, cycles: int = 30,
     reachable (the coordinator walk being measured is unchanged).
     ``protocheck`` arms the wire-conformance monitor and records its
     violation count in the row — the capacity probe's proof that the
-    threaded driver stayed on-spec at the size it calibrated."""
+    threaded driver stayed on-spec at the size it calibrated.
+    ``roll_window`` closes one telemetry window over the measured cycles
+    (docs/capacity.md "Live recalibration"): the live-calibration plane
+    then ingests exactly this measurement, and a run launched with
+    HOROVOD_CAPACITY_LIVE_DIR leaves a comparable capacity_live.json
+    beside the committed artifact."""
     cluster = SimCluster(ranks=ranks, elastic=True, protocheck=protocheck,
                          enable_metrics=True,
                          driver_threads=driver_threads)
@@ -91,6 +97,11 @@ def measure_world_size(ranks: int, cycles: int = 30,
             observed = cluster.reshape_seconds_observed()
             if observed:
                 reshape_s = observed[-1]
+        window_index = None
+        if roll_window:
+            window = cluster.roll_window()
+            if window is not None:
+                window_index = window["index"]
         row = {
             "ranks": ranks,
             "cycles": cycles,
@@ -100,6 +111,8 @@ def measure_world_size(ranks: int, cycles: int = 30,
             "heartbeat_fanout_seconds": hb,
             "reshape_seconds": reshape_s,
         }
+        if window_index is not None:
+            row["telemetry_window"] = window_index
     finally:
         cluster.stop()
     if protocheck:

@@ -21,6 +21,11 @@ Examples::
     # machine-readable plan (CI, dashboards)
     python -m horovod_tpu.tools.capacity --ranks 4096 --json
 
+    # plan from a live job's in-flight re-fit (capacity_live.json,
+    # persisted by the rank-0 window roller — docs/capacity.md)
+    python -m horovod_tpu.tools.capacity --ranks 4096 \\
+        --live "$HOROVOD_CAPACITY_LIVE_DIR"
+
 Substrate honesty (docs/capacity.md): the calibrations are loopback-TCP
 shared-GIL measurements — they price the coordinator's per-rank walk
 costs, not NIC latency. The plan stamps its calibration source.
@@ -33,6 +38,7 @@ import json
 import os
 import sys
 
+from ..utils.live_calibration import LIVE_ARTIFACT_NAME
 from ..utils.scaling_model import capacity_plan
 
 # Control-plane calibration candidates, newest first: the r17 probe's
@@ -68,6 +74,11 @@ def main(argv=None) -> int:
     parser.add_argument("--artifacts", default="artifacts",
                         help="directory holding the calibration "
                              "artifacts (default: artifacts/)")
+    parser.add_argument("--live", default=None, metavar="DIR",
+                        help="plan from a live job's rolling re-fit "
+                             "instead of the committed calibration: DIR "
+                             "is the job's HOROVOD_CAPACITY_LIVE_DIR "
+                             "holding its capacity_live.json")
     parser.add_argument("--step-time", type=float, default=None,
                         help="override the backward compute window in "
                              "seconds (default: the overlap artifact's "
@@ -80,21 +91,39 @@ def main(argv=None) -> int:
 
     control = None
     control_path = None
-    for name in CONTROL_PLANE_ARTIFACTS:
-        path = os.path.join(args.artifacts, name)
-        try:
-            control = _load_json(path)
-            control_path = path
-            break
-        except (OSError, ValueError):
-            continue
-    if control is None or not control.get("control_plane"):
-        sys.stderr.write(
-            "capacity: no readable control-plane calibration under "
-            f"{args.artifacts!r} (looked for "
-            f"{', '.join(CONTROL_PLANE_ARTIFACTS)}); run "
-            "examples/capacity_probe.py to measure one\n")
-        return 2
+    if args.live is not None:
+        # Live mode: the ONLY source is the job's persisted rolling
+        # re-fit — falling back to a committed artifact here would
+        # silently answer a different question than the operator asked.
+        path = os.path.join(args.live, LIVE_ARTIFACT_NAME)
+        control = _load_optional(path)
+        control_path = path
+        if control is None or not control.get("control_plane"):
+            sys.stderr.write(
+                f"capacity: no live re-fit at {path!r} — the job has not "
+                "completed a telemetry window yet (or was launched "
+                "without HOROVOD_CAPACITY_LIVE_DIR); windows roll every "
+                "HOROVOD_METRICS_WINDOW_SECONDS (30s default) and the "
+                "artifact lands every HOROVOD_CAPACITY_REFIT_WINDOWS "
+                "windows and at shutdown. For a committed-calibration "
+                "plan, drop --live.\n")
+            return 2
+    else:
+        for name in CONTROL_PLANE_ARTIFACTS:
+            path = os.path.join(args.artifacts, name)
+            try:
+                control = _load_json(path)
+                control_path = path
+                break
+            except (OSError, ValueError):
+                continue
+        if control is None or not control.get("control_plane"):
+            sys.stderr.write(
+                "capacity: no readable control-plane calibration under "
+                f"{args.artifacts!r} (looked for "
+                f"{', '.join(CONTROL_PLANE_ARTIFACTS)}); run "
+                "examples/capacity_probe.py to measure one\n")
+            return 2
 
     restore = _load_optional(os.path.join(args.artifacts, RESTORE_ARTIFACT))
     overlap = _load_optional(os.path.join(args.artifacts, OVERLAP_ARTIFACT))

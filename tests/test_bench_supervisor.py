@@ -160,3 +160,133 @@ def test_child_probe_cpu_end_to_end():
     assert out.returncode == 0, out.stderr[-2000:]
     parsed = json.loads(out.stdout.strip().splitlines()[-1])
     assert parsed["probe"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# --check-trend: the regression sentinel over committed artifacts
+# (round 19, docs/capacity.md "Live recalibration")
+
+
+def _write_artifact(dirpath, name, data):
+    path = os.path.join(str(dirpath), name)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f)
+    return path
+
+
+def _cal(negotiation, reshape=0.0004, heartbeat=0.0001):
+    return {"calibration": {"negotiation_per_rank_s": negotiation,
+                            "reshape_per_rank_s": reshape,
+                            "heartbeat_per_rank_s": heartbeat}}
+
+
+def test_check_trend_ok_within_tolerance(bench, tmp_path, capsys):
+    base, cur = tmp_path / "base", tmp_path / "cur"
+    base.mkdir(), cur.mkdir()
+    _write_artifact(base, "capacity_r17.json", _cal(0.0005))
+    # +20% is inside the 50% loopback-noise tolerance.
+    _write_artifact(cur, "capacity_r18.json", _cal(0.0006))
+    rc = bench.check_trend(str(cur), str(base))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "capacity_r18.json:negotiation_per_rank_s: ok" in out
+    assert "vs capacity_r17.json" in out  # newest committed sibling
+    assert "3 metric(s) compared, 0 regression(s)" in out
+
+
+def test_check_trend_regression_exits_1_per_metric_verdicts(bench,
+                                                            tmp_path,
+                                                            capsys):
+    base, cur = tmp_path / "base", tmp_path / "cur"
+    base.mkdir(), cur.mkdir()
+    _write_artifact(base, "capacity_r17.json", _cal(0.0005))
+    # 3x the committed slope: a step-function regression, not noise.
+    _write_artifact(cur, "capacity_r18.json", _cal(0.0015))
+    rc = bench.check_trend(str(cur), str(base))
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = [ln for ln in out.splitlines()
+            if "negotiation_per_rank_s" in ln][0]
+    assert "REGRESSION" in line and "lower is better" in line
+    assert "tolerance 50%" in line
+    # The untouched metrics on the same artifact still read ok.
+    assert "capacity_r18.json:reshape_per_rank_s: ok" in out
+    assert "1 regression(s)" in out
+
+
+def test_check_trend_higher_is_better_and_ratio_paths(bench, tmp_path,
+                                                      capsys):
+    base, cur = tmp_path / "base", tmp_path / "cur"
+    base.mkdir(), cur.mkdir()
+    # overlap efficiency regresses DOWNWARD (higher is better)...
+    _write_artifact(base, "overlap_r16.json",
+                    {"median_step_report": {"overlap_efficiency": 0.94}})
+    _write_artifact(cur, "overlap_r17.json",
+                    {"median_step_report": {"overlap_efficiency": 0.60}})
+    # ...while the restore plane's sum/count RATIO stays inside 50%.
+    _write_artifact(base, "elastic_restore_r15.json",
+                    {"hvd_elastic_restore_seconds":
+                     {"sum": 10.0, "count": 10}})
+    _write_artifact(cur, "elastic_restore_r19.json",
+                    {"hvd_elastic_restore_seconds":
+                     {"sum": 12.0, "count": 10}})
+    rc = bench.check_trend(str(cur), str(base))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "overlap_r17.json:overlap_efficiency: REGRESSION" in out
+    assert "higher is better" in out
+    assert "elastic_restore_r19.json:restore_mean_s: ok" in out
+
+
+def test_check_trend_same_name_baseline_beats_newest_round(bench,
+                                                           tmp_path,
+                                                           capsys):
+    """A re-run of an already-committed round compares against ITSELF,
+    not a newer sibling whose schema may have diverged (the r10-vs-r12
+    allreduce_bandwidth case)."""
+    base, cur = tmp_path / "base", tmp_path / "cur"
+    base.mkdir(), cur.mkdir()
+    _write_artifact(base, "capacity_r17.json", _cal(0.0005))
+    _write_artifact(base, "capacity_r99.json", _cal(0.0001))
+    _write_artifact(cur, "capacity_r17.json", _cal(0.0006))
+    rc = bench.check_trend(str(cur), str(base))
+    out = capsys.readouterr().out
+    # vs r99's 0.0001 this would be a 6x regression; vs the same-name
+    # committed r17 it is +20%: ok.
+    assert rc == 0 and "vs capacity_r17.json" in out
+
+
+def test_check_trend_skips_are_reported_not_failed(bench, tmp_path,
+                                                   capsys):
+    base, cur = tmp_path / "base", tmp_path / "cur"
+    base.mkdir(), cur.mkdir()
+    # Unknown family: ignored. Known family, no committed sibling: skip.
+    _write_artifact(cur, "widget_r3.json", {"value": 1.0})
+    _write_artifact(cur, "capacity_r18.json", _cal(0.0005))
+    # Known family, metric absent in the current artifact: skip.
+    _write_artifact(base, "serving_r11.json", {"value": 2400.0})
+    _write_artifact(cur, "serving_r12.json", {"other": 1})
+    rc = bench.check_trend(str(cur), str(base))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "capacity_r18.json: skip (no committed" in out
+    assert "serving_r12.json:tokens_per_s: skip (metric absent" in out
+    assert "widget_r3.json" not in out
+    assert "0 regression(s)" in out
+
+
+def test_check_trend_cli_dispatch_exit_code(tmp_path):
+    """python bench.py --check-trend DIR --baseline DIR end to end: the
+    dispatch path parses args and propagates the regression exit."""
+    base, cur = tmp_path / "base", tmp_path / "cur"
+    base.mkdir(), cur.mkdir()
+    _write_artifact(base, "capacity_r17.json", _cal(0.0005))
+    _write_artifact(cur, "capacity_r18.json", _cal(0.0025))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("BENCH_CHILD", None)
+    out = subprocess.run(
+        [sys.executable, BENCH, "--check-trend", str(cur),
+         "--baseline", str(base)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "REGRESSION" in out.stdout

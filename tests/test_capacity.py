@@ -341,6 +341,74 @@ def test_capacity_headroom_registered_and_diagnosable():
     assert any(d.rule == "capacity_headroom" for d in diagnose(ev))
 
 
+def _window_of(snap):
+    return {"index": 0, "start": 0.0, "end": 1.0, "duration_seconds": 1.0,
+            "snapshots": {0: snap}}
+
+
+def _cycle_snap(counts, world=64):
+    return {"hvd_membership_size": _gauge_entry(world),
+            "hvd_controller_cycle_seconds": _hist_entry(
+                (0.01, 0.02, 0.05, 0.1, 1.0), counts)}
+
+
+def test_capacity_headroom_warmup_heals_within_two_windows():
+    """The windowed twin (ISSUE 19): a slow warm-up lives forever in the
+    lifetime histogram, but once two healthy windows roll past it the
+    rule judges the RECENT deltas and heals."""
+    slow = _cycle_snap([0, 0, 0, 0, 30, 0])      # p99 past the 64ms wire
+    healthy = _cycle_snap([0, 0, 30, 0, 0, 0])   # p99 under 50ms
+    # Without windows the lifetime snapshot fires — the dilution problem.
+    lifetime = Evidence(snapshots={0: slow},
+                        capacity_calibration=_plan_data())
+    assert [d.evidence["plane"] for d in
+            check_capacity_headroom(lifetime)] == ["negotiation"]
+    # Same lifetime totals, but the last two windows are healthy: silent.
+    ev = Evidence(snapshots={0: slow}, capacity_calibration=_plan_data(),
+                  windows=[_window_of(slow), _window_of(healthy),
+                           _window_of(healthy)])
+    assert list(check_capacity_headroom(ev)) == []
+
+
+def test_capacity_headroom_fresh_degradation_fires_despite_history():
+    """The other direction: hours of healthy history must not dilute
+    fresh degradation away. The lifetime view (10k fast cycles swallowing
+    30 slow ones) stays silent; the recent windows name the plane."""
+    diluted = _cycle_snap([0, 0, 10000, 0, 30, 0])
+    silent = Evidence(snapshots={0: diluted},
+                      capacity_calibration=_plan_data())
+    assert list(check_capacity_headroom(silent)) == []
+    slow = _cycle_snap([0, 0, 0, 0, 30, 0])
+    ev = Evidence(snapshots={0: diluted},
+                  capacity_calibration=_plan_data(),
+                  windows=[_window_of(_cycle_snap([0, 0, 10000, 0, 0, 0])),
+                           _window_of(slow), _window_of(slow)])
+    findings = list(check_capacity_headroom(ev))
+    assert [d.evidence["plane"] for d in findings] == ["negotiation"]
+    assert findings[0].evidence["windows_judged"] == 2
+
+
+def test_recv_wait_skew_windowed_heals():
+    """recv_wait_skew rides the same recent-window view: one slow
+    warm-up recv profile no longer brands a now-healthy link."""
+    from horovod_tpu.doctor.rules import check_recv_wait_skew
+
+    buckets = (0.01, 0.1, 1.0)
+
+    def rw(counts):
+        return {"hvd_wire_recv_wait_seconds": _hist_entry(buckets, counts)}
+
+    slow, fast = rw([0, 0, 30, 0]), rw([30, 0, 0, 0])
+    snapshots = {0: {}, 1: slow, 2: fast, 3: fast}
+    lifetime = Evidence(snapshots=snapshots)
+    assert [d.rank for d in check_recv_wait_skew(lifetime)] == [1]
+    healthy_window = _window_of({})
+    healthy_window["snapshots"] = {0: {}, 1: fast, 2: fast, 3: fast}
+    recent = Evidence(snapshots=snapshots,
+                      windows=[healthy_window, healthy_window])
+    assert list(check_recv_wait_skew(recent)) == []
+
+
 def test_evidence_picks_up_calibration_live_and_offline(monkeypatch,
                                                         tmp_path):
     monkeypatch.setenv("HOROVOD_CAPACITY_CALIBRATION", ARTIFACT)

@@ -156,6 +156,9 @@ def test_known_series_present():
         "hvd_native_pipeline_stall_seconds",
         "hvd_native_cycle_seconds",
         "hvd_native_execute_seconds",
+        "hvd_metrics_windows_total",
+        "hvd_capacity_drift_ratio",
+        "hvd_capacity_refits_total",
     ):
         assert expected in names, f"missing from the codebase: {expected}"
 
