@@ -257,7 +257,16 @@ def _controller_metrics():
 # shard_mapped Pallas kernel, not the einsum fallback.
 
 FULL_ROWS = {
-    # CPU-only path proof FIRST: it needs no TPU, so even a pool that
+    # The aggregate static gate (hvdlint + aux lint + protocheck incl.
+    # --native + whole-process lock graph + hvdabi) as a bench row: the
+    # full record lands beside the perf rows so an ABI/spec drift shows
+    # up in the same artifact a reviewer already reads. Pure parse work,
+    # no TPU, a few seconds.
+    "static_gates": {
+        "module": "horovod_tpu.tools.check",
+        "args": ["--format", "json"],
+        "json": True},
+    # CPU-only path proof next: it needs no TPU, so even a pool that
     # wedges after the probe cannot starve it of budget.
     "llama_tp_decode_path_proof": {
         "script": "examples/tp_decode_profile.py",
@@ -393,8 +402,11 @@ def child_row(name, status_path):
         return
     spec = FULL_ROWS[name]
     _phase(status_path, "import")
-    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          spec["script"])
+    if "module" in spec:
+        script = spec["module"]  # run as `python -m <module>` in-process
+    else:
+        script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              spec["script"])
     argv_prev = sys.argv
     sys.argv = [script] + spec["args"]
     buf = io.StringIO()
@@ -409,7 +421,10 @@ def child_row(name, status_path):
     import runpy
     try:
         with contextlib.redirect_stdout(buf):
-            runpy.run_path(script, run_name="__main__")
+            if "module" in spec:
+                runpy.run_module(spec["module"], run_name="__main__")
+            else:
+                runpy.run_path(script, run_name="__main__")
     except SystemExit as e:
         if e.code not in (0, None):
             sys.stderr.write(buf.getvalue())
@@ -444,7 +459,8 @@ def child_row(name, status_path):
                 f"row {name}: no rate matched in: {out.strip()[-300:]}")
         row = {"metric": name, "value": float(m.group(1)),
                "unit": spec["unit"], "cmd": " ".join(
-                   ["python", spec["script"]] + spec["args"])}
+                   ["python", spec.get("script") or
+                    "-m " + spec["module"]] + spec["args"])}
     row.setdefault("metrics", _controller_metrics())
     row.setdefault("straggler", _straggler_summary())
     row.setdefault("health", _doctor_summary())

@@ -10,8 +10,10 @@ complementary ways:
 
 * :mod:`~horovod_tpu.analysis.framework` + :mod:`~horovod_tpu.analysis.rules`
   — an AST-based lint over the package source with distributed-correctness
-  rules (HVD001..HVD009), ``# hvdlint: disable=RULE`` suppressions, a
-  checked-in baseline for grandfathered findings, and JSON/text reporters.
+  rules (HVD001..HVD011), ``# hvdlint: disable=RULE`` suppressions, a
+  checked-in baseline for grandfathered findings (HVD010/HVD011 — the
+  cross-language ABI rules — are ``NEVER_BASELINE``), and JSON/text
+  reporters.
   CLI: ``python -m horovod_tpu.tools.lint``; gate: ``tests/test_lint.py``.
 * :mod:`~horovod_tpu.analysis.dataflow` — the call-graph + rank-taint
   machinery behind the interprocedural rules (HVD001 catches a
@@ -29,6 +31,14 @@ complementary ways:
   reports statically-possible cycles never observed at runtime.
 * :mod:`~horovod_tpu.analysis.autofix` — mechanical ``--fix`` repairs
   for HVD002/HVD005 (idempotent by construction).
+* :mod:`~horovod_tpu.analysis.cpp` — the hvdabi cross-language plane: a
+  declarative (no-compiler) extractor over the C++ core's
+  ``extern "C"`` signatures, counter-slot layout, frame-kind anchors,
+  and mutex regions, with checkers for the ABI bijection
+  (``bindings.py`` ↔ C ↔ tf_ops ``CoreApi``), counter/metrics parity,
+  native frame-kind coverage (``protocheck --native``), and the C++
+  half of the whole-process static lock graph.
+  CLI: ``python -m horovod_tpu.tools.abicheck``.
 
 Everything here is stdlib-only and import-light: ``common/wire.py`` (and
 every other hot module) imports :func:`~horovod_tpu.analysis.lockorder.make_lock`
@@ -38,6 +48,7 @@ See ``docs/static-analysis.md`` for the rule catalog and workflows.
 """
 
 from .framework import (  # noqa: F401
+    NEVER_BASELINE,
     Finding,
     LintResult,
     Rule,
@@ -70,6 +81,7 @@ from .protocol import (  # noqa: F401
 from .rules import ALL_RULES, aux_rules, get_rule  # noqa: F401
 
 __all__ = [
+    "NEVER_BASELINE",
     "Finding", "LintResult", "Rule", "SourceFile", "baseline_key",
     "iter_python_files", "lint_source", "load_baseline", "render_json",
     "render_text", "run_lint", "write_baseline", "ALL_RULES", "aux_rules",

@@ -180,6 +180,11 @@ def run_lint(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
     # are file-invariant for several rules).
     baseline_budget: Dict[Tuple[str, str, str], int] = {}
     for e in (baseline or []):
+        if str(e.get("rule", "")) in NEVER_BASELINE:
+            # ABI/counter drift must never be grandfathered: a baseline
+            # entry for these rules (hand-edited in) is simply ignored,
+            # so the finding still fails the gate.
+            continue
         k = baseline_key(e)
         baseline_budget[k] = baseline_budget.get(k, 0) + 1
 
@@ -255,9 +260,26 @@ def load_baseline(path: str) -> List[dict]:
     return entries
 
 
+#: Rules whose findings may NEVER be baselined: cross-language ABI and
+#: counter/series drift (HVD010/HVD011) describe a contract that is
+#: already broken on disk — grandfathering one ships the round-10
+#: stack-garbage bug class. ``write_baseline`` refuses them and
+#: ``run_lint`` ignores hand-edited baseline entries carrying them.
+NEVER_BASELINE = frozenset({"HVD010", "HVD011"})
+
+
 def write_baseline(path: str, findings: Sequence[Finding]) -> str:
     """Write the grandfather file. Line numbers are recorded for human
-    orientation only; matching ignores them (see :func:`baseline_key`)."""
+    orientation only; matching ignores them (see :func:`baseline_key`).
+
+    Raises ``ValueError`` for findings from :data:`NEVER_BASELINE`
+    rules — ABI drift must be fixed, not grandfathered."""
+    refused = sorted({f.rule for f in findings if f.rule in NEVER_BASELINE})
+    if refused:
+        raise ValueError(
+            "refusing to baseline %s finding(s): ABI/counter drift must "
+            "be fixed, never grandfathered (docs/static-analysis.md)"
+            % ", ".join(refused))
     entries = [f.as_dict() for f in
                sorted(findings, key=lambda f: (f.path, f.line, f.rule))]
     with open(path, "w", encoding="utf-8") as f:

@@ -388,13 +388,21 @@ def _resolve_lock_assignments(tree):
     return class_attrs, module_names
 
 
-def static_graph(paths: Optional[List[str]] = None) -> dict:
+def static_graph(paths: Optional[List[str]] = None,
+                 include_native: Optional[bool] = None) -> dict:
     """Extract the potential lock-order graph from source. ``paths``
     defaults to the installed ``horovod_tpu`` package. Returns a report
     shaped like the runtime one (locks / edges / cycles / acyclic) with
     ``"static": True`` and, per edge, one example ``via`` chain
     (file::function [-> callee]) so a potential inversion is actionable
-    without ever reproducing it."""
+    without ever reproducing it.
+
+    ``include_native`` merges the C++ core's static mutex graph
+    (``analysis.cpp.lock_graph``: ``native.<tu>.<mutex>`` locks) into
+    the same report, making this the whole-process acyclicity gate.
+    Default: on for the package-default scan, off when explicit
+    ``paths`` are given (fixture scans of a tmpdir should not drag the
+    repo's C++ edges in)."""
     import ast
 
     from .dataflow import PackageIndex, call_name, iter_own_nodes
@@ -591,11 +599,32 @@ def static_graph(paths: Optional[List[str]] = None) -> dict:
                                  f"{where} -> {bare} "
                                  f"({callee[0]}::{callee[1]})")
 
-    all_locks = sorted({name
-                        for class_attrs, mod_names in lock_tables.values()
-                        for name in list(mod_names.values())
-                        + [n for attrs in class_attrs.values()
-                           for n in attrs.values()]})
+    lock_names = {name
+                  for class_attrs, mod_names in lock_tables.values()
+                  for name in list(mod_names.values())
+                  + [n for attrs in class_attrs.values()
+                     for n in attrs.values()]}
+    if include_native is None:
+        include_native = paths == [os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))]
+    if include_native:
+        # The C++ half: native.<tu>.<mutex> names can never collide
+        # with make_lock names, so the union graph stays one namespace.
+        try:
+            from . import cpp
+            native = cpp.lock_graph()
+        except Exception:
+            native = None  # missing/renamed C++ sources: python-only
+        if native is not None:
+            lock_names |= set(native["locks"])
+            for e in native["edges"]:
+                entry = edges.get((e["from"], e["to"]))
+                if entry is None:
+                    edges[(e["from"], e["to"])] = {"via": e["via"],
+                                                   "count": e["count"]}
+                else:
+                    entry["count"] += e["count"]
+    all_locks = sorted(lock_names)
     cycles = find_cycles(edges)
     return {
         "static": True,

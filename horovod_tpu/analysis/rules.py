@@ -1,4 +1,4 @@
-"""hvdlint distributed-correctness rules (HVD001..HVD009).
+"""hvdlint distributed-correctness rules (HVD001..HVD011).
 
 Each rule encodes one invariant the runtime depends on but cannot check
 until a job is already hung:
@@ -43,6 +43,15 @@ until a job is already hung:
   the sanctioned monotonic helpers (``epoch_advances``/
   ``epoch_is_stale``): one auditable definition of "newer epoch" for
   the runtime, the reshape drain, and the conformance monitor.
+* HVD010 — cross-language ABI drift: a ctypes declaration in
+  ``core/bindings.py`` that disagrees with the ``extern "C"``
+  definition in the C++ core (arg count, ctype compatibility, restype)
+  — the hvdabi extractor (``analysis/cpp.py``) checks this with a
+  parse, not a rebuild. Never baselinable.
+* HVD011 — native counter/series mirror drift: the metrics package
+  consuming a counter key the C layout does not define, or registering
+  a ``hvd_native_*``/``hvd_ring_*`` series with no owning counter slot
+  in ``analysis/cpp.NATIVE_SERIES_MAP``. Never baselinable.
 """
 
 from __future__ import annotations
@@ -424,6 +433,48 @@ class RawEpochComparisonRule(Rule):
                     "helpers the conformance monitor shares")
 
 
+class AbiDriftRule(Rule):
+    code = "HVD010"
+    name = "abi-drift"
+    description = ("ctypes declaration in core/bindings.py disagrees "
+                   "with the extern \"C\" definition in the C++ core "
+                   "(arg count, ctype compatibility, restype) — checked "
+                   "statically by hvdabi (analysis/cpp.py), no rebuild")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not src.relpath.endswith("core/bindings.py"):
+            return
+        # The C++ sources are located from the installed package tree
+        # (cpp module's own location), NOT from src.abspath: fixture
+        # tests hand lint_source() fake paths.
+        from . import cpp
+
+        for f in cpp.bindings_source_findings(src.source):
+            yield Finding(rule=self.code, path=src.relpath,
+                          line=f["line"] or 1, col=0,
+                          message=f["message"])
+
+
+class CounterDriftRule(Rule):
+    code = "HVD011"
+    name = "counter-series-drift"
+    description = ("native counter/series mirror drift: the metrics "
+                   "package consumes a counter key the C layout does "
+                   "not define, or registers a hvd_native_*/hvd_ring_* "
+                   "series with no owning counter slot in "
+                   "analysis/cpp.NATIVE_SERIES_MAP")
+
+    def check(self, src: SourceFile) -> Iterator[Finding]:
+        if not src.relpath.endswith("metrics/__init__.py"):
+            return
+        from . import cpp
+
+        for f in cpp.metrics_source_findings(src.source):
+            yield Finding(rule=self.code, path=src.relpath,
+                          line=f["line"] or 1, col=0,
+                          message=f["message"])
+
+
 ALL_RULES: List[Type[Rule]] = [
     DivergentCollectiveRule,
     UnorderedIterationRule,
@@ -434,6 +485,8 @@ ALL_RULES: List[Type[Rule]] = [
     MetricCatalogRule,
     ProtocolHandlerRule,
     RawEpochComparisonRule,
+    AbiDriftRule,
+    CounterDriftRule,
 ]
 
 

@@ -46,8 +46,9 @@ WIRE_LINK_CODES = {
 WIRE_LINK_NAMES = {v: k for k, v in WIRE_LINK_CODES.items()}
 
 # Native-engine telemetry plane (engine.cc): counter-slot layout of
-# hvd_eng_get_counters. MUST mirror enum CounterSlot — the ABI freshness
-# smoke test pins the total slot count against the C return value.
+# hvd_eng_get_counters. MUST mirror enum CounterSlot — hvdabi
+# (analysis/cpp.py) pins the layout statically against the C enum, and
+# the @slow rebuild smoke still cross-checks the compiled .so.
 NATIVE_HIST_BUCKETS = 22   # kHistBuckets: registry DEFAULT_TIME_BUCKETS
 NATIVE_HIST_SLOTS = NATIVE_HIST_BUCKETS + 1  # + the +Inf overflow slot
 NATIVE_COUNTER_SCALARS = (
@@ -227,7 +228,7 @@ def native_counters() -> Optional[dict]:
     the core isn't loaded, no engine ever initialized in this process
     (e.g. the Python controller merely using the ring data plane), or the
     loaded .so reports a different slot layout (ABI drift — also caught
-    loudly by the freshness smoke test)."""
+    statically by hvdabi and loudly by the @slow rebuild smoke)."""
     lib = loaded()
     if lib is None or not lib.hvd_eng_active():
         return None

@@ -305,8 +305,8 @@ struct TimeHist {
 };
 
 // Counter-slot layout for hvd_eng_get_counters: APPEND-ONLY, mirrored by
-// NATIVE_COUNTER_SLOTS in core/bindings.py (a drift fails the ABI
-// freshness smoke test's slot-count pin).
+// NATIVE_COUNTER_SCALARS / N_NATIVE_COUNTER_SLOTS in core/bindings.py (a
+// drift is a hvdabi finding: python -m horovod_tpu.tools.abicheck).
 enum CounterSlot : int {
   CTR_CYCLES = 0,
   CTR_TENSORS = 1,
@@ -801,6 +801,22 @@ class Engine {
   }
 
   // --------------------------------------------------------- control frames
+  //
+  // Frame-kind coverage vs the 7-kind SPEC in analysis/protocol.py,
+  // checked statically by `protocheck --native` (analysis/cpp.py). The
+  // native engine's control plane is raw length-prefixed replies on the
+  // coordinator wires — it does not yet speak the kind-byte protocol, so
+  // every kind beyond the data plane is declared unsupported here rather
+  // than silently dropped (ROADMAP item 1 is the work that flips these
+  // to handled).
+  //
+  // hvdabi:frame-kind kind=data status=handled via=recv_frame
+  // hvdabi:frame-kind kind=heartbeat status=unsupported reason=python-engine-only
+  // hvdabi:frame-kind kind=abort status=unsupported reason=python-engine-only
+  // hvdabi:frame-kind kind=join status=unsupported reason=python-engine-only
+  // hvdabi:frame-kind kind=reshape status=unsupported reason=python-engine-only
+  // hvdabi:frame-kind kind=shard_fetch status=unsupported reason=python-engine-only
+  // hvdabi:frame-kind kind=shard_data status=unsupported reason=python-engine-only
 
   void send_frame(const std::vector<uint8_t>& payload) {
     uint32_t len = (uint32_t)payload.size();
@@ -2257,7 +2273,8 @@ int hvd_eng_get_spans(long long max, int* phases, long long* seqs,
 }
 
 // Cumulative counters + histogram buckets (slot layout: CounterSlot /
-// bindings.NATIVE_COUNTER_SLOTS). Fills min(n, slot count) entries of
+// bindings.NATIVE_COUNTER_SCALARS..N_NATIVE_COUNTER_SLOTS). Fills
+// min(n, slot count) entries of
 // `out`; returns the slot count so callers can size-check. Zeros when no
 // engine was ever initialized.
 int hvd_eng_get_counters(long long* out, int n) {

@@ -56,8 +56,8 @@ struct CoreApi {
   // Trailing void* is the round-10 int8 error-feedback residual slot;
   // the TF tier never compensates (no per-tensor residual store here),
   // so it always passes nullptr — but the POINTER TYPE must match the
-  // core's 8-arg ABI or the callee reads a garbage residual off the
-  // stack.
+  // core's 9-arg ABI or the callee reads a garbage residual off the
+  // stack (hvdabi pins every fn-pointer type here against engine.cc).
   long long (*enqueue)(int, const char*, void*, const long long*, int, int,
                        int, void*, int) = nullptr;
   int (*wait)(long long) = nullptr;

@@ -115,7 +115,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                       select=select)
 
     if args.write_baseline:
-        out = write_baseline(args.baseline, result.findings)
+        try:
+            out = write_baseline(args.baseline, result.findings)
+        except ValueError as exc:
+            # NEVER_BASELINE rules (HVD010/HVD011): ABI drift is fixed,
+            # not grandfathered.
+            print(f"hvdlint: {exc}", file=sys.stderr)
+            return 2
         print(f"hvdlint: wrote {len(result.findings)} finding(s) to {out}")
         return 0
 

@@ -18,6 +18,14 @@ The static side of the wire/epoch protocol spec
   graph misses (a bug in the static pass — it must be a superset) and
   (b) statically-possible cycles no run has ever exhibited (the races
   we could have; exit 1 when any exist).
+* ``--native`` — frame-kind coverage of the C++ engine
+  (``core/src/engine.cc``) against the same 7-kind SPEC, via the hvdabi
+  extractor (``analysis/cpp.py``): every kind must carry a
+  ``hvdabi:frame-kind`` anchor declaring it handled (with a real
+  function) or explicitly unsupported — a kind with neither is a frame
+  the native engine would silently drop (exit 1). Declared-unsupported
+  kinds are reported as coverage, not findings (the ROADMAP item 1
+  gap, visible instead of silent).
 * ``--dump-spec`` — render the three role state tables as markdown
   (the source of the tables in docs/static-analysis.md).
 """
@@ -59,6 +67,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         metavar="PROTOCHECK_JSON",
                         help="validate runtime protocheck.json artifacts "
                              "(exit 1 on recorded violations)")
+    parser.add_argument("--native", action="store_true",
+                        help="also check the C++ engine's frame-kind "
+                             "coverage against the SPEC (hvdabi static "
+                             "anchors; exit 1 on silent drops)")
     parser.add_argument("--lockgraph", nargs="*", default=None,
                         metavar="LOCKGRAPH_JSON",
                         help="join the static lock-order graph with "
@@ -73,6 +85,27 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     report = {"static_findings": _static_findings()}
     rc = 1 if report["static_findings"] else 0
+
+    if args.native:
+        from ..analysis import cpp
+
+        sources = cpp.load_sources()
+        engine = sources.get("engine")
+        if engine is None:
+            report["native"] = {
+                "findings": [{"path": dict(cpp.CPP_SOURCES)["engine"],
+                              "line": 0,
+                              "message": "engine.cc not found"}],
+                "coverage": {}}
+            rc = 1
+        else:
+            anchors = cpp.parse_frame_anchors(engine["comments"])
+            findings, coverage = cpp.check_native_frames(
+                engine["functions"], anchors, protocol.KINDS,
+                engine["relpath"])
+            report["native"] = {"findings": findings, "coverage": coverage}
+            if findings:
+                rc = 1
 
     if args.runtime is not None:
         runtime = {"artifacts": [], "violations": []}
@@ -120,6 +153,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"{f['path']}:{f['line']}: {f['message']}")
     print(f"protocheck: {len(report['static_findings'])} static "
           "finding(s)")
+    if "native" in report:
+        for f in report["native"]["findings"]:
+            print(f"{f['path']}:{f['line']}: {f['message']}")
+        cov = report["native"]["coverage"]
+        handled = sorted(k for k, v in cov.items()
+                         if v["status"] == "handled")
+        unsupported = sorted(k for k, v in cov.items()
+                             if v["status"] == "unsupported")
+        print(f"protocheck --native: "
+              f"{len(report['native']['findings'])} finding(s); "
+              f"handled: {', '.join(handled) or '-'}; "
+              f"declared unsupported: {', '.join(unsupported) or '-'}")
     if "runtime" in report:
         for v in report["runtime"]["violations"]:
             print(f"{v['artifact']}: OFF-SPEC {v['role']}.{v['state']} "

@@ -1,0 +1,22 @@
+"""HVD010 bad fixture: ctypes declarations that drift from the real
+extern "C" definitions in the C++ core (linted AS core/bindings.py; the
+analyzer reads the repo's actual engine.cc/ring.cc for ground truth).
+
+Three distinct drifts, each a finding:
+* hvd_eng_wait — wrong arg COUNT (the C definition takes one long long);
+* hvd_eng_poll — right count, wrong CTYPE (c_int for a long long handle
+  truncates on every 64-bit sequence id past 2^31);
+* hvd_ring_allreduce — restype-only pin for a 4-arg C function (ctypes
+  would silently default every argument to c_int).
+"""
+
+import ctypes
+
+
+def declare(lib):
+    lib.hvd_eng_wait.argtypes = [ctypes.c_longlong, ctypes.c_int]
+    lib.hvd_eng_wait.restype = ctypes.c_int
+    lib.hvd_eng_poll.argtypes = [ctypes.c_int]
+    lib.hvd_eng_poll.restype = ctypes.c_int
+    lib.hvd_ring_allreduce.restype = ctypes.c_int
+    return lib

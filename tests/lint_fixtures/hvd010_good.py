@@ -1,0 +1,17 @@
+"""HVD010 good fixture: ctypes declarations that agree with the real
+extern "C" definitions (arg count, ctype compatibility, restype) — no
+findings. A restype-only pin is fine for a 0-arg C function."""
+
+import ctypes
+
+
+def declare(lib):
+    lib.hvd_eng_wait.argtypes = [ctypes.c_longlong]
+    lib.hvd_eng_wait.restype = ctypes.c_int
+    lib.hvd_eng_poll.argtypes = [ctypes.c_longlong]
+    lib.hvd_eng_poll.restype = ctypes.c_int
+    lib.hvd_ring_allreduce.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                       ctypes.c_int, ctypes.c_int]
+    lib.hvd_ring_allreduce.restype = ctypes.c_int
+    lib.hvd_ring_last_error.restype = ctypes.c_char_p
+    return lib

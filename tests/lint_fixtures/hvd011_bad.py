@@ -1,0 +1,14 @@
+"""HVD011 bad fixture: the metrics mirror consuming a counter key the C
+layout does not define (linted AS metrics/__init__.py; the analyzer
+reads the repo's real engine.cc CounterSlot enum for ground truth). A
+typo'd or removed slot name here would otherwise read as a silent
+KeyError at mirror time — or worse, silently mirror nothing."""
+
+
+def refresh_native_engine_metrics(bindings):
+    c = bindings.native_counters()
+    if c is None:
+        return
+    total = c["cycles"]
+    total += c["fusion_buffer_occupancy"]  # no such slot in CounterSlot
+    return total

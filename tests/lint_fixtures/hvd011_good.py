@@ -1,0 +1,12 @@
+"""HVD011 good fixture: every consumed counter key exists in the C
+layout (scalar slots plus the histogram/generation keys) — silent."""
+
+
+def refresh_native_engine_metrics(bindings):
+    c = bindings.native_counters()
+    if c is None:
+        return
+    total = c["cycles"] + c["tensors"] + c["pipeline_stall_us"]
+    gen = c["engine_gen"]
+    hist = c["cycle_seconds"]
+    return total, gen, hist

@@ -1,0 +1,406 @@
+"""hvdabi: cross-language conformance analyzer (``analysis/cpp.py``).
+
+Two halves:
+
+* extractor unit tests over synthetic C++ snippets — block comments,
+  string literals, preprocessor guards, multi-line signatures,
+  macro-wrapped exports, constexpr enum algebra, frame anchors, lock
+  regions;
+* repo-level gates — HEAD is clean, the committed manifest pin is
+  golden, the never-baseline ratchet holds, and a seeded-drift matrix
+  (mutated arg count, dropped frame-kind anchor, renamed counter slot,
+  inverted lock pair) proves each checker actually fires on the kind of
+  drift it exists for.  The matrix clones the conformance surface into
+  tmp_path and mutates the clone, so the real tree is never touched.
+"""
+
+import json
+import os
+import shutil
+
+import pytest
+
+from horovod_tpu.analysis import cpp
+from horovod_tpu.analysis.framework import (Finding, NEVER_BASELINE,
+                                            run_lint, write_baseline)
+from horovod_tpu.analysis.lockorder import find_cycles
+from horovod_tpu.analysis.rules import AbiDriftRule
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_snippet(src):
+    """Mirror load_sources' per-TU pipeline for a synthetic snippet."""
+    code_nc, code, comments = cpp._strip(src)
+    code, guarded = cpp._preprocess(code)
+    code_nc, _ = cpp._preprocess(code_nc)
+    return cpp.parse_functions(code_nc, code, guarded), comments
+
+
+def _fake_sources(tag, src, relpath="synthetic.cc"):
+    code_nc, code, comments = cpp._strip(src)
+    code, guarded = cpp._preprocess(code)
+    code_nc, _ = cpp._preprocess(code_nc)
+    return {tag: {
+        "relpath": relpath, "text": src, "code_nc": code_nc, "code": code,
+        "comments": comments, "guarded_lines": guarded,
+        "functions": cpp.parse_functions(code_nc, code, guarded),
+    }}
+
+
+# ---------------------------------------------------------------------------
+# Extractor edge cases
+
+
+def test_block_comment_hides_signatures():
+    funcs, comments = _parse_snippet(
+        "/* long long fake_fn(int a); spans\n"
+        "   two lines with int other_fake(void); inside */\n"
+        "int real_fn(int a) { return a; }\n")
+    assert [f["name"] for f in funcs] == ["real_fn"]
+    # block comments yield one entry per line, line numbers accurate
+    assert comments[0][0] == 1 and "fake_fn" in comments[0][1]
+    assert comments[1][0] == 2 and "other_fake" in comments[1][1]
+
+
+def test_string_literal_contents_are_blanked():
+    funcs, _ = _parse_snippet(
+        'void log_it() { emit("int string_fn(int);"); }\n'
+        "int after_string(int b);\n")
+    names = {f["name"] for f in funcs}
+    assert "string_fn" not in names
+    assert "after_string" in names
+
+
+def test_preprocessor_if0_blanked_and_ifdef_flagged_guarded():
+    funcs, _ = _parse_snippet(
+        "#if 0\n"
+        "int dead_fn(int a);\n"
+        "#endif\n"
+        "int live_fn(int a);\n"
+        "#ifdef HVD_EXPERIMENTAL\n"
+        "int guarded_fn(int a);\n"
+        "#endif\n")
+    by = {f["name"]: f for f in funcs}
+    assert "dead_fn" not in by
+    assert by["live_fn"]["guarded"] is False
+    assert by["guarded_fn"]["guarded"] is True
+
+
+def test_multiline_signature_in_extern_c_block():
+    funcs, _ = _parse_snippet(
+        'extern "C" {\n'
+        "int hvd_multi(const void* buf,\n"
+        "              long n,\n"
+        "              int dtype,\n"
+        "              int op) {\n"
+        "  return 0;\n"
+        "}\n"
+        "}\n")
+    (f,) = [f for f in funcs if f["name"] == "hvd_multi"]
+    assert f["extern_c"] and f["kind"] == "def" and f["line"] == 2
+    assert [(p["type"], p["name"]) for p in f["params"]] == [
+        ("const void *", "buf"), ("long", "n"),
+        ("int", "dtype"), ("int", "op")]
+
+
+def test_macro_wrapped_export():
+    funcs, _ = _parse_snippet(
+        '#define HVD_EXPORT __attribute__((visibility("default")))\n'
+        'extern "C" HVD_EXPORT long long hvd_macro_export(int n) '
+        "{ return n; }\n")
+    (f,) = [f for f in funcs if f["name"] == "hvd_macro_export"]
+    assert f["extern_c"] and f["kind"] == "def"
+    assert f["ret"] == "long long"  # ALL-CAPS macro token dropped
+
+
+def test_assignment_expressions_are_not_declarations():
+    funcs, _ = _parse_snippet(
+        "void driver() {\n"
+        "  long esz = hvd_dtype_size(dtype);\n"
+        "  hvd::g_last = hvd_ring_last_error();\n"
+        "}\n")
+    assert [f["name"] for f in funcs] == ["driver"]
+
+
+def test_counter_enum_with_constexpr_algebra():
+    counters = cpp.extract_counters(
+        "constexpr int kHistBuckets = 4;\n"
+        "constexpr int kHistSlots = kHistBuckets + 1;\n"
+        "enum CounterSlot {\n"
+        "  CTR_ALPHA = 0,\n"
+        "  CTR_BETA,\n"
+        "  CYCLE_HIST_COUNT,\n"
+        "  N_COUNTER_SLOTS = CYCLE_HIST_COUNT + 2 * kHistSlots,\n"
+        "};\n")
+    assert counters["scalars"] == ["alpha", "beta"]
+    assert counters["hist_buckets"] == 4
+    assert counters["hist_slots"] == 5
+    assert counters["n_slots"] == 12
+
+
+# ---------------------------------------------------------------------------
+# Frame-kind anchor checker (synthetic)
+
+_KINDS = ("data", "heartbeat")
+_FUNCS = [{"name": "recv_frame"}]
+
+
+def _anchors(src):
+    _, comments = _parse_snippet(src)
+    return cpp.parse_frame_anchors(comments)
+
+
+def test_frame_anchor_clean_coverage():
+    findings, coverage = cpp.check_native_frames(_FUNCS, _anchors(
+        "// hvdabi:frame-kind kind=data status=handled via=recv_frame\n"
+        "// hvdabi:frame-kind kind=heartbeat status=unsupported "
+        "reason=python-engine-only\n"), _KINDS, "engine.cc")
+    assert findings == []
+    assert coverage == {
+        "data": {"status": "handled", "via": "recv_frame"},
+        "heartbeat": {"status": "unsupported"}}
+
+
+def test_frame_anchor_dropped_kind_is_a_finding():
+    findings, _ = cpp.check_native_frames(_FUNCS, _anchors(
+        "// hvdabi:frame-kind kind=data status=handled via=recv_frame\n"),
+        _KINDS, "engine.cc")
+    assert len(findings) == 1
+    assert "'heartbeat'" in findings[0]["message"]
+    assert "no coverage anchor" in findings[0]["message"]
+
+
+def test_frame_anchor_unknown_kind_duplicate_and_bad_via():
+    findings, _ = cpp.check_native_frames(_FUNCS, _anchors(
+        "// hvdabi:frame-kind kind=data status=handled via=recv_frame\n"
+        "// hvdabi:frame-kind kind=data status=handled via=recv_frame\n"
+        "// hvdabi:frame-kind kind=gossip status=handled via=recv_frame\n"
+        "// hvdabi:frame-kind kind=heartbeat status=handled via=nope\n"),
+        _KINDS, "engine.cc")
+    msgs = " | ".join(f["message"] for f in findings)
+    assert "duplicate frame-kind anchor" in msgs
+    assert "unknown frame kind 'gossip'" in msgs
+    assert "no such function" in msgs
+    assert len(findings) == 3
+
+
+# ---------------------------------------------------------------------------
+# Lock-graph extraction (synthetic)
+
+_LOCK_PREAMBLE = (
+    "#include <mutex>\n"
+    "static std::mutex mu_a;\n"
+    "static std::mutex mu_b;\n"
+    "void take_both() {\n"
+    "  std::lock_guard<std::mutex> la(mu_a);\n"
+    "  std::lock_guard<std::mutex> lb(mu_b);\n"
+    "}\n")
+
+
+def test_lock_graph_ordered_pair_is_acyclic():
+    g = cpp.lock_graph(_fake_sources("synth", _LOCK_PREAMBLE))
+    assert g["locks"] == ["native.synth.mu_a", "native.synth.mu_b"]
+    assert [(e["from"], e["to"]) for e in g["edges"]] == [
+        ("native.synth.mu_a", "native.synth.mu_b")]
+    assert find_cycles([(e["from"], e["to"]) for e in g["edges"]]) == []
+
+
+def test_lock_graph_reordered_pair_is_a_cycle():
+    g = cpp.lock_graph(_fake_sources("synth", _LOCK_PREAMBLE + (
+        "void take_both_inverted() {\n"
+        "  std::lock_guard<std::mutex> lb(mu_b);\n"
+        "  std::lock_guard<std::mutex> la(mu_a);\n"
+        "}\n")))
+    assert find_cycles([(e["from"], e["to"]) for e in g["edges"]])
+
+
+def test_lock_graph_propagates_through_bare_calls_only():
+    src = (
+        "#include <mutex>\n"
+        "static std::mutex mu_a;\n"
+        "static std::mutex mu_b;\n"
+        "void helper() { std::lock_guard<std::mutex> g(mu_b); }\n"
+        "void bare_caller() {\n"
+        "  std::lock_guard<std::mutex> g(mu_a);\n"
+        "  helper();\n"
+        "}\n"
+        "void receiver_caller() {\n"
+        "  std::lock_guard<std::mutex> g(mu_a);\n"
+        "  obj_.helper();\n"  # receiver call: must NOT resolve by bare name
+        "}\n")
+    g = cpp.lock_graph(_fake_sources("synth", src))
+    edges = [(e["from"], e["to"], e["via"]) for e in g["edges"]]
+    assert edges == [("native.synth.mu_a", "native.synth.mu_b",
+                      "synthetic.cc::bare_caller -> helper")]
+
+
+# ---------------------------------------------------------------------------
+# HEAD gates: clean run, golden manifest
+
+
+def test_head_has_zero_findings():
+    report = cpp.run_checks()
+    assert report["findings"] == [], "\n".join(
+        "%(path)s:%(line)s [%(check)s] %(message)s" % f
+        for f in report["findings"])
+    # the ROADMAP gap is visible as coverage, not silence
+    assert report["coverage"]["data"]["status"] == "handled"
+    assert report["coverage"]["heartbeat"]["status"] == "unsupported"
+
+
+def test_cpp_lock_graph_matches_known_shape():
+    g = cpp.lock_graph()
+    assert "native.engine.g_engine_mu" in g["locks"]
+    pairs = {(e["from"], e["to"]) for e in g["edges"]}
+    assert ("native.engine.g_engine_mu", "native.engine.mu_") in pairs
+    assert find_cycles([(e["from"], e["to"]) for e in g["edges"]]) == []
+
+
+def test_manifest_pin_is_golden():
+    with open(os.path.join(REPO, cpp.MANIFEST_PATH)) as f:
+        pinned = f.read()
+    assert cpp.render_manifest(cpp.build_manifest()) == pinned
+
+
+def test_dump_manifest_cli_matches_pin(capsys):
+    from horovod_tpu.tools import abicheck
+    assert abicheck.main(["--dump-manifest"]) == 0
+    with open(os.path.join(REPO, cpp.MANIFEST_PATH)) as f:
+        assert capsys.readouterr().out == f.read()
+
+
+def test_abicheck_cli_clean_on_head(capsys):
+    from horovod_tpu.tools import abicheck
+    assert abicheck.main([]) == 0
+    assert "abicheck: 0 finding(s)" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Never-baseline ratchet
+
+_BAD_BINDINGS = (
+    "import ctypes\n"
+    "def declare(lib):\n"
+    "    lib.hvd_eng_wait.argtypes = [ctypes.c_longlong, ctypes.c_int]\n"
+    "    lib.hvd_eng_wait.restype = ctypes.c_int\n"
+    "    return lib\n")
+
+
+def test_write_baseline_refuses_abi_drift(tmp_path):
+    drift = Finding(rule="HVD010", path="horovod_tpu/core/bindings.py",
+                    line=3, col=0, message="seeded")
+    ok = Finding(rule="HVD001", path="x.py", line=1, col=0, message="m")
+    with pytest.raises(ValueError, match="never grandfathered"):
+        write_baseline(str(tmp_path / "b.json"), [ok, drift])
+    # without the drift finding the same call succeeds
+    assert os.path.exists(write_baseline(str(tmp_path / "b.json"), [ok]))
+
+
+def test_run_lint_ignores_hand_edited_abi_baseline(tmp_path):
+    assert "HVD010" in NEVER_BASELINE and "HVD011" in NEVER_BASELINE
+    pkg = tmp_path / "core"
+    pkg.mkdir()
+    (pkg / "bindings.py").write_text(_BAD_BINDINGS)
+    first = run_lint([str(tmp_path)], rules=[AbiDriftRule()],
+                     root=str(tmp_path))
+    assert first.findings and all(f.rule == "HVD010"
+                                  for f in first.findings)
+    # hand-edit the findings into a baseline: the budget must ignore them
+    again = run_lint([str(tmp_path)], rules=[AbiDriftRule()],
+                     baseline=[f.as_dict() for f in first.findings],
+                     root=str(tmp_path))
+    assert again.findings == first.findings
+    assert again.baselined == []
+
+
+# ---------------------------------------------------------------------------
+# Seeded-drift matrix: clone the conformance surface, mutate, re-check.
+
+_CLONE_FILES = tuple(rel for _tag, rel in cpp.CPP_SOURCES) + (
+    cpp.BINDINGS_PATH, cpp.METRICS_PATH, cpp.METRICS_PIN_PATH,
+    cpp.MANIFEST_PATH,
+    # the dtype kernels are consumed only from tests/*.py — the
+    # consumption checker scans those for symbol mentions
+    "tests/test_ring_kernels.py",
+)
+
+
+@pytest.fixture()
+def clone(tmp_path):
+    root = tmp_path / "repo"
+    for rel in _CLONE_FILES:
+        src = os.path.join(REPO, rel)
+        if not os.path.exists(src):
+            continue
+        dst = root / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copyfile(src, dst)
+    return str(root)
+
+
+def _mutate(root, rel, old, new):
+    path = os.path.join(root, rel)
+    with open(path) as f:
+        text = f.read()
+    assert old in text, "mutation anchor vanished: %r" % old
+    with open(path, "w") as f:
+        f.write(text.replace(old, new, 1))
+
+
+def test_clone_baseline_is_clean(clone):
+    report = cpp.run_checks(root=clone)
+    assert report["findings"] == [], "\n".join(
+        "%(path)s:%(line)s [%(check)s] %(message)s" % f
+        for f in report["findings"])
+
+
+def test_seeded_argcount_drift_fires_abi_checker(clone):
+    _mutate(clone, cpp.BINDINGS_PATH,
+            "lib.hvd_eng_wait.argtypes = [ctypes.c_longlong]",
+            "lib.hvd_eng_wait.argtypes = [ctypes.c_longlong, ctypes.c_int]")
+    findings = cpp.run_checks(root=clone, with_manifest=False)["findings"]
+    assert len(findings) == 1
+    assert findings[0]["check"] == "abi"
+    assert "hvd_eng_wait argtypes has 2 entries" in findings[0]["message"]
+
+
+def test_seeded_dropped_frame_anchor_fires_native_frames(clone):
+    _mutate(clone, "horovod_tpu/core/src/engine.cc",
+            "// hvdabi:frame-kind kind=heartbeat status=unsupported "
+            "reason=python-engine-only\n", "")
+    findings = cpp.run_checks(root=clone, with_manifest=False)["findings"]
+    assert len(findings) == 1
+    assert findings[0]["check"] == "native-frames"
+    assert "'heartbeat'" in findings[0]["message"]
+
+
+def test_seeded_renamed_counter_slot_fires_counter_checker(clone):
+    _mutate(clone, "horovod_tpu/core/src/engine.cc",
+            "CTR_PIPELINE_STALL_US = 12,", "CTR_PIPELINE_STALL_USEC = 12,")
+    findings = cpp.run_checks(root=clone, with_manifest=False)["findings"]
+    assert findings
+    assert all(f["check"] == "counters" for f in findings)
+    assert any("pipeline_stall_us" in f["message"] for f in findings)
+
+
+def test_seeded_lock_inversion_fires_cycle_checker(clone):
+    # HEAD order is g_engine_mu -> mu_; seed the inversion.
+    with open(os.path.join(clone, "horovod_tpu/core/src/engine.cc"),
+              "a") as f:
+        f.write("\nstatic void seeded_lock_inversion() {\n"
+                "  std::lock_guard<std::mutex> a(mu_);\n"
+                "  std::lock_guard<std::mutex> b(g_engine_mu);\n"
+                "}\n")
+    findings = cpp.run_checks(root=clone, with_manifest=False)["findings"]
+    assert any(f["check"] == "locks" and "cycle" in f["message"]
+               for f in findings)
+
+
+def test_seeded_manifest_drift_fires_manifest_checker(clone):
+    _mutate(clone, "horovod_tpu/tensorflow/src/tf_ops.cc",
+            'sym("hvd_eng_wait")', 'sym("hvd_eng_wait_for")')
+    findings = cpp.run_checks(root=clone)["findings"]
+    manifest = [f for f in findings if f["check"] == "manifest"]
+    assert manifest
+    assert any("core_api" in f["message"] for f in manifest)
